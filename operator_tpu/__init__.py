@@ -1,0 +1,29 @@
+"""operator_tpu — a TPU-native rebuild of the Podmortem system.
+
+The reference (podmortem/operator, see SURVEY.md) is a Kubernetes operator that
+watches pods for failures, collects logs/events, pattern-matches them against
+Git-synced pattern libraries and produces AI explanations via two external
+REST services (log-parser, ai-interface).  This framework re-implements the
+whole system in one tree with the compute running on TPU:
+
+- ``operator_tpu.schema``    — typed CR/analysis/pattern models (replaces the
+  external ``common-lib`` Maven artifact and the three CRD YAMLs).
+- ``operator_tpu.patterns``  — the pattern-match engine (replaces the external
+  ``log-parser`` service), with a CPU scorer and a TPU semantic path.
+- ``operator_tpu.models``    — JAX implementations of the LLMs and encoders
+  (TinyLlama-1.1B → Llama-3-8B / Mistral-7B, all-MiniLM-L6).
+- ``operator_tpu.ops``       — Pallas TPU kernels (similarity top-k, ragged
+  paged attention) with pure-XLA reference implementations.
+- ``operator_tpu.parallel``  — device mesh / sharding layer (DP/TP/FSDP over
+  ICI via jax.sharding + shard_map).
+- ``operator_tpu.serving``   — continuous-batching inference engine (replaces
+  the external ``ai-interface`` service).
+- ``operator_tpu.operator``  — the asyncio control plane: watch loop,
+  reconcilers, event emission, durable storage, git pattern sync, health.
+- ``operator_tpu.utils``     — config, timing/metrics, logging.
+
+Nothing here imports jax at package-import time; the control plane can run on
+a machine with no accelerator, and the data plane initialises lazily.
+"""
+
+__version__ = "0.1.0"

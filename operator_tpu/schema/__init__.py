@@ -1,0 +1,66 @@
+"""Typed schema layer — replaces the reference's external ``common-lib``
+artifact and hand-written CRD YAMLs (SURVEY.md §2.2, §7 stage 1)."""
+
+from .analysis import (
+    AIProviderConfig,
+    AIResponse,
+    AnalysisEvent,
+    AnalysisRequest,
+    AnalysisResult,
+    AnalysisSummary,
+    MatchContext,
+    MatchedPattern,
+    PodFailureData,
+    Severity,
+    StageTimings,
+)
+from .crds import (
+    API_VERSION,
+    GROUP,
+    VERSION,
+    AIProvider,
+    AIProviderRef,
+    AIProviderSpec,
+    AIProviderStatus,
+    AuthenticationRef,
+    PatternLibrary,
+    PatternLibrarySpec,
+    PatternLibraryStatus,
+    PatternRepository,
+    PodFailureStatus,
+    Podmortem,
+    PodmortemSpec,
+    PodmortemStatus,
+    RepositoryCredentials,
+    SecretRef,
+    SyncedRepository,
+    parse_refresh_interval,
+)
+from .kube import (
+    Container,
+    ContainerState,
+    ContainerStateTerminated,
+    ContainerStateWaiting,
+    ContainerStatus,
+    Deployment,
+    Event,
+    ObjectReference,
+    Pod,
+    PodSpec,
+    PodStatus,
+    ReplicaSet,
+    Secret,
+)
+from .meta import K8sObject, LabelSelector, LabelSelectorRequirement, ObjectMeta, now_iso
+from .patterns import (
+    ContextExtraction,
+    LibraryMetadata,
+    Pattern,
+    PatternLibraryFile,
+    PrimaryPattern,
+    Remediation,
+    SecondaryPattern,
+)
+from .serde import from_dict, to_dict
+
+__all__ = [name for name in dir() if not name.startswith("_")]

@@ -1,0 +1,19 @@
+"""Test configuration.
+
+JAX tests run on the CPU backend with 8 virtual devices so DP/TP/FSDP mesh
+code is exercised without TPU hardware (SURVEY.md §4: the "multi-node without
+a cluster" strategy).  Env vars must be set before jax is first imported,
+which is why this lives at conftest import time.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

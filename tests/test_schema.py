@@ -1,0 +1,267 @@
+"""Schema layer tests: serde round-trips, selector semantics, CRD generation,
+refresh-interval parsing, severity ordering."""
+
+import yaml
+
+from operator_tpu.schema import (
+    AIProvider,
+    AIResponse,
+    AnalysisEvent,
+    AnalysisResult,
+    AnalysisSummary,
+    LabelSelector,
+    LabelSelectorRequirement,
+    MatchedPattern,
+    ObjectMeta,
+    PatternLibrary,
+    PatternLibraryFile,
+    Pod,
+    PodFailureData,
+    Podmortem,
+    Severity,
+    parse_refresh_interval,
+)
+from operator_tpu.schema.crdgen import all_crds, render_all
+from operator_tpu.schema.serde import camel_to_snake, snake_to_camel
+
+
+# --- serde ----------------------------------------------------------------
+
+
+def test_snake_camel_roundtrip():
+    assert snake_to_camel("ai_analysis_enabled") == "aiAnalysisEnabled"
+    assert snake_to_camel("pod_selector") == "podSelector"
+    assert camel_to_snake("aiAnalysisEnabled") == "ai_analysis_enabled"
+
+
+def test_podmortem_parse_and_serialize():
+    data = {
+        "apiVersion": "podmortem.tpu.dev/v1alpha1",
+        "kind": "Podmortem",
+        "metadata": {"name": "pm-1", "namespace": "default", "labels": {"a": "b"}},
+        "spec": {
+            "podSelector": {"matchLabels": {"app": "web"}},
+            "aiProviderRef": {"name": "prov", "namespace": "podmortem-system"},
+            "aiAnalysisEnabled": False,
+        },
+    }
+    pm = Podmortem.parse(data)
+    assert pm.name == "pm-1"
+    assert pm.spec.pod_selector.match_labels == {"app": "web"}
+    assert pm.spec.ai_provider_ref.name == "prov"
+    assert pm.spec.ai_analysis_enabled is False
+
+    out = pm.to_dict()
+    assert out["spec"]["podSelector"]["matchLabels"] == {"app": "web"}
+    assert out["spec"]["aiAnalysisEnabled"] is False
+    # None fields are omitted, as Kubernetes expects
+    assert "status" not in out
+
+
+def test_unknown_keys_ignored_and_defaults_applied():
+    pm = Podmortem.parse({"spec": {"bogusField": 1}, "zzz": {}})
+    assert pm.spec.ai_analysis_enabled is True  # CRD default (podmortem-crd.yaml:50-53)
+    aip = AIProvider.parse({"spec": {"providerId": "tpu-native"}})
+    # defaults mirror reference AIInterfaceClient.java:78-84
+    assert aip.spec.timeout_seconds == 30
+    assert aip.spec.max_retries == 3
+    assert aip.spec.caching_enabled is True
+    assert aip.spec.max_tokens == 500
+    assert abs(aip.spec.temperature - 0.3) < 1e-9
+
+
+def test_str_enum_serializes_to_value():
+    # Severity is a str-enum; to_dict must emit the plain value so the tree
+    # stays YAML/JSON-safe (yaml.safe_dump rejects enum objects).
+    from operator_tpu.schema.serde import to_dict
+
+    result = AnalysisResult(
+        events=[AnalysisEvent(matched_pattern=MatchedPattern(severity=Severity.HIGH))]
+    )
+    out = to_dict(result)
+    sev = out["events"][0]["matchedPattern"]["severity"]
+    assert sev == "HIGH" and type(sev) is str
+    yaml.safe_dump(out)  # must not raise
+
+
+def test_explicit_null_treated_as_unset():
+    # Kubernetes treats `field: null` as unset; defaults must apply.
+    pm = Podmortem.parse({"spec": {"podSelector": None, "aiAnalysisEnabled": None}})
+    assert pm.spec.pod_selector.is_empty()
+    assert pm.spec.ai_analysis_enabled is True
+
+
+def test_event_type_wire_name():
+    from operator_tpu.schema import Event
+
+    ev = Event.parse({"type": "Warning", "reason": "PodFailureDetected"})
+    assert ev.type_ == "Warning"
+    assert ev.to_dict()["type"] == "Warning"
+
+
+# --- label selectors ------------------------------------------------------
+
+
+def test_selector_match_labels():
+    sel = LabelSelector(match_labels={"app": "web"})
+    assert sel.matches({"app": "web", "x": "y"})
+    assert not sel.matches({"app": "db"})
+    assert not sel.matches({})
+
+
+def test_selector_empty_matches_all():
+    assert LabelSelector().matches({"anything": "goes"})
+    assert LabelSelector().matches(None)
+
+
+def test_selector_match_expressions():
+    # The reference ignores matchExpressions (PodFailureWatcher.java:247-265);
+    # we implement the full CRD contract (podmortem-crd.yaml:26-39).
+    sel = LabelSelector(
+        match_expressions=[
+            LabelSelectorRequirement(key="tier", operator="In", values=["web", "api"]),
+            LabelSelectorRequirement(key="canary", operator="DoesNotExist"),
+        ]
+    )
+    assert sel.matches({"tier": "web"})
+    assert not sel.matches({"tier": "db"})
+    assert not sel.matches({"tier": "web", "canary": "true"})
+    sel2 = LabelSelector(match_expressions=[LabelSelectorRequirement(key="x", operator="Exists")])
+    assert sel2.matches({"x": ""})
+    assert not sel2.matches({"y": "1"})
+
+
+# --- severity -------------------------------------------------------------
+
+
+def test_severity_ordering_and_parse():
+    assert Severity.parse("critical") is Severity.CRITICAL
+    assert Severity.parse(None) is Severity.INFO
+    assert Severity.parse("garbage") is Severity.INFO
+    assert Severity.highest([Severity.LOW, Severity.HIGH, Severity.MEDIUM]) is Severity.HIGH
+    assert Severity.CRITICAL.rank > Severity.HIGH.rank > Severity.MEDIUM.rank
+
+
+# --- analysis result ------------------------------------------------------
+
+
+def test_analysis_result_summary_line():
+    result = AnalysisResult(
+        summary=AnalysisSummary(highest_severity="HIGH", significant_events=2, total_events=3),
+        events=[
+            AnalysisEvent(score=0.4, matched_pattern=MatchedPattern(name="oom", severity="HIGH")),
+            AnalysisEvent(score=0.9, matched_pattern=MatchedPattern(name="npe", severity="MEDIUM")),
+        ],
+    )
+    line = result.pattern_summary_line()
+    assert "npe" in line and "HIGH" in line and "0.90" in line
+    assert AnalysisResult().pattern_summary_line().startswith("No known failure patterns")
+
+
+def test_analysis_result_roundtrip():
+    result = AnalysisResult(
+        analysis_id="a1",
+        pod_name="p",
+        events=[AnalysisEvent(score=1.5, matched_pattern=MatchedPattern(name="x", severity="LOW"))],
+    )
+    back = AnalysisResult.parse(result.to_dict())
+    assert back.analysis_id == "a1"
+    assert back.events[0].score == 1.5
+    assert back.events[0].matched_pattern.name == "x"
+
+
+def test_pod_failure_data_roundtrip():
+    pod = Pod(metadata=ObjectMeta(name="web-1", namespace="ns"))
+    data = PodFailureData(pod=pod, logs="line1\nline2")
+    back = PodFailureData.parse(data.to_dict())
+    assert back.pod.metadata.name == "web-1"
+    assert back.logs == "line1\nline2"
+
+
+# --- refresh interval (reference PatternLibraryReconciler.java:282-305) ---
+
+
+def test_parse_refresh_interval():
+    assert parse_refresh_interval("30s") == 30
+    assert parse_refresh_interval("5m") == 300
+    assert parse_refresh_interval("1h") == 3600
+    assert parse_refresh_interval("2d") == 172800
+    assert parse_refresh_interval("1h30m") == 5400
+    assert parse_refresh_interval("90") == 90
+    assert parse_refresh_interval(None) == 3600
+    assert parse_refresh_interval("junk") == 3600
+    assert parse_refresh_interval("") == 3600
+
+
+# --- pattern library file -------------------------------------------------
+
+
+def test_pattern_library_file_parse(tmp_path):
+    doc = {
+        "metadata": {"libraryId": "quarkus", "version": "1.0"},
+        "patterns": [
+            {
+                "id": "port-conflict",
+                "name": "Port already in use",
+                "severity": "HIGH",
+                "primaryPattern": {"regex": r"Port \d+ already in use", "confidence": 0.9},
+                "secondaryPatterns": [
+                    {"regex": r"java\.net\.BindException", "weight": 0.5, "proximityWindow": 10}
+                ],
+                "remediation": {"description": "free the port"},
+            }
+        ],
+    }
+    p = tmp_path / "quarkus.yaml"
+    p.write_text(yaml.safe_dump(doc))
+    lib = PatternLibraryFile.load(p)
+    assert lib.metadata.library_id == "quarkus"
+    pat = lib.patterns[0]
+    assert pat.severity_enum is Severity.HIGH
+    assert pat.primary_pattern.compiled().search("Port 8080 already in use")
+    assert pat.secondary_patterns[0].proximity_window == 10
+    assert "Port already in use" in pat.anchor_text()
+
+
+def test_pattern_library_filename_fallback(tmp_path):
+    p = tmp_path / "mylib.yml"
+    p.write_text(yaml.safe_dump({"patterns": []}))
+    lib = PatternLibraryFile.load(p)
+    assert lib.metadata.library_id == "mylib"
+
+
+# --- CRD generation -------------------------------------------------------
+
+
+def test_crd_generation():
+    crds = all_crds()
+    names = {c["metadata"]["name"] for c in crds}
+    assert names == {
+        "podmortems.podmortem.tpu.dev",
+        "aiproviders.podmortem.tpu.dev",
+        "patternlibraries.podmortem.tpu.dev",
+    }
+    for crd in crds:
+        version = crd["spec"]["versions"][0]
+        assert version["subresources"] == {"status": {}}  # status subresource, all 3 reference CRDs
+        schema = version["schema"]["openAPIV3Schema"]
+        assert "spec" in schema["properties"] and "status" in schema["properties"]
+    # Podmortem spec carries full selector schema incl. matchExpressions
+    pm = next(c for c in crds if c["spec"]["names"]["kind"] == "Podmortem")
+    sel = pm["spec"]["versions"][0]["schema"]["openAPIV3Schema"]["properties"]["spec"][
+        "properties"
+    ]["podSelector"]
+    assert "matchExpressions" in sel["properties"]
+    # round-trips through YAML
+    docs = list(yaml.safe_load_all(render_all()))
+    assert len(docs) == 3
+
+
+def test_aiprovider_crd_defaults():
+    crd = next(c for c in all_crds() if c["spec"]["names"]["kind"] == "AIProvider")
+    props = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]["properties"]["spec"][
+        "properties"
+    ]
+    assert props["timeoutSeconds"]["default"] == 30
+    assert props["maxTokens"]["default"] == 500
+    assert props["temperature"]["default"] == 0.3
