@@ -1,0 +1,21 @@
+"""Pattern-match engine — the in-tree replacement for the reference's
+external log-parser service (SURVEY.md §2.2, §7 stage 2).
+
+CPU path: regex/keyword scoring (`matcher`).  TPU path: embedding similarity
+over pattern anchors (`operator_tpu.patterns.semantic`, added with the
+MiniLM encoder)."""
+
+from .engine import PatternEngine, event_evidence_lines, status_evidence_lines
+from .loader import (
+    LoadedLibrary,
+    available_libraries,
+    builtin_library_path,
+    discover_library_files,
+    load_builtin_library,
+    load_libraries,
+    load_library_file,
+)
+from .matcher import MatcherConfig, match_libraries, match_pattern, summarize
+from .windows import LogWindow, context_window, iter_windows, split_lines, tail_chars
+
+__all__ = [name for name in dir() if not name.startswith("_")]

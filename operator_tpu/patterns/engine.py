@@ -1,0 +1,194 @@
+"""PatternEngine — the analysis facade (the log-parser service's role).
+
+``analyze(PodFailureData) -> AnalysisResult`` is the behavioural equivalent
+of the reference's ``POST /parse`` (LogParserRestClient.java:37-39), run
+in-process.  Evidence beyond the raw log also participates in matching,
+which the reference's operator merely forwarded:
+
+- container termination states (exit code / reason / message,
+  PodFailureWatcher.java:147-159 detects them but never matches on them)
+  become synthetic evidence lines like
+  ``[container-status] app terminated exit code 137 reason=OOMKilled``;
+- Kubernetes event notes collected with the failure
+  (PodFailureWatcher.java:326-332) are matched as
+  ``[k8s-event] Warning BackOff: ...`` lines.
+
+A reload() picks up newly synced pattern libraries; the sync reconciler
+calls it after each git pull.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from ..schema.analysis import AnalysisResult, PodFailureData, StageTimings
+from ..schema.kube import Pod
+from .loader import LoadedLibrary, load_builtin_library, load_libraries
+from .matcher import MatcherConfig, match_libraries
+from .windows import split_lines
+
+log = logging.getLogger(__name__)
+
+
+def status_evidence_lines(pod: Optional[Pod]) -> list[str]:
+    """Synthetic evidence lines derived from the pod's container statuses."""
+    if pod is None or pod.status is None:
+        return []
+    lines: list[str] = []
+    for cs in [*pod.status.container_statuses, *pod.status.init_container_statuses]:
+        for label, state in (("state", cs.state), ("lastState", cs.last_state)):
+            if state is None:
+                continue
+            if state.terminated is not None:
+                t = state.terminated
+                parts = [f"[container-status] {cs.name} terminated"]
+                if t.exit_code is not None:
+                    parts.append(f"exit code {t.exit_code}")
+                if t.reason:
+                    parts.append(f"reason={t.reason}")
+                if t.message:
+                    parts.append(t.message)
+                lines.append(" ".join(parts))
+            if state.waiting is not None and state.waiting.reason:
+                msg = state.waiting.message or ""
+                lines.append(f"[container-status] {cs.name} waiting reason={state.waiting.reason} {msg}".rstrip())
+        if cs.restart_count:
+            lines.append(f"[container-status] {cs.name} restartCount={cs.restart_count}")
+    return lines
+
+
+def event_evidence_lines(failure: PodFailureData) -> list[str]:
+    lines = []
+    for event in failure.events:
+        note = event.note or ""
+        lines.append(f"[k8s-event] {event.type_ or 'Normal'} {event.reason or ''}: {note}".rstrip())
+    return lines
+
+
+class PatternEngine:
+    """Thread-safe holder of loaded libraries + the match entry point.
+
+    The control plane calls :meth:`analyze` per failure and
+    :meth:`reload` after every pattern sync; both may race, hence the lock
+    around the library snapshot (the reference relies on the parser service
+    re-reading the PVC per request — we reload explicitly instead).
+    """
+
+    def __init__(
+        self,
+        cache_dir: Optional[str] = None,
+        *,
+        enabled_libraries: Optional[list[str]] = None,
+        include_builtin: bool = True,
+        config: Optional[MatcherConfig] = None,
+    ) -> None:
+        self.cache_dir = cache_dir
+        self.enabled_libraries = enabled_libraries
+        self.include_builtin = include_builtin
+        self.config = config or MatcherConfig()
+        self._lock = threading.Lock()
+        self._libraries: list[LoadedLibrary] = []
+        self.reload()
+
+    # ------------------------------------------------------------------
+    def reload(self) -> int:
+        """Re-scan the cache dir; returns the number of loaded patterns."""
+        libraries: list[LoadedLibrary] = []
+        if self.cache_dir:
+            libraries.extend(load_libraries(self.cache_dir, self.enabled_libraries))
+        if self.include_builtin:
+            builtin = load_builtin_library()
+            # synced libraries shadow the builtin one by name
+            if all(lib.name != builtin.name for lib in libraries):
+                libraries.append(builtin)
+        with self._lock:
+            self._libraries = libraries
+        total = sum(len(lib.patterns) for lib in libraries)
+        log.info("pattern engine loaded %d libraries / %d patterns", len(libraries), total)
+        return total
+
+    @property
+    def libraries(self) -> list[LoadedLibrary]:
+        with self._lock:
+            return list(self._libraries)
+
+    def library_names(self) -> list[str]:
+        return sorted(lib.name for lib in self.libraries)
+
+    # ------------------------------------------------------------------
+    def analyze(self, failure: PodFailureData) -> AnalysisResult:
+        started = time.perf_counter()
+        lines = split_lines(failure.logs)
+        lines.extend(event_evidence_lines(failure))
+        lines.extend(status_evidence_lines(failure.pod))
+        pod = failure.pod
+        result = match_libraries(
+            self.libraries,
+            lines,
+            self.config,
+            pod_name=pod.metadata.name if pod else None,
+            pod_namespace=pod.metadata.namespace if pod else None,
+        )
+        result.timings = StageTimings(parse_ms=round((time.perf_counter() - started) * 1e3, 3))
+        return result
+
+
+def _main(argv: Optional[list[str]] = None) -> int:
+    """``python -m operator_tpu.patterns.engine [logfile ...]`` — analyze log
+    files (or stdin) against the loaded pattern libraries and print the
+    result as YAML."""
+    import argparse
+    import sys
+
+    import yaml
+
+    parser = argparse.ArgumentParser(
+        prog="operator_tpu.patterns.engine",
+        description="Pattern-match log files against failure-pattern libraries.",
+    )
+    parser.add_argument("logfiles", nargs="*", help="log files (default: stdin)")
+    parser.add_argument("--cache-dir", help="synced pattern-cache directory")
+    parser.add_argument("--no-builtin", action="store_true",
+                        help="skip the built-in kubernetes-common library")
+    parser.add_argument("--top", type=int, default=5, help="show top-K events")
+    args = parser.parse_args(argv)
+
+    engine = PatternEngine(cache_dir=args.cache_dir, include_builtin=not args.no_builtin)
+    sources = args.logfiles or ["-"]
+    exit_code = 0
+    for source in sources:
+        try:
+            logs = sys.stdin.read() if source == "-" else open(source, encoding="utf-8", errors="replace").read()
+        except OSError as exc:
+            print(f"error: cannot read {source}: {exc}", file=sys.stderr)
+            exit_code = 2
+            continue
+        result = engine.analyze(PodFailureData(logs=logs))
+        doc = {
+            "source": source,
+            "summary": result.summary.__dict__,
+            "events": [
+                {
+                    "pattern": e.matched_pattern.id,
+                    "name": e.matched_pattern.name,
+                    "severity": e.matched_pattern.severity,
+                    "score": e.score,
+                    "line": e.context.line_number if e.context else None,
+                    "matched": e.context.matched_line if e.context else None,
+                }
+                for e in result.top_events(args.top)
+            ],
+        }
+        try:
+            print(yaml.safe_dump(doc, sort_keys=False), end="")
+        except BrokenPipeError:
+            sys.stderr.close()
+            return 0
+    return exit_code
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

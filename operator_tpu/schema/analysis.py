@@ -39,7 +39,9 @@ class Severity(str, enum.Enum):
         return _SEVERITY_RANK[self]
 
     @classmethod
-    def parse(cls, value: Optional[str]) -> "Severity":
+    def parse(cls, value) -> "Severity":
+        if isinstance(value, cls):
+            return value
         if value is None:
             return cls.INFO
         try:

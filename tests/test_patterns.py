@@ -1,0 +1,284 @@
+"""Pattern-engine tests: loader robustness, windowing, scoring semantics,
+and the BASELINE config-1 golden path (recorded CrashLoopBackOff log,
+pattern-match only, CPU)."""
+
+import os
+
+import yaml
+
+from operator_tpu.patterns import (
+    MatcherConfig,
+    PatternEngine,
+    available_libraries,
+    iter_windows,
+    load_builtin_library,
+    load_libraries,
+    load_library_file,
+    match_pattern,
+    split_lines,
+    tail_chars,
+)
+from operator_tpu.schema import (
+    ContainerState,
+    ContainerStateTerminated,
+    ContainerStateWaiting,
+    ContainerStatus,
+    Event,
+    ObjectMeta,
+    Pod,
+    PodFailureData,
+    PodStatus,
+    Severity,
+)
+from operator_tpu.schema.patterns import Pattern, PrimaryPattern, SecondaryPattern
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def fixture(name: str) -> str:
+    with open(os.path.join(FIXTURES, name)) as f:
+        return f.read()
+
+
+# --- loader ---------------------------------------------------------------
+
+
+def test_builtin_library_loads_clean():
+    lib = load_builtin_library()
+    assert lib.name == "kubernetes-common"
+    assert len(lib.patterns) >= 15
+    assert lib.skipped == 0
+    ids = {p.id for p in lib.patterns}
+    assert {"oom-killed", "port-conflict", "crashloop-backoff"} <= ids
+
+
+def test_loader_skips_malformed_regex(tmp_path):
+    doc = {
+        "patterns": [
+            {"id": "ok", "name": "ok", "primaryPattern": {"regex": "fine"}},
+            {"id": "bad", "name": "bad", "primaryPattern": {"regex": "([unclosed"}},
+            {"id": "empty", "name": "no primary"},
+            {"id": "badsec", "primaryPattern": {"regex": "x"},
+             "secondaryPatterns": [{"regex": "(((", "weight": 0.2}]},
+        ]
+    }
+    p = tmp_path / "lib.yaml"
+    p.write_text(yaml.safe_dump(doc))
+    lib = load_library_file(p)
+    assert [pat.id for pat in lib.patterns] == ["ok"]
+    assert lib.skipped == 3
+
+
+def test_loader_handles_garbage_yaml(tmp_path):
+    (tmp_path / "junk.yaml").write_text(":::: not yaml {{{")
+    lib = load_library_file(tmp_path / "junk.yaml")
+    assert lib.patterns == []
+
+
+def test_discover_and_enabled_filter(tmp_path):
+    # layout mirrors the sync contract: <cache>/<library>/<repo>/<file>.yaml
+    d = tmp_path / "libA" / "repo1"
+    d.mkdir(parents=True)
+    (d / "java.yaml").write_text(yaml.safe_dump(
+        {"patterns": [{"id": "a", "primaryPattern": {"regex": "A"}}]}))
+    (d / "python.yml").write_text(yaml.safe_dump(
+        {"patterns": [{"id": "b", "primaryPattern": {"regex": "B"}}]}))
+    (d / "notes.txt").write_text("ignored")
+    assert available_libraries(tmp_path) == ["java", "python"]
+    libs = load_libraries(tmp_path, enabled=["python"])
+    assert [l.name for l in libs] == ["python"]
+
+
+def test_enabled_filter_matches_declared_library_id(tmp_path):
+    # a file whose stem differs from its declared libraryId must be
+    # selectable by either name
+    d = tmp_path / "lib" / "repo"
+    d.mkdir(parents=True)
+    (d / "patterns.yaml").write_text(yaml.safe_dump({
+        "metadata": {"libraryId": "quarkus-patterns"},
+        "patterns": [{"id": "q", "primaryPattern": {"regex": "Q"}}],
+    }))
+    assert available_libraries(tmp_path) == ["quarkus-patterns"]
+    assert [l.name for l in load_libraries(tmp_path, enabled=["quarkus-patterns"])] == ["quarkus-patterns"]
+    assert [l.name for l in load_libraries(tmp_path, enabled=["patterns"])] == ["quarkus-patterns"]
+    assert load_libraries(tmp_path, enabled=["other"]) == []
+
+
+def test_matcher_config_zero_caps():
+    pat = Pattern(id="p", primary_pattern=PrimaryPattern(regex="X"))
+    assert match_pattern(pat, ["X"] * 5, MatcherConfig(max_events_per_pattern=0)) == []
+
+
+def test_severity_parse_accepts_enum():
+    assert Severity.parse(Severity.HIGH) is Severity.HIGH
+
+
+def test_summary_counts_before_truncation():
+    from operator_tpu.patterns.loader import LoadedLibrary
+    from operator_tpu.patterns import match_libraries as ml
+    pats = [Pattern(id=f"p{i}", severity="LOW",
+                    primary_pattern=PrimaryPattern(regex=f"M{i:02d}", confidence=0.9))
+            for i in range(30)]
+    lib = LoadedLibrary(name="big", patterns=pats)
+    # every pattern fires 3 times -> 90 events total, truncated to 50
+    lines = [f"M{i:02d}" for i in range(30)] * 3
+    res = ml([lib], lines, MatcherConfig(max_total_events=50))
+    assert res.summary.total_events == 90
+    assert res.summary.significant_events == 90
+    assert len(res.events) == 50
+
+
+# --- windows --------------------------------------------------------------
+
+
+def test_split_lines_caps_at_tail():
+    logs = "\n".join(f"line{i}" for i in range(100))
+    lines = split_lines(logs, max_lines=10)
+    assert lines == [f"line{i}" for i in range(90, 100)]
+    assert split_lines(None) == []
+
+
+def test_iter_windows_overlap_and_coverage():
+    lines = [f"l{i}" for i in range(40)]
+    wins = list(iter_windows(lines, window_lines=16, stride=8))
+    assert wins[0].start == 0 and wins[0].stop == 16
+    assert wins[1].start == 8
+    assert wins[-1].stop == 40
+    # every line covered
+    covered = set()
+    for w in wins:
+        covered.update(range(w.start, w.stop))
+    assert covered == set(range(40))
+
+
+def test_tail_chars_line_boundary():
+    logs = "short\n" + "x" * 50 + "\nfinal line"
+    tail = tail_chars(logs, limit=20)
+    assert tail == "final line"
+    assert tail_chars("abc", 100) == "abc"
+
+
+# --- matcher scoring ------------------------------------------------------
+
+
+def test_secondary_proximity_scoring():
+    pat = Pattern(
+        id="p",
+        name="p",
+        severity="HIGH",
+        primary_pattern=PrimaryPattern(regex="PRIMARY", confidence=0.6),
+        secondary_patterns=[
+            SecondaryPattern(regex="NEAR", weight=0.3, proximity_window=2),
+            SecondaryPattern(regex="FAR", weight=0.5, proximity_window=2),
+        ],
+    )
+    lines = ["FAR", "x", "x", "NEAR", "PRIMARY", "x", "x", "x", "x"]
+    events = match_pattern(pat, lines)
+    assert len(events) == 1
+    # NEAR within window (+0.3); FAR 4 lines away, outside window of 2
+    assert abs(events[0].score - 0.9) < 1e-6
+    ctx = events[0].context
+    assert ctx.matched_line == "PRIMARY"
+    assert ctx.line_number == 4
+
+
+def test_keyword_primary_and_event_cap():
+    pat = Pattern(
+        id="kw",
+        primary_pattern=PrimaryPattern(keywords=["alpha", "beta"], confidence=0.5),
+    )
+    lines = ["alpha beta"] * 10 + ["only alpha here"]
+    events = match_pattern(pat, lines)
+    assert len(events) == MatcherConfig().max_events_per_pattern
+    # newest hits kept
+    assert events[-1].context.line_number == 9
+
+
+# --- engine end-to-end (BASELINE config 1) --------------------------------
+
+
+def make_failed_pod(exit_code=1, reason=None, waiting=None, restarts=3):
+    return Pod(
+        metadata=ObjectMeta(name="payment-7f9c", namespace="prod", labels={"app": "payment"}),
+        status=PodStatus(
+            phase="Running",
+            container_statuses=[
+                ContainerStatus(
+                    name="app",
+                    restart_count=restarts,
+                    state=ContainerState(
+                        waiting=ContainerStateWaiting(reason=waiting) if waiting else None,
+                        terminated=None if waiting else ContainerStateTerminated(
+                            exit_code=exit_code, reason=reason, finished_at="2026-07-28T09:14:03Z"
+                        ),
+                    ),
+                )
+            ],
+        ),
+    )
+
+
+def test_engine_crashloop_golden():
+    engine = PatternEngine()
+    failure = PodFailureData(
+        pod=make_failed_pod(exit_code=1, waiting="CrashLoopBackOff"),
+        logs=fixture("crashloop_quarkus.log"),
+        events=[Event(type_="Warning", reason="BackOff",
+                      note="Back-off restarting failed container app in pod payment-7f9c")],
+    )
+    result = engine.analyze(failure)
+    assert result.pod_name == "payment-7f9c"
+    assert result.summary.significant_events >= 2
+    top = result.top_events(1)[0]
+    # the port conflict is the root cause and must outrank generic patterns:
+    # primary 0.9 + BindException 0.5 + "failed to start" 0.2
+    assert top.matched_pattern.id == "port-conflict"
+    assert abs(top.score - 1.6) < 1e-6
+    assert result.summary.highest_severity == "HIGH"
+    matched_ids = {e.matched_pattern.id for e in result.events}
+    assert "crashloop-backoff" in matched_ids  # from waiting reason + k8s event
+    assert result.timings.parse_ms is not None
+    line = result.pattern_summary_line()
+    assert "port" in line.lower() and "HIGH" in line
+
+
+def test_engine_oom_golden():
+    engine = PatternEngine()
+    failure = PodFailureData(
+        pod=make_failed_pod(exit_code=137, reason="OOMKilled"),
+        logs=fixture("oom_java.log"),
+    )
+    result = engine.analyze(failure)
+    ids = {e.matched_pattern.id for e in result.events}
+    assert "java-heap-oom" in ids
+    assert "oom-killed" in ids  # fires on the synthetic container-status line
+    assert result.summary.highest_severity == "CRITICAL"
+
+
+def test_engine_clean_log_no_matches():
+    engine = PatternEngine()
+    ok_pod = Pod(metadata=ObjectMeta(name="ok", namespace="ns"), status=PodStatus())
+    result = engine.analyze(PodFailureData(pod=ok_pod, logs="all good\nstartup complete\n"))
+    assert result.events == []
+    assert result.summary.total_events == 0
+    assert result.pattern_summary_line().startswith("No known failure patterns")
+
+
+def test_engine_reload_picks_up_synced_library(tmp_path):
+    engine = PatternEngine(cache_dir=str(tmp_path))
+    assert "kubernetes-common" in engine.library_names()
+    d = tmp_path / "mylib" / "repo"
+    d.mkdir(parents=True)
+    (d / "custom.yaml").write_text(yaml.safe_dump({
+        "patterns": [{
+            "id": "custom-marker",
+            "name": "Custom marker",
+            "severity": "CRITICAL",
+            "primaryPattern": {"regex": "MAGIC_MARKER_42", "confidence": 1.0},
+        }]
+    }))
+    engine.reload()
+    assert "custom" in engine.library_names()
+    result = engine.analyze(PodFailureData(logs="x\nMAGIC_MARKER_42 happened\n"))
+    assert result.events[0].matched_pattern.id == "custom-marker"
+    assert result.events[0].severity is Severity.CRITICAL
