@@ -1,0 +1,33 @@
+"""The asyncio control plane (SURVEY.md §7 stage 5): watch loop, reconcilers,
+event emission, durable storage, git pattern sync, health — the operator half
+of the reference, rebuilt around one shared analysis pipeline."""
+
+from .app import Operator
+from .events import EventService, truncate_message
+from .health import LivenessCheck, ReadinessCheck
+from .kubeapi import (
+    ApiError,
+    ConflictError,
+    FakeKubeApi,
+    ForbiddenError,
+    KubeApi,
+    NotFoundError,
+    WatchClosed,
+    WatchEvent,
+)
+from .patternsync import GitSyncService, PatternLibraryReconciler, SyncOutcome
+from .pipeline import AnalysisPipeline
+from .providers import (
+    OpenAICompatProvider,
+    ProviderError,
+    ProviderRegistry,
+    ResponseCache,
+    TemplateProvider,
+    default_registry,
+    resolve_provider_config,
+)
+from .reconciler import AIProviderReconciler, PodmortemReconciler
+from .storage import AnalysisStorageService
+from .watcher import PodFailureWatcher, PodmortemCache, get_failure_time, has_pod_failed
+
+__all__ = [name for name in dir() if not name.startswith("_")]

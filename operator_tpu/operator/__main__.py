@@ -1,0 +1,6 @@
+"""CLI entry: ``python -m operator_tpu.operator --demo``."""
+
+from .app import _main
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

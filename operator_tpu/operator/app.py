@@ -1,0 +1,245 @@
+"""Operator application wiring + demo harness.
+
+``Operator`` composes the control plane: pattern engine, analysis pipeline,
+pod-failure watcher, the three reconcilers, and health checks, all over one
+``KubeApi``.  The startup sequence is the reference's (SURVEY.md §3.1):
+reconcilers register, the pod watcher starts, readiness gates on pattern
+availability.
+
+``python -m operator_tpu.operator --demo`` runs the whole control plane
+against the in-memory fake apiserver, injects a CrashLoopBackOff failure,
+and prints the emitted events, annotations, and CR status — the end-to-end
+slice of BASELINE configs 1+2 without a cluster.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from ..patterns.engine import PatternEngine
+from ..utils.config import OperatorConfig
+from ..utils.timing import METRICS, MetricsRegistry
+from .events import EventService
+from .health import LivenessCheck, ReadinessCheck
+from .kubeapi import FakeKubeApi, KubeApi
+from .patternsync import GitSyncService, PatternLibraryReconciler
+from .pipeline import AnalysisPipeline
+from .providers import ProviderRegistry, default_registry
+from .reconciler import AIProviderReconciler, PodmortemReconciler
+from .storage import AnalysisStorageService
+from .watcher import PodFailureWatcher, PodmortemCache
+
+log = logging.getLogger(__name__)
+
+
+class Operator:
+    def __init__(
+        self,
+        api: KubeApi,
+        *,
+        config: Optional[OperatorConfig] = None,
+        providers: Optional[ProviderRegistry] = None,
+        metrics: Optional[MetricsRegistry] = None,
+    ) -> None:
+        self.api = api
+        self.config = config or OperatorConfig()
+        self.metrics = metrics or METRICS
+        self.providers = providers or default_registry()
+        self._register_tpu_provider()
+        self.engine = PatternEngine(cache_dir=self.config.pattern_cache_directory)
+        self.events = EventService(api, self.config)
+        self.storage = AnalysisStorageService(api, self.config)
+        self.pipeline = AnalysisPipeline(
+            api,
+            self.engine,
+            config=self.config,
+            events=self.events,
+            storage=self.storage,
+            providers=self.providers,
+            metrics=self.metrics,
+        )
+        self.cr_cache = PodmortemCache(api)
+        self.watcher = PodFailureWatcher(
+            api, self.pipeline, config=self.config, metrics=self.metrics, cache=self.cr_cache
+        )
+        self.podmortem_reconciler = PodmortemReconciler(
+            api, self.pipeline, config=self.config, metrics=self.metrics
+        )
+        self.aiprovider_reconciler = AIProviderReconciler(
+            api, providers=self.providers, config=self.config
+        )
+        self.pattern_reconciler = PatternLibraryReconciler(
+            api, GitSyncService(self.config), engine=self.engine, config=self.config
+        )
+        self.readiness = ReadinessCheck(api, self.config)
+        self.liveness = LivenessCheck()
+        self._stop = asyncio.Event()
+        self._tasks: list[asyncio.Task] = []
+
+    def _register_tpu_provider(self) -> None:
+        """Lazily wire the tpu-native serving backend; on hosts without jax
+        the factory raises at first use and the pipeline degrades to
+        pattern-only results (never at operator startup)."""
+
+        def factory():
+            from ..serving.backend import TpuNativeProvider
+
+            return TpuNativeProvider(self.config)
+
+        self.providers.register_factory("tpu-native", factory)
+
+    # ------------------------------------------------------------------
+    async def start(self) -> None:
+        log.info("operator starting (namespaces: %s)",
+                 self.config.watch_namespaces or "ALL")
+        self._stop.clear()
+        self._tasks = [
+            asyncio.create_task(self.watcher.run(self._stop), name="pod-watcher"),
+            asyncio.create_task(self.podmortem_reconciler.run(self._stop), name="podmortem-reconciler"),
+            asyncio.create_task(self.aiprovider_reconciler.run(self._stop), name="aiprovider-reconciler"),
+            asyncio.create_task(self.pattern_reconciler.run(self._stop), name="patternlibrary-reconciler"),
+        ]
+
+    async def stop(self) -> None:
+        self._stop.set()
+        await self.watcher.drain()
+        for task in self._tasks:
+            task.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks = []
+        log.info("operator stopped")
+
+    async def run_forever(self) -> None:
+        await self.start()
+        try:
+            await asyncio.gather(*self._tasks)
+        finally:
+            await self.stop()
+
+
+# --------------------------------------------------------------------------
+# demo harness
+# --------------------------------------------------------------------------
+
+
+async def run_demo(logfile: Optional[str] = None, provider_id: str = "template") -> dict:
+    """Full control-plane pass over the fake apiserver; returns a summary
+    dict (also printed by the CLI)."""
+    import os
+
+    from ..schema import (
+        AIProvider,
+        AIProviderRef,
+        AIProviderSpec,
+        ContainerState,
+        ContainerStateTerminated,
+        ContainerStateWaiting,
+        ContainerStatus,
+        LabelSelector,
+        ObjectMeta,
+        Pod,
+        PodmortemSpec,
+        PodStatus,
+    )
+    from ..schema.crds import Podmortem
+
+    api = FakeKubeApi()
+    config = OperatorConfig(pattern_cache_directory="/nonexistent-demo-cache")
+    operator = Operator(api, config=config)
+
+    # user objects: one AIProvider + one Podmortem watching app=payment
+    await api.create_obj(AIProvider(
+        metadata=ObjectMeta(name="demo-provider", namespace="podmortem-system"),
+        spec=AIProviderSpec(provider_id=provider_id, model_id="demo-model"),
+    ))
+    await api.create_obj(Podmortem(
+        metadata=ObjectMeta(name="watch-payment", namespace="podmortem-system"),
+        spec=PodmortemSpec(
+            pod_selector=LabelSelector(match_labels={"app": "payment"}),
+            ai_provider_ref=AIProviderRef(name="demo-provider", namespace="podmortem-system"),
+            ai_analysis_enabled=True,
+        ),
+    ))
+
+    await operator.start()
+    await asyncio.sleep(0.05)  # let watches register + caches prime
+
+    # the failing pod
+    if logfile is None:
+        logfile = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+            "tests", "fixtures", "crashloop_quarkus.log",
+        )
+    with open(logfile, encoding="utf-8", errors="replace") as f:
+        crash_log = f.read()
+    pod = Pod(
+        metadata=ObjectMeta(name="payment-7f9c", namespace="prod", labels={"app": "payment"}),
+        status=PodStatus(phase="Running", container_statuses=[ContainerStatus(
+            name="app", restart_count=3,
+            state=ContainerState(waiting=ContainerStateWaiting(reason="CrashLoopBackOff")),
+            last_state=ContainerState(terminated=ContainerStateTerminated(
+                exit_code=1, finished_at="2026-07-28T09:14:03Z")),
+        )]),
+    )
+    api.set_pod_log("prod", "payment-7f9c", crash_log, previous=True)
+    await api.create_obj(pod)
+    # the watcher reacts to MODIFIED (reference :107); poke the pod
+    await api.patch("Pod", "payment-7f9c", "prod", {"metadata": {"labels": {"poked": "1"}}})
+
+    await asyncio.sleep(0.1)
+    await operator.watcher.drain()
+
+    events = await api.list("Event")
+    stored_pod = await api.get("Pod", "payment-7f9c", "prod")
+    podmortem = await api.get("Podmortem", "watch-payment", "podmortem-system")
+    readiness = await operator.readiness.check()
+    await operator.stop()
+
+    return {
+        "events": [
+            {"reason": e.get("reason"), "type": e.get("type"),
+             "target": f"{e.get('regarding', {}).get('kind')}/{e.get('regarding', {}).get('name')}",
+             "note": (e.get("note") or "")[:160]}
+            for e in events
+        ],
+        "pod_annotations": stored_pod.get("metadata", {}).get("annotations", {}),
+        "podmortem_status": podmortem.get("status", {}),
+        "ready": readiness.ready,
+        "metrics": operator.metrics.snapshot(),
+    }
+
+
+def _main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+    import json
+    import sys
+
+    parser = argparse.ArgumentParser(prog="operator_tpu.operator")
+    parser.add_argument("--demo", action="store_true",
+                        help="run the control plane against the in-memory fake apiserver")
+    parser.add_argument("--logfile", help="log file for the demo failure pod")
+    parser.add_argument("--provider", default="template",
+                        help="providerId for the demo AIProvider (template|tpu-native)")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO,
+                        format="%(asctime)s %(levelname)-7s %(name)s: %(message)s")
+    if not args.demo:
+        parser.error("only --demo mode is available without a cluster "
+                     "(in-cluster mode arrives with operator_tpu.operator.httpapi)")
+    try:
+        summary = asyncio.run(run_demo(args.logfile, args.provider))
+    except OSError as exc:
+        print(f"error: cannot read demo log file: {exc}", file=sys.stderr)
+        return 2
+    try:
+        print(json.dumps(summary, indent=2))
+    except BrokenPipeError:
+        sys.stderr.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

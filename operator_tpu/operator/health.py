@@ -1,0 +1,66 @@
+"""Health checks.
+
+Readiness parity with reference PatternLibraryReadinessCheck
+(health/PatternLibraryReadinessCheck.java:22-86): ready when no
+PatternLibrary CRs exist; otherwise require at least one pattern YAML in the
+cache; after a 5-minute startup grace period report ready regardless (so a
+broken Git remote can't keep the operator out of rotation forever).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from ..patterns.loader import discover_library_files
+from ..utils.config import OperatorConfig
+from .kubeapi import ApiError, KubeApi
+
+STARTUP_GRACE_S = 300.0  # reference :26 (5 minutes)
+
+
+@dataclass
+class HealthStatus:
+    ready: bool
+    reason: str
+
+
+class ReadinessCheck:
+    def __init__(
+        self,
+        api: KubeApi,
+        config: Optional[OperatorConfig] = None,
+        *,
+        started_at: Optional[float] = None,
+    ) -> None:
+        self.api = api
+        self.config = config or OperatorConfig()
+        self.started_at = time.monotonic() if started_at is None else started_at
+
+    def _in_grace(self) -> bool:
+        return (time.monotonic() - self.started_at) > STARTUP_GRACE_S
+
+    async def check(self) -> HealthStatus:
+        try:
+            libraries = await self.api.list("PatternLibrary")
+        except ApiError as exc:
+            # can't even list CRs: not ready unless grace elapsed
+            if self._in_grace():
+                return HealthStatus(True, f"degraded (list failed: {exc}) but grace elapsed")
+            return HealthStatus(False, f"cannot list PatternLibrary CRs: {exc}")
+        if not libraries:
+            return HealthStatus(True, "no PatternLibrary CRs configured")  # reference :38-41
+        files = discover_library_files(self.config.pattern_cache_directory)
+        if files:
+            return HealthStatus(True, f"{len(files)} pattern file(s) cached")
+        if self._in_grace():
+            return HealthStatus(True, "no patterns cached but startup grace elapsed")  # :72-76
+        return HealthStatus(False, "PatternLibrary CRs exist but no patterns cached yet")
+
+
+class LivenessCheck:
+    """Alive as long as the event loop answers."""
+
+    async def check(self) -> HealthStatus:
+        return HealthStatus(True, "alive")

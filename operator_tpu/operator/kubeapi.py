@@ -1,0 +1,362 @@
+"""Kubernetes API abstraction + in-memory fake apiserver.
+
+The control plane talks to this interface only; production uses the HTTP
+client (``operator_tpu.operator.httpapi``), tests use :class:`FakeKubeApi` —
+the fabric8-mock-server role the reference's intended-but-never-landed test
+strategy called for (SURVEY.md §4).
+
+The fake reproduces the apiserver behaviours the operator's correctness
+depends on:
+
+- **optimistic concurrency**: a patch carrying a stale ``resourceVersion``
+  fails with 409, exactly what AnalysisStorageService's retry discipline is
+  built against (reference AnalysisStorageService.java:179-187);
+- **watch streams** per kind/namespace with ADDED/MODIFIED/DELETED events and
+  server-side close (so watcher auto-restart logic is testable —
+  reference PodFailureWatcher.java:127-135);
+- **label-selector list filtering** (reference PodmortemReconciler.java:105-111);
+- **error injection hooks** for 409 storms, 403s, and transient faults.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import logging
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Optional
+
+from ..schema.meta import LabelSelector, now_iso
+
+log = logging.getLogger(__name__)
+
+
+class ApiError(Exception):
+    status = 500
+
+    def __init__(self, message: str, status: Optional[int] = None) -> None:
+        super().__init__(message)
+        if status is not None:
+            self.status = status
+
+
+class NotFoundError(ApiError):
+    status = 404
+
+
+class ConflictError(ApiError):
+    status = 409
+
+
+class ForbiddenError(ApiError):
+    status = 403
+
+
+class WatchClosed(Exception):
+    """The server closed the watch stream; callers should re-establish
+    (the reference restarts its watch 5s after an error close —
+    PodFailureWatcher.java:562-583)."""
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    object: dict[str, Any]
+
+
+# --------------------------------------------------------------------------
+# interface
+# --------------------------------------------------------------------------
+
+
+class KubeApi:
+    """Async Kubernetes API surface used by the control plane.  All objects
+    are plain camelCase dicts (parse into schema types at the edges)."""
+
+    async def get(self, kind: str, name: str, namespace: str) -> dict:
+        raise NotImplementedError
+
+    async def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[LabelSelector] = None,
+    ) -> list[dict]:
+        raise NotImplementedError
+
+    async def create(self, kind: str, obj: dict) -> dict:
+        raise NotImplementedError
+
+    async def patch(
+        self,
+        kind: str,
+        name: str,
+        namespace: str,
+        patch: dict,
+        *,
+        resource_version: Optional[str] = None,
+    ) -> dict:
+        raise NotImplementedError
+
+    async def patch_status(
+        self,
+        kind: str,
+        name: str,
+        namespace: str,
+        status: dict,
+        *,
+        resource_version: Optional[str] = None,
+    ) -> dict:
+        raise NotImplementedError
+
+    async def delete(self, kind: str, name: str, namespace: str) -> None:
+        raise NotImplementedError
+
+    async def get_log(
+        self,
+        name: str,
+        namespace: str,
+        *,
+        container: Optional[str] = None,
+        previous: bool = False,
+        tail_bytes: Optional[int] = None,
+    ) -> str:
+        raise NotImplementedError
+
+    def watch(
+        self, kind: str, namespace: Optional[str] = None
+    ) -> AsyncIterator[WatchEvent]:
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------------------
+# fake implementation
+# --------------------------------------------------------------------------
+
+
+def _deep_merge(base: dict, patch: dict) -> dict:
+    """JSON-merge-patch semantics: dicts merge recursively, ``None`` deletes,
+    everything else (lists included) replaces."""
+    out = dict(base)
+    for key, value in patch.items():
+        if value is None:
+            out.pop(key, None)
+        elif isinstance(value, dict) and isinstance(out.get(key), dict):
+            out[key] = _deep_merge(out[key], value)
+        else:
+            out[key] = copy.deepcopy(value)
+    return out
+
+
+@dataclass
+class _WatchRegistration:
+    kind: str
+    namespace: Optional[str]
+    queue: asyncio.Queue = field(default_factory=asyncio.Queue)
+
+
+#: error-injection hook: (op, kind, name) -> Exception to raise, or None
+ErrorHook = Callable[[str, str, str], Optional[Exception]]
+
+
+class FakeKubeApi(KubeApi):
+    def __init__(self) -> None:
+        self._objects: dict[str, dict[tuple[str, str], dict]] = {}
+        self._logs: dict[tuple[str, str, bool], str] = {}
+        self._rv = 0
+        self._watches: list[_WatchRegistration] = []
+        self.error_hooks: list[ErrorHook] = []
+
+    # --- error injection --------------------------------------------------
+    def inject_errors(self, op: str, error_factory: Callable[[], Exception], times: int = 1) -> None:
+        """Raise ``error_factory()`` for the next ``times`` calls of ``op``
+        (op is 'get'/'list'/'create'/'patch'/'patch_status'/'delete'/'get_log')."""
+        remaining = {"n": times}
+
+        def hook(actual_op: str, kind: str, name: str) -> Optional[Exception]:
+            if actual_op == op and remaining["n"] > 0:
+                remaining["n"] -= 1
+                return error_factory()
+            return None
+
+        self.error_hooks.append(hook)
+
+    def inject_conflicts(self, times: int, op: str = "patch_status") -> None:
+        self.inject_errors(op, lambda: ConflictError("the object has been modified"), times)
+
+    def _check_hooks(self, op: str, kind: str, name: str) -> None:
+        for hook in self.error_hooks:
+            exc = hook(op, kind, name)
+            if exc is not None:
+                raise exc
+
+    # --- store helpers ----------------------------------------------------
+    def _bucket(self, kind: str) -> dict[tuple[str, str], dict]:
+        return self._objects.setdefault(kind, {})
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _notify(self, event_type: str, kind: str, obj: dict) -> None:
+        namespace = obj.get("metadata", {}).get("namespace")
+        for registration in list(self._watches):
+            if registration.kind != kind:
+                continue
+            if registration.namespace is not None and registration.namespace != namespace:
+                continue
+            registration.queue.put_nowait(WatchEvent(event_type, copy.deepcopy(obj)))
+
+    # --- KubeApi ----------------------------------------------------------
+    async def get(self, kind: str, name: str, namespace: str) -> dict:
+        self._check_hooks("get", kind, name)
+        obj = self._bucket(kind).get((namespace, name))
+        if obj is None:
+            raise NotFoundError(f"{kind} {namespace}/{name} not found")
+        return copy.deepcopy(obj)
+
+    async def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[LabelSelector] = None,
+    ) -> list[dict]:
+        self._check_hooks("list", kind, "*")
+        out = []
+        for (ns, _), obj in sorted(self._bucket(kind).items()):
+            if namespace is not None and ns != namespace:
+                continue
+            if label_selector is not None and not label_selector.matches(
+                obj.get("metadata", {}).get("labels") or {}
+            ):
+                continue
+            out.append(copy.deepcopy(obj))
+        return out
+
+    async def create(self, kind: str, obj: dict) -> dict:
+        meta = obj.setdefault("metadata", {})
+        name, namespace = meta.get("name"), meta.get("namespace")
+        if not name or not namespace:
+            raise ApiError(f"{kind} requires metadata.name and metadata.namespace", 422)
+        self._check_hooks("create", kind, name)
+        bucket = self._bucket(kind)
+        if (namespace, name) in bucket:
+            raise ConflictError(f"{kind} {namespace}/{name} already exists")
+        stored = copy.deepcopy(obj)
+        stored["metadata"].setdefault("uid", str(uuid.uuid4()))
+        stored["metadata"].setdefault("creationTimestamp", now_iso())
+        stored["metadata"]["resourceVersion"] = self._next_rv()
+        bucket[(namespace, name)] = stored
+        self._notify("ADDED", kind, stored)
+        return copy.deepcopy(stored)
+
+    async def _patch_impl(
+        self,
+        op: str,
+        kind: str,
+        name: str,
+        namespace: str,
+        patch: dict,
+        resource_version: Optional[str],
+    ) -> dict:
+        self._check_hooks(op, kind, name)
+        bucket = self._bucket(kind)
+        current = bucket.get((namespace, name))
+        if current is None:
+            raise NotFoundError(f"{kind} {namespace}/{name} not found")
+        if resource_version is not None and current["metadata"].get("resourceVersion") != resource_version:
+            raise ConflictError(
+                f"Operation cannot be fulfilled on {kind} {namespace}/{name}: "
+                f"the object has been modified"
+            )
+        merged = _deep_merge(current, patch)
+        merged["metadata"]["resourceVersion"] = self._next_rv()
+        bucket[(namespace, name)] = merged
+        self._notify("MODIFIED", kind, merged)
+        return copy.deepcopy(merged)
+
+    async def patch(
+        self,
+        kind: str,
+        name: str,
+        namespace: str,
+        patch: dict,
+        *,
+        resource_version: Optional[str] = None,
+    ) -> dict:
+        return await self._patch_impl("patch", kind, name, namespace, patch, resource_version)
+
+    async def patch_status(
+        self,
+        kind: str,
+        name: str,
+        namespace: str,
+        status: dict,
+        *,
+        resource_version: Optional[str] = None,
+    ) -> dict:
+        return await self._patch_impl(
+            "patch_status", kind, name, namespace, {"status": status}, resource_version
+        )
+
+    async def delete(self, kind: str, name: str, namespace: str) -> None:
+        self._check_hooks("delete", kind, name)
+        bucket = self._bucket(kind)
+        obj = bucket.pop((namespace, name), None)
+        if obj is None:
+            raise NotFoundError(f"{kind} {namespace}/{name} not found")
+        self._notify("DELETED", kind, obj)
+
+    # --- pod logs ---------------------------------------------------------
+    def set_pod_log(self, namespace: str, name: str, text: str, *, previous: bool = False) -> None:
+        self._logs[(namespace, name, previous)] = text
+
+    async def get_log(
+        self,
+        name: str,
+        namespace: str,
+        *,
+        container: Optional[str] = None,
+        previous: bool = False,
+        tail_bytes: Optional[int] = None,
+    ) -> str:
+        self._check_hooks("get_log", "Pod", name)
+        text = self._logs.get((namespace, name, previous))
+        if text is None and previous:
+            text = self._logs.get((namespace, name, False))
+        if text is None:
+            if (namespace, name) not in self._bucket("Pod"):
+                raise NotFoundError(f"Pod {namespace}/{name} not found")
+            return ""
+        if tail_bytes is not None and len(text) > tail_bytes:
+            text = text[-tail_bytes:]
+        return text
+
+    # --- watch ------------------------------------------------------------
+    async def watch(  # type: ignore[override]
+        self, kind: str, namespace: Optional[str] = None
+    ) -> AsyncIterator[WatchEvent]:
+        registration = _WatchRegistration(kind=kind, namespace=namespace)
+        self._watches.append(registration)
+        try:
+            while True:
+                event = await registration.queue.get()
+                if isinstance(event, Exception):
+                    raise WatchClosed(str(event)) from event
+                yield event
+        finally:
+            if registration in self._watches:
+                self._watches.remove(registration)
+
+    def close_watches(self, error: str = "server closed the watch") -> int:
+        """Simulate the apiserver dropping all watch streams."""
+        closed = 0
+        for registration in list(self._watches):
+            registration.queue.put_nowait(RuntimeError(error))
+            closed += 1
+        return closed
+
+    # --- typed convenience (tests) ---------------------------------------
+    async def create_obj(self, obj: Any) -> dict:
+        return await self.create(obj.kind, obj.to_dict())

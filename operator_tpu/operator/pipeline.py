@@ -1,0 +1,299 @@
+"""The failure-analysis pipeline: collect -> parse -> explain -> store -> emit.
+
+One implementation shared by the real-time watcher and the poll-path
+reconciler — the consolidation SURVEY.md §3.3 calls out (the reference
+duplicates ~200 LoC between PodFailureWatcher and PodmortemReconciler, and
+the reconcile path never stores results; here both paths store).
+
+Graceful degradation mirrors the reference (SURVEY.md §5 failure-detection
+entry): parse failure => error event + status; AI failure => pattern-only
+result still stored; provider missing => stored without AI.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+from ..patterns.engine import PatternEngine
+from ..schema.analysis import AIResponse, AnalysisRequest, AnalysisResult, PodFailureData
+from ..schema.crds import AIProvider, Podmortem
+from ..schema.kube import Event as KubeEvent
+from ..schema.kube import Pod
+from ..schema.meta import now_iso
+from ..utils.config import OperatorConfig
+from ..utils.timing import METRICS, MetricsRegistry
+from .events import EventService
+from .kubeapi import ApiError, KubeApi, NotFoundError
+from .providers import (
+    ProviderError,
+    ProviderRegistry,
+    ResponseCache,
+    default_registry,
+    resolve_provider_config,
+)
+from .storage import AnalysisStorageService
+
+log = logging.getLogger(__name__)
+
+
+class FailureDedupe:
+    """Shared dedupe of (pod, failureTime) across the watcher and the
+    poll-path reconciler — one analysis per distinct failure, like the
+    reference's ``processedFailures`` map (PodFailureWatcher.java:50,180-193)
+    but (a) shared by both detection paths, (b) bounded, and (c) aware of
+    in-flight vs done so a *failed* analysis can be retried."""
+
+    _IN_FLIGHT = "in-flight"
+    _DONE = "done"
+
+    def __init__(self, max_entries: int = 10_000) -> None:
+        from collections import OrderedDict
+
+        self._states: "OrderedDict[str, str]" = OrderedDict()
+        self._max = max_entries
+
+    @staticmethod
+    def key(pod: Pod, failure_time: str) -> str:
+        return f"{pod.metadata.namespace}/{pod.metadata.name}@{failure_time}"
+
+    def try_claim(self, key: str) -> bool:
+        """Claim the failure for processing; False if already in flight or done."""
+        if key in self._states:
+            self._states.move_to_end(key)
+            return False
+        self._states[key] = self._IN_FLIGHT
+        while len(self._states) > self._max:
+            self._states.popitem(last=False)
+        return True
+
+    def mark_done(self, key: str) -> None:
+        self._states[key] = self._DONE
+
+    def release(self, key: str) -> None:
+        """Forget a failed attempt so either path may retry it."""
+        self._states.pop(key, None)
+
+
+class AnalysisPipeline:
+    def __init__(
+        self,
+        api: KubeApi,
+        engine: PatternEngine,
+        *,
+        config: Optional[OperatorConfig] = None,
+        events: Optional[EventService] = None,
+        storage: Optional[AnalysisStorageService] = None,
+        providers: Optional[ProviderRegistry] = None,
+        metrics: Optional[MetricsRegistry] = None,
+    ) -> None:
+        self.api = api
+        self.engine = engine
+        self.config = config or OperatorConfig()
+        self.events = events or EventService(api, self.config)
+        self.storage = storage or AnalysisStorageService(api, self.config)
+        self.providers = providers or default_registry()
+        self.metrics = metrics or METRICS
+        self.cache = ResponseCache()
+        self.dedupe = FailureDedupe()
+
+    # ------------------------------------------------------------------
+    async def process_failure_group(
+        self,
+        pod: Pod,
+        podmortems: list[Podmortem],
+        *,
+        failure_time: str,
+    ) -> list[Optional[AnalysisResult]]:
+        """Claim one (pod, failureTime) and fan out one pipeline per matching
+        CR (reference fans out per CR, PodFailureWatcher.java:196-199).
+        Returns [] if the failure was already claimed.  A fully failed group
+        releases the claim so the other detection path can retry it."""
+        key = FailureDedupe.key(pod, failure_time)
+        if not self.dedupe.try_claim(key):
+            return []
+        try:
+            results = []
+            for podmortem in podmortems:
+                results.append(
+                    await self.process_pod_failure(pod, podmortem, failure_time=failure_time)
+                )
+        except BaseException:
+            self.dedupe.release(key)
+            raise
+        if any(result is not None for result in results):
+            self.dedupe.mark_done(key)
+        else:
+            self.dedupe.release(key)
+        return results
+
+    # ------------------------------------------------------------------
+    async def process_pod_failure(
+        self,
+        pod: Pod,
+        podmortem: Podmortem,
+        *,
+        failure_time: Optional[str] = None,
+    ) -> Optional[AnalysisResult]:
+        """The hot path (reference call stack §3.2).  Returns the analysis
+        result, or None when collection failed outright."""
+        started = time.perf_counter()
+        self.metrics.incr("failures_detected")
+        await self.events.emit_failure_detected(pod, podmortem)
+
+        # -- collect -----------------------------------------------------
+        try:
+            with self.metrics.timed("collect"):
+                failure = await self.collect_failure_data(pod)
+        except ApiError as exc:
+            log.error("failed collecting failure data for %s: %s", pod.qualified_name(), exc)
+            await self.events.emit_analysis_error(pod, podmortem, f"log collection failed: {exc}")
+            self.metrics.incr("collect_errors")
+            return None
+
+        # -- parse (CPU/TPU pattern match) --------------------------------
+        try:
+            with self.metrics.timed("parse"):
+                result = await asyncio.wait_for(
+                    asyncio.to_thread(self.engine.analyze, failure),
+                    timeout=self.config.parse_timeout_s,
+                )
+        except Exception as exc:  # noqa: BLE001 - degrade, never crash the watch
+            log.exception("pattern analysis failed for %s", pod.qualified_name())
+            await self.events.emit_analysis_error(pod, podmortem, f"pattern analysis failed: {exc}")
+            self.metrics.incr("parse_errors")
+            return None
+
+        # -- explain ------------------------------------------------------
+        ai_response: Optional[AIResponse] = None
+        if podmortem.spec.ai_analysis_enabled and podmortem.spec.ai_provider_ref is not None:
+            ai_response = await self._generate_explanation(pod, podmortem, result, failure)
+        elif podmortem.spec.ai_analysis_enabled:
+            log.info("podmortem %s has no aiProviderRef; storing pattern-only result",
+                     podmortem.qualified_name())
+
+        # -- store + emit --------------------------------------------------
+        with self.metrics.timed("store"):
+            await self.storage.store_analysis_results(
+                result, ai_response, pod, podmortem, failure_time=failure_time
+            )
+        explanation = (
+            ai_response.explanation
+            if ai_response is not None and ai_response.explanation
+            else result.pattern_summary_line()
+        )
+        await self.events.emit_analysis_complete(pod, podmortem, result, explanation)
+        total_ms = (time.perf_counter() - started) * 1e3
+        self.metrics.record("pipeline_total", total_ms)
+        self.metrics.incr("analyses_completed")
+        if result.timings is not None:
+            result.timings.total_ms = round(total_ms, 3)
+        return result
+
+    # ------------------------------------------------------------------
+    async def collect_failure_data(self, pod: Pod) -> PodFailureData:
+        """Pod log + namespace events for the pod
+        (reference collectPodFailureData, PodFailureWatcher.java:310-345).
+        Prefers the previous container's log when the pod restarted (the
+        crash evidence lives there, not in the fresh container)."""
+        restarted = any(
+            cs.restart_count > 0 for cs in (pod.status.container_statuses if pod.status else [])
+        )
+        logs = ""
+        try:
+            logs = await self.api.get_log(
+                pod.metadata.name,
+                pod.metadata.namespace,
+                previous=restarted,
+                tail_bytes=self.config.log_tail_bytes,
+            )
+        except NotFoundError:
+            raise
+        except ApiError as exc:
+            log.warning("log fetch failed for %s (%s); continuing with events only",
+                        pod.qualified_name(), exc)
+        events: list[KubeEvent] = []
+        try:
+            raw_events = await self.api.list("Event", namespace=pod.metadata.namespace)
+            for raw in raw_events:
+                event = KubeEvent.parse(raw)
+                if event.regarding is None or event.regarding.name != pod.metadata.name:
+                    continue
+                # never feed our own analysis events back into analysis — the
+                # explanation quotes log evidence, which would re-match the
+                # patterns and echo-amplify on every restart
+                if event.reporting_controller == self.config.reporting_controller:
+                    continue
+                events.append(event)
+        except ApiError as exc:
+            log.debug("event list failed for %s: %s", pod.qualified_name(), exc)
+        return PodFailureData(pod=pod, logs=logs, events=events, collection_time=now_iso())
+
+    # ------------------------------------------------------------------
+    async def _generate_explanation(
+        self,
+        pod: Pod,
+        podmortem: Podmortem,
+        result: AnalysisResult,
+        failure: PodFailureData,
+    ) -> AIResponse:
+        ref = podmortem.spec.ai_provider_ref
+        namespace = ref.namespace or podmortem.metadata.namespace or "default"
+        try:
+            provider_dict = await self.api.get("AIProvider", ref.name, namespace)
+        except NotFoundError:
+            message = f"AIProvider {namespace}/{ref.name} not found"
+            log.warning("%s (podmortem %s)", message, podmortem.qualified_name())
+            await self.events.emit_analysis_error(pod, podmortem, message)
+            self.metrics.incr("provider_missing")
+            return AIResponse(error=message)
+        except ApiError as exc:
+            await self.events.emit_analysis_error(pod, podmortem, f"AIProvider fetch failed: {exc}")
+            return AIResponse(error=str(exc))
+
+        provider = AIProvider.parse(provider_dict)
+        provider_config = await resolve_provider_config(self.api, provider)
+        request = AnalysisRequest(
+            analysis_result=result, provider_config=provider_config, failure_data=failure
+        )
+
+        cache_key = None
+        if provider_config.caching_enabled:
+            cache_key = ResponseCache.key(request)
+            cached = self.cache.get(cache_key)
+            if cached is not None:
+                self.metrics.incr("ai_cache_hits")
+                cached_copy = AIResponse(**{**cached.__dict__, "cached": True})
+                return cached_copy
+
+        try:
+            backend = self.providers.resolve(provider_config.provider_id)
+        except ProviderError as exc:
+            await self.events.emit_analysis_error(pod, podmortem, str(exc))
+            self.metrics.incr("provider_errors")
+            return AIResponse(error=str(exc))
+
+        try:
+            with self.metrics.timed("ai_generate"):
+                response = await asyncio.wait_for(
+                    backend.generate(request), timeout=self.config.ai_timeout_s
+                )
+        except asyncio.TimeoutError:
+            message = f"AI generation timed out after {self.config.ai_timeout_s:.0f}s"
+            await self.events.emit_analysis_error(pod, podmortem, message)
+            self.metrics.incr("ai_timeouts")
+            return AIResponse(error=message, provider_id=provider_config.provider_id)
+        except Exception as exc:  # noqa: BLE001 - degrade to pattern-only
+            log.exception("AI generation failed for %s", pod.qualified_name())
+            await self.events.emit_analysis_error(pod, podmortem, f"AI generation failed: {exc}")
+            self.metrics.incr("ai_errors")
+            return AIResponse(error=str(exc), provider_id=provider_config.provider_id)
+
+        if response.error:
+            await self.events.emit_analysis_error(pod, podmortem, response.error)
+            self.metrics.incr("ai_errors")
+        elif cache_key is not None:
+            self.cache.put(cache_key, response)
+        return response

@@ -1,0 +1,282 @@
+"""AI provider backends + resolution of AIProvider CRs into runtime config.
+
+The reference delegates explanation generation to an external ai-interface
+service addressed by ``providerId`` (``openai``, ``ollama`` — reference
+aiprovider-crd.yaml:19-21, AIInterfaceRestClient.java:37-39).  Here providers
+are in-process backends behind one async interface:
+
+- ``tpu-native``  — the in-tree TPU serving engine (registered by
+  ``operator_tpu.serving`` at startup; the whole point of the rebuild);
+- ``template``    — deterministic pattern-based explanations, no model
+  (fallback + tests);
+- ``openai`` / any OpenAI-compatible HTTP endpoint — preserved for parity
+  (reference README.md:50-66), implemented with urllib in a thread so the
+  event loop stays unblocked (the reference's worker-pool discipline,
+  SURVEY.md §5).
+
+Config resolution mirrors AIInterfaceClient.convertToProviderConfig
+(reference :71-105): CR spec + defaults + auth token base64-decoded from the
+referenced Secret (:118-149).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import logging
+import urllib.error
+import urllib.request
+from collections import OrderedDict
+from typing import Callable, Optional, Protocol
+
+from ..schema.analysis import AIProviderConfig, AIResponse, AnalysisRequest
+from ..schema.crds import AIProvider
+from ..schema.kube import Secret
+from .kubeapi import ApiError, KubeApi, NotFoundError
+
+log = logging.getLogger(__name__)
+
+
+class AIProviderBackend(Protocol):
+    async def generate(self, request: AnalysisRequest) -> AIResponse: ...
+
+
+class ProviderError(Exception):
+    pass
+
+
+# --------------------------------------------------------------------------
+# registry
+# --------------------------------------------------------------------------
+
+
+class ProviderRegistry:
+    def __init__(self) -> None:
+        self._backends: dict[str, AIProviderBackend] = {}
+        self._factories: dict[str, Callable[[], AIProviderBackend]] = {}
+
+    def register(self, provider_id: str, backend: AIProviderBackend) -> None:
+        self._backends[provider_id] = backend
+
+    def register_factory(self, provider_id: str, factory: Callable[[], AIProviderBackend]) -> None:
+        """Lazy registration — the tpu-native backend loads model weights, so
+        it materialises on first use, not at import."""
+        self._factories[provider_id] = factory
+
+    def resolve(self, provider_id: Optional[str]) -> AIProviderBackend:
+        pid = provider_id or "template"
+        backend = self._backends.get(pid)
+        if backend is None and pid in self._factories:
+            try:
+                backend = self._factories[pid]()
+            except Exception as exc:  # noqa: BLE001 - degrade to ProviderError
+                # keep the factory registered: the failure may be transient
+                # (e.g. TPU busy); the pipeline stores a pattern-only result
+                raise ProviderError(f"provider {pid!r} failed to initialise: {exc}") from exc
+            del self._factories[pid]
+            self._backends[pid] = backend
+        if backend is None:
+            if pid in ("openai", "ollama", "openai-compatible"):
+                backend = OpenAICompatProvider()
+                self._backends[pid] = backend
+            else:
+                raise ProviderError(f"unknown providerId {pid!r}")
+        return backend
+
+    def known_ids(self) -> list[str]:
+        return sorted(
+            set(self._backends) | set(self._factories) | {"openai", "ollama", "template"}
+        )
+
+
+def default_registry() -> ProviderRegistry:
+    registry = ProviderRegistry()
+    registry.register("template", TemplateProvider())
+    return registry
+
+
+# --------------------------------------------------------------------------
+# CR -> config resolution
+# --------------------------------------------------------------------------
+
+
+async def resolve_provider_config(api: KubeApi, provider: AIProvider) -> AIProviderConfig:
+    spec = provider.spec
+    token: Optional[str] = None
+    auth = spec.authentication_ref
+    if auth is not None and auth.secret_name:
+        try:
+            secret_dict = await api.get(
+                "Secret", auth.secret_name, provider.metadata.namespace or "default"
+            )
+            token = Secret.parse(secret_dict).decoded(auth.secret_key or "token")
+            if token is None:
+                log.warning(
+                    "secret %s has no key %s", auth.secret_name, auth.secret_key or "token"
+                )
+        except NotFoundError:
+            log.warning("auth secret %s not found for provider %s",
+                        auth.secret_name, provider.metadata.name)
+        except ApiError as exc:
+            log.warning("failed reading auth secret for %s: %s", provider.metadata.name, exc)
+    return AIProviderConfig(
+        provider_id=spec.provider_id,
+        api_url=spec.api_url,
+        model_id=spec.model_id,
+        auth_token=token,
+        timeout_seconds=spec.timeout_seconds,
+        max_retries=spec.max_retries,
+        caching_enabled=spec.caching_enabled,
+        prompt_template=spec.prompt_template,
+        max_tokens=spec.max_tokens,
+        temperature=spec.temperature,
+        additional_config=dict(spec.additional_config),
+    )
+
+
+# --------------------------------------------------------------------------
+# response cache (reference cachingEnabled, AIInterfaceClient.java:80)
+# --------------------------------------------------------------------------
+
+
+class ResponseCache:
+    """Small LRU keyed on the analysis evidence, so a crash-looping pod
+    replaying one failure doesn't re-run generation every restart."""
+
+    def __init__(self, maxsize: int = 256) -> None:
+        self.maxsize = maxsize
+        self._entries: OrderedDict[str, AIResponse] = OrderedDict()
+
+    @staticmethod
+    def key(request: AnalysisRequest) -> str:
+        result = request.analysis_result
+        config = request.provider_config
+        basis = {
+            "provider": config.provider_id if config else None,
+            "model": config.model_id if config else None,
+            "patterns": [
+                (e.matched_pattern.id if e.matched_pattern else None,
+                 e.context.matched_line if e.context else None)
+                for e in (result.events if result else [])[:8]
+            ],
+        }
+        return hashlib.sha256(json.dumps(basis, sort_keys=True).encode()).hexdigest()
+
+    def get(self, key: str) -> Optional[AIResponse]:
+        response = self._entries.get(key)
+        if response is not None:
+            self._entries.move_to_end(key)
+        return response
+
+    def put(self, key: str, response: AIResponse) -> None:
+        self._entries[key] = response
+        self._entries.move_to_end(key)
+        while len(self._entries) > self.maxsize:
+            self._entries.popitem(last=False)
+
+
+# --------------------------------------------------------------------------
+# backends
+# --------------------------------------------------------------------------
+
+
+class TemplateProvider:
+    """Deterministic explanation straight from the pattern result — the
+    zero-model fallback, formatted with the Root Cause / Fix sections the
+    event truncation preserves (reference EventService.java:282-301)."""
+
+    async def generate(self, request: AnalysisRequest) -> AIResponse:
+        result = request.analysis_result
+        config = request.provider_config or AIProviderConfig()
+        if result is None or not result.events:
+            return AIResponse(
+                explanation="Root Cause: no known failure pattern matched the logs.\n"
+                "Fix: inspect the pod logs manually.",
+                provider_id="template",
+                model_id=config.model_id,
+            )
+        top = result.top_events(3)
+        primary = top[0]
+        name = primary.matched_pattern.name if primary.matched_pattern else "unknown failure"
+        lines = [f"Root Cause: {name}."]
+        if primary.context and primary.context.matched_line:
+            lines.append(f'Evidence: "{primary.context.matched_line.strip()[:200]}"')
+        if len(top) > 1:
+            others = ", ".join(
+                e.matched_pattern.name for e in top[1:] if e.matched_pattern and e.matched_pattern.name
+            )
+            if others:
+                lines.append(f"Related signals: {others}.")
+        remediation = primary.matched_pattern.remediation if primary.matched_pattern else None
+        lines.append(f"Fix: {remediation.strip()}" if remediation else
+                     "Fix: inspect the surrounding log context.")
+        return AIResponse(
+            explanation="\n".join(lines),
+            provider_id="template",
+            model_id=config.model_id,
+        )
+
+
+class OpenAICompatProvider:
+    """OpenAI-compatible chat-completions client (covers ``openai`` and
+    ``ollama`` providerIds).  Blocking urllib runs in a worker thread; retries
+    honour the CR's maxRetries (reference defaults :78-84)."""
+
+    def __init__(self, opener: Optional[Callable] = None) -> None:
+        # injectable for tests; defaults to urllib
+        self._opener = opener or urllib.request.urlopen
+
+    async def generate(self, request: AnalysisRequest) -> AIResponse:
+        config = request.provider_config or AIProviderConfig()
+        if not config.api_url:
+            return AIResponse(error="provider has no apiUrl", provider_id=config.provider_id)
+        from ..serving.prompts import build_prompt  # shared with tpu-native path
+
+        prompt = build_prompt(request)
+        body = {
+            "model": config.model_id,
+            "messages": [{"role": "user", "content": prompt}],
+            "max_tokens": config.max_tokens,
+            "temperature": config.temperature,
+        }
+        # accept any of: bare host, .../v1, or a full .../chat/completions URL
+        # (the documented OpenAI base is https://api.openai.com/v1)
+        url = config.api_url.rstrip("/")
+        if url.endswith("/chat/completions"):
+            pass
+        elif url.endswith("/v1"):
+            url = f"{url}/chat/completions"
+        else:
+            url = f"{url}/v1/chat/completions"
+        headers = {"Content-Type": "application/json"}
+        if config.auth_token:
+            headers["Authorization"] = f"Bearer {config.auth_token}"
+
+        def call() -> AIResponse:
+            req = urllib.request.Request(
+                url, data=json.dumps(body).encode(), headers=headers, method="POST"
+            )
+            with self._opener(req, timeout=config.timeout_seconds) as resp:
+                payload = json.loads(resp.read().decode())
+            text = payload["choices"][0]["message"]["content"]
+            usage = payload.get("usage", {})
+            return AIResponse(
+                explanation=text,
+                provider_id=config.provider_id,
+                model_id=config.model_id,
+                prompt_tokens=usage.get("prompt_tokens"),
+                completion_tokens=usage.get("completion_tokens"),
+            )
+
+        last_error: Optional[str] = None
+        for attempt in range(max(1, config.max_retries)):
+            try:
+                return await asyncio.to_thread(call)
+            except (urllib.error.URLError, OSError, KeyError, ValueError) as exc:
+                last_error = str(exc)
+                log.warning("provider %s attempt %d failed: %s",
+                            config.provider_id, attempt + 1, exc)
+                await asyncio.sleep(min(2**attempt * 0.2, 2.0))
+        return AIResponse(error=f"provider failed after retries: {last_error}",
+                          provider_id=config.provider_id, model_id=config.model_id)

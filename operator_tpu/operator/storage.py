@@ -1,0 +1,177 @@
+"""Durable result storage — pod annotations + Podmortem CR status history.
+
+Behavioural parity with the reference's AnalysisStorageService:
+
+- annotation keys ``podmortem.io/{analysis,severity,analyzed-at,monitor}``
+  (reference AnalysisStorageService.java:42-46);
+- full AI text stored when present, else the pattern summary line
+  (:142-156);
+- Podmortem ``status.recentFailures`` is a newest-first ring capped at 10
+  (:48,286-333);
+- optimistic-concurrency discipline: re-fetch latest, patch with its
+  resourceVersion, on 409 retry up to 5 times with 100ms*2^n backoff
+  (:74-76,179-187); 403 logs an RBAC warning and gives up (:188-193).
+
+Unlike the reference — where the reconciler injects this service but never
+calls it (PodmortemReconciler.java:50, SURVEY.md §3.3) — both detection
+paths here share one pipeline, so poll-path results are stored too.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from ..schema.analysis import AIResponse, AnalysisResult
+from ..schema.crds import PodFailureStatus, Podmortem
+from ..schema.kube import Pod
+from ..schema.meta import now_iso
+from ..schema.serde import to_dict
+from ..utils.config import OperatorConfig
+from .kubeapi import ApiError, ConflictError, ForbiddenError, KubeApi, NotFoundError
+
+log = logging.getLogger(__name__)
+
+ANNOTATION_ANALYSIS = "podmortem.io/analysis"
+ANNOTATION_SEVERITY = "podmortem.io/severity"
+ANNOTATION_ANALYZED_AT = "podmortem.io/analyzed-at"
+ANNOTATION_MONITOR = "podmortem.io/monitor"
+
+#: keep pod annotations within etcd sanity; full text still goes to CR status
+MAX_ANNOTATION_CHARS = 8192
+
+
+class AnalysisStorageService:
+    def __init__(self, api: KubeApi, config: Optional[OperatorConfig] = None) -> None:
+        self.api = api
+        self.config = config or OperatorConfig()
+
+    # ------------------------------------------------------------------
+    async def store_analysis_results(
+        self,
+        result: AnalysisResult,
+        ai_response: Optional[AIResponse],
+        pod: Pod,
+        podmortem: Podmortem,
+        *,
+        failure_time: Optional[str] = None,
+    ) -> None:
+        """Store to both places; failures in one must not block the other
+        (reference stores annotations first, then status :60-68)."""
+        explanation = self._explanation_text(result, ai_response)
+        await self.store_to_pod_annotations(pod, result, explanation)
+        await self.store_to_podmortem_status(
+            podmortem, pod, result, ai_response, explanation, failure_time=failure_time
+        )
+
+    @staticmethod
+    def _explanation_text(result: AnalysisResult, ai_response: Optional[AIResponse]) -> str:
+        if ai_response is not None and ai_response.explanation:
+            return ai_response.explanation
+        return result.pattern_summary_line()
+
+    # ------------------------------------------------------------------
+    async def store_to_pod_annotations(
+        self, pod: Pod, result: AnalysisResult, explanation: str
+    ) -> bool:
+        annotations = {
+            ANNOTATION_ANALYSIS: explanation[:MAX_ANNOTATION_CHARS],
+            ANNOTATION_SEVERITY: (result.summary.highest_severity or "NONE"),
+            ANNOTATION_ANALYZED_AT: now_iso(),
+        }
+
+        async def attempt() -> bool:
+            latest = await self.api.get("Pod", pod.metadata.name, pod.metadata.namespace)
+            rv = latest.get("metadata", {}).get("resourceVersion")
+            await self.api.patch(
+                "Pod",
+                pod.metadata.name,
+                pod.metadata.namespace,
+                {"metadata": {"annotations": annotations}},
+                resource_version=rv,
+            )
+            return True
+
+        return await self._with_conflict_retry(
+            attempt, what=f"pod annotations {pod.qualified_name()}"
+        )
+
+    # ------------------------------------------------------------------
+    async def store_to_podmortem_status(
+        self,
+        podmortem: Podmortem,
+        pod: Pod,
+        result: AnalysisResult,
+        ai_response: Optional[AIResponse],
+        explanation: str,
+        *,
+        failure_time: Optional[str] = None,
+    ) -> bool:
+        if ai_response is not None and ai_response.explanation:
+            analysis_status = "Analyzed"
+        elif ai_response is not None and ai_response.error:
+            analysis_status = "Failed"
+        else:
+            analysis_status = "PatternOnly"
+        entry = PodFailureStatus(
+            pod_name=pod.metadata.name,
+            pod_namespace=pod.metadata.namespace,
+            failure_time=failure_time or now_iso(),
+            analysis_status=analysis_status,
+            explanation=explanation,
+            severity=result.summary.highest_severity,
+        )
+
+        async def attempt() -> bool:
+            latest = await self.api.get("Podmortem", podmortem.metadata.name, podmortem.metadata.namespace)
+            rv = latest.get("metadata", {}).get("resourceVersion")
+            status = latest.get("status") or {}
+            failures = [to_dict(entry)] + list(status.get("recentFailures") or [])
+            failures = failures[: self.config.max_recent_failures]  # ring of 10
+            status.update(
+                {
+                    "recentFailures": failures,
+                    "lastUpdateTime": now_iso(),
+                }
+            )
+            await self.api.patch_status(
+                "Podmortem",
+                podmortem.metadata.name,
+                podmortem.metadata.namespace,
+                status,
+                resource_version=rv,
+            )
+            return True
+
+        return await self._with_conflict_retry(
+            attempt, what=f"podmortem status {podmortem.qualified_name()}"
+        )
+
+    # ------------------------------------------------------------------
+    async def _with_conflict_retry(self, attempt, what: str) -> bool:
+        """Re-fetch + patch, retrying 409s with exponential backoff
+        (reference :74-76,179-193)."""
+        retries = self.config.conflict_max_retries
+        for i in range(retries):
+            try:
+                return await attempt()
+            except ConflictError:
+                if i == retries - 1:
+                    log.error("giving up storing %s after %d conflicts", what, retries)
+                    return False
+                delay = self.config.conflict_backoff_base_s * (2**i)
+                log.debug("409 storing %s; retry %d in %.0fms", what, i + 1, delay * 1e3)
+                await asyncio.sleep(delay)
+            except ForbiddenError as exc:
+                log.warning(
+                    "RBAC forbids storing %s (%s); grant patch on the target resource", what, exc
+                )
+                return False
+            except NotFoundError:
+                log.info("target of %s is gone; skipping storage", what)
+                return False
+            except ApiError as exc:
+                log.error("failed storing %s: %s", what, exc)
+                return False
+        return False

@@ -51,7 +51,14 @@ from .kube import (
     ReplicaSet,
     Secret,
 )
-from .meta import K8sObject, LabelSelector, LabelSelectorRequirement, ObjectMeta, now_iso
+from .meta import (
+    K8sObject,
+    LabelSelector,
+    LabelSelectorRequirement,
+    ObjectMeta,
+    OwnerReference,
+    now_iso,
+)
 from .patterns import (
     ContextExtraction,
     LibraryMetadata,

@@ -1,0 +1,11 @@
+"""TPU serving plane — in-tree replacement for the reference's external
+ai-interface service (SURVEY.md §2.2, §7 stage 4).
+
+``prompts`` is model-free; the batching engine, KV cache, and the
+``tpu-native`` provider backend live in the sibling modules and import jax
+lazily so the control plane runs on accelerator-less machines.
+"""
+
+from .prompts import DEFAULT_TEMPLATE, build_prompt
+
+__all__ = ["DEFAULT_TEMPLATE", "build_prompt"]

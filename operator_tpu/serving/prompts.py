@@ -1,0 +1,96 @@
+"""Prompt construction for explanation generation.
+
+Honours the AIProvider CR's ``promptTemplate`` (reference
+aiprovider-crd.yaml:46-48); the default template instructs the model to
+answer in the Root Cause / Fix sections that downstream event truncation
+preserves (reference EventService.java:282-301).
+
+Context management for long logs (SURVEY.md §5 long-context entry): rather
+than shipping the whole log, the prompt carries the top-scoring match
+windows — the selection the pattern engine already did — plus the log tail,
+within a fixed character budget so batched prefill lengths stay bounded.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..schema.analysis import AnalysisRequest, AnalysisResult
+
+DEFAULT_TEMPLATE = """You are a Kubernetes failure analyst. A pod failed; explain why.
+
+Pod: {pod_name} (namespace {namespace})
+Pattern analysis (severity {severity}): {patterns}
+
+Strongest log evidence:
+{evidence}
+
+Recent log tail:
+{log_tail}
+
+Answer concisely with exactly two sections:
+Root Cause: <one or two sentences naming the root cause>
+Fix: <the most direct remediation>"""
+
+#: budgets keep batched prefill bounded (32 concurrent events -> one prefill,
+#: BASELINE config 4)
+MAX_EVIDENCE_CHARS = 1600
+MAX_TAIL_CHARS = 1200
+
+
+def _pattern_summary(result: Optional[AnalysisResult]) -> str:
+    if result is None or not result.events:
+        return "no known failure patterns matched"
+    parts = []
+    for event in result.top_events(3):
+        if event.matched_pattern is None:
+            continue
+        parts.append(f"{event.matched_pattern.name} (score {event.score:.2f})")
+    return "; ".join(parts) or "no named patterns"
+
+
+def _evidence(result: Optional[AnalysisResult]) -> str:
+    if result is None:
+        return "(none)"
+    blocks = []
+    used = 0
+    for event in result.top_events(3):
+        if event.context is None:
+            continue
+        block = event.context.render().strip()
+        if not block:
+            continue
+        remaining = MAX_EVIDENCE_CHARS - used
+        if remaining <= 0:
+            break
+        if len(block) > remaining:
+            block = block[:remaining]
+        blocks.append(block)
+        used += len(block)
+    return "\n---\n".join(blocks) if blocks else "(none)"
+
+
+def build_prompt(request: AnalysisRequest) -> str:
+    from ..patterns.windows import tail_chars  # local import keeps serving lean
+
+    result = request.analysis_result
+    config = request.provider_config
+    template = (config.prompt_template if config and config.prompt_template else DEFAULT_TEMPLATE)
+    failure = request.failure_data
+    pod = failure.pod if failure else None
+    log_tail = tail_chars(failure.logs if failure else "", MAX_TAIL_CHARS)
+    fields = {
+        "pod_name": (pod.metadata.name if pod else None) or (result.pod_name if result else None) or "unknown",
+        "namespace": (pod.metadata.namespace if pod else None)
+        or (result.pod_namespace if result else None)
+        or "unknown",
+        "severity": (result.summary.highest_severity if result else None) or "NONE",
+        "patterns": _pattern_summary(result),
+        "evidence": _evidence(result),
+        "log_tail": log_tail or "(no logs)",
+    }
+    try:
+        return template.format(**fields)
+    except (KeyError, IndexError, ValueError):
+        # user template with unknown placeholders: fall back to default
+        return DEFAULT_TEMPLATE.format(**fields)

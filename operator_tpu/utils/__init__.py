@@ -1,0 +1,6 @@
+"""Cross-cutting utilities: config, timing/metrics."""
+
+from .config import OperatorConfig
+from .timing import METRICS, MetricsRegistry, StageStats
+
+__all__ = ["OperatorConfig", "METRICS", "MetricsRegistry", "StageStats"]

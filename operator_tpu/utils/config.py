@@ -1,0 +1,70 @@
+"""Operator configuration — the MicroProfile-Config equivalent.
+
+Three tiers, mirroring the reference (SURVEY.md §5 config entry):
+static defaults < environment variables < CR spec (runtime behaviour such as
+AI on/off and provider params lives in the CRDs, not here).
+
+Env mapping follows the reference's keys where they exist:
+``podmortem.watch.namespaces`` -> ``PODMORTEM_WATCH_NAMESPACES``
+(reference PodFailureWatcher.java:52-53), ``pattern.cache.directory`` ->
+``PATTERN_CACHE_DIRECTORY`` (application.properties:4-5).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, fields
+from typing import Optional
+
+
+@dataclass
+class OperatorConfig:
+    # --- watch / reconcile ------------------------------------------------
+    watch_namespaces: list[str] = field(default_factory=list)  # empty = all
+    watch_restart_delay_s: float = 5.0  # reference PodFailureWatcher.java:574
+    reconcile_interval_s: float = 60.0
+
+    # --- pattern cache / sync --------------------------------------------
+    pattern_cache_directory: str = "/shared/patterns"  # application.properties:4-5
+    git_binary: str = "git"
+    sync_timeout_s: float = 120.0
+
+    # --- storage (reference AnalysisStorageService.java:48,74-76) ---------
+    max_recent_failures: int = 10
+    conflict_max_retries: int = 5
+    conflict_backoff_base_s: float = 0.1  # 100ms * 2^n
+
+    # --- events (reference EventService.java:32,81) -----------------------
+    reporting_controller: str = "podmortem.operator"
+    event_message_limit: int = 1024
+
+    # --- analysis budgets (application.properties:7-11) -------------------
+    parse_timeout_s: float = 30.0
+    ai_timeout_s: float = 180.0
+    log_tail_bytes: int = 1_000_000  # cap on fetched pod log
+
+    # --- serving ----------------------------------------------------------
+    model_id: str = "tinyllama-1.1b"
+    checkpoint_dir: Optional[str] = None
+    max_batch_size: int = 32  # BASELINE config 4: 32 events -> one prefill
+
+    @classmethod
+    def from_env(cls, env: Optional[dict[str, str]] = None) -> "OperatorConfig":
+        env = dict(os.environ if env is None else env)
+        cfg = cls()
+        for f in fields(cls):
+            key = f.name.upper()
+            if f.name == "watch_namespaces":
+                key = "PODMORTEM_WATCH_NAMESPACES"
+            raw = env.get(key)
+            if raw is None:
+                continue
+            if f.name == "watch_namespaces":
+                cfg.watch_namespaces = [ns.strip() for ns in raw.split(",") if ns.strip()]
+            elif f.type in ("float", float):
+                cfg.__setattr__(f.name, float(raw))
+            elif f.type in ("int", int):
+                cfg.__setattr__(f.name, int(raw))
+            else:
+                cfg.__setattr__(f.name, raw)
+        return cfg

@@ -1,0 +1,116 @@
+"""Per-stage latency metrics.
+
+The reference has no tracing at all (SURVEY.md §5); the rebuild's north star
+is a latency SLO (p50 < 2s), so stage timing is built in: every pipeline run
+records detect→collect→parse→prefill→decode→store durations, and the
+registry keeps streaming percentiles for the bench harness.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from bisect import insort
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+
+@dataclass
+class StageStats:
+    """Rolling latency record for one named stage (bounded memory)."""
+
+    name: str
+    count: int = 0
+    total_ms: float = 0.0
+    max_ms: float = 0.0
+    _sorted: list[float] = field(default_factory=list, repr=False)
+    _cap: int = 4096
+
+    def record(self, duration_ms: float) -> None:
+        self.count += 1
+        self.total_ms += duration_ms
+        self.max_ms = max(self.max_ms, duration_ms)
+        if len(self._sorted) >= self._cap:
+            # drop a middle sample to stay bounded while keeping the tails
+            del self._sorted[len(self._sorted) // 2]
+        insort(self._sorted, duration_ms)
+
+    @property
+    def mean_ms(self) -> float:
+        return self.total_ms / self.count if self.count else 0.0
+
+    def percentile(self, q: float) -> float:
+        if not self._sorted:
+            return 0.0
+        idx = min(len(self._sorted) - 1, int(q / 100.0 * len(self._sorted)))
+        return self._sorted[idx]
+
+    @property
+    def p50_ms(self) -> float:
+        return self.percentile(50)
+
+    @property
+    def p99_ms(self) -> float:
+        return self.percentile(99)
+
+
+class MetricsRegistry:
+    """Thread-safe registry of stage stats + counters."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._stages: dict[str, StageStats] = {}
+        self._counters: dict[str, int] = {}
+
+    def stage(self, name: str) -> StageStats:
+        with self._lock:
+            stats = self._stages.get(name)
+            if stats is None:
+                stats = StageStats(name)
+                self._stages[name] = stats
+            return stats
+
+    def record(self, name: str, duration_ms: float) -> None:
+        with self._lock:
+            stats = self._stages.get(name)
+            if stats is None:
+                stats = StageStats(name)
+                self._stages[name] = stats
+            stats.record(duration_ms)
+
+    @contextmanager
+    def timed(self, name: str) -> Iterator[None]:
+        started = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, (time.perf_counter() - started) * 1e3)
+
+    def incr(self, name: str, amount: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + amount
+
+    def counter(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "stages": {
+                    name: {
+                        "count": s.count,
+                        "mean_ms": round(s.mean_ms, 3),
+                        "p50_ms": round(s.p50_ms, 3),
+                        "p99_ms": round(s.p99_ms, 3),
+                        "max_ms": round(s.max_ms, 3),
+                    }
+                    for name, s in self._stages.items()
+                },
+                "counters": dict(self._counters),
+            }
+
+
+#: process-wide default registry (dependency-inject a fresh one in tests)
+METRICS = MetricsRegistry()

@@ -1,0 +1,417 @@
+"""Control-plane unit tests: fake apiserver semantics, storage 409
+discipline, event emission/truncation, health gating, provider resolution."""
+
+import asyncio
+import base64
+
+import pytest
+
+from operator_tpu.operator import (
+    AnalysisStorageService,
+    ConflictError,
+    EventService,
+    FakeKubeApi,
+    NotFoundError,
+    ReadinessCheck,
+    TemplateProvider,
+    WatchClosed,
+    default_registry,
+    resolve_provider_config,
+    truncate_message,
+)
+from operator_tpu.operator.storage import (
+    ANNOTATION_ANALYSIS,
+    ANNOTATION_ANALYZED_AT,
+    ANNOTATION_SEVERITY,
+)
+from operator_tpu.schema import (
+    AIProvider,
+    AIProviderSpec,
+    AIResponse,
+    AnalysisEvent,
+    AnalysisRequest,
+    AnalysisResult,
+    AnalysisSummary,
+    AuthenticationRef,
+    LabelSelector,
+    MatchContext,
+    MatchedPattern,
+    ObjectMeta,
+    OwnerReference,
+    PatternLibrary,
+    Pod,
+    Podmortem,
+    PodmortemSpec,
+    Secret,
+)
+from operator_tpu.utils.config import OperatorConfig
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_pod(name="web-1", namespace="prod", labels=None, owners=None):
+    return Pod(metadata=ObjectMeta(name=name, namespace=namespace,
+                                   labels=labels or {"app": "web"},
+                                   owner_references=owners or []))
+
+
+def make_result(severity="HIGH", pattern="port-conflict", score=1.5):
+    return AnalysisResult(
+        analysis_id="t1",
+        summary=AnalysisSummary(highest_severity=severity, significant_events=1, total_events=1,
+                                score=score),
+        events=[AnalysisEvent(score=score,
+                              matched_pattern=MatchedPattern(id=pattern, name=pattern,
+                                                             severity=severity),
+                              context=MatchContext(line_number=3, matched_line="boom"))],
+    )
+
+
+# --- fake apiserver -------------------------------------------------------
+
+
+def test_fake_api_crud_and_rv():
+    async def body():
+        api = FakeKubeApi()
+        pod = make_pod()
+        created = await api.create("Pod", pod.to_dict())
+        assert created["metadata"]["resourceVersion"] == "1"
+        assert created["metadata"]["uid"]
+        patched = await api.patch("Pod", "web-1", "prod", {"metadata": {"labels": {"x": "y"}}})
+        assert patched["metadata"]["resourceVersion"] == "2"
+        assert patched["metadata"]["labels"] == {"app": "web", "x": "y"}
+        with pytest.raises(NotFoundError):
+            await api.get("Pod", "nope", "prod")
+        with pytest.raises(ConflictError):
+            await api.create("Pod", pod.to_dict())
+        await api.delete("Pod", "web-1", "prod")
+        with pytest.raises(NotFoundError):
+            await api.get("Pod", "web-1", "prod")
+
+    run(body())
+
+
+def test_fake_api_optimistic_concurrency():
+    async def body():
+        api = FakeKubeApi()
+        await api.create("Pod", make_pod().to_dict())
+        current = await api.get("Pod", "web-1", "prod")
+        rv = current["metadata"]["resourceVersion"]
+        await api.patch("Pod", "web-1", "prod", {"metadata": {"labels": {"a": "1"}}},
+                        resource_version=rv)
+        with pytest.raises(ConflictError):  # rv is now stale
+            await api.patch("Pod", "web-1", "prod", {"metadata": {"labels": {"b": "2"}}},
+                            resource_version=rv)
+
+    run(body())
+
+
+def test_fake_api_list_selector_and_watch():
+    async def body():
+        api = FakeKubeApi()
+        await api.create("Pod", make_pod("a", labels={"app": "web"}).to_dict())
+        await api.create("Pod", make_pod("b", labels={"app": "db"}).to_dict())
+        sel = LabelSelector(match_labels={"app": "web"})
+        assert [p["metadata"]["name"] for p in await api.list("Pod", label_selector=sel)] == ["a"]
+
+        events = []
+
+        async def consume():
+            async for ev in api.watch("Pod", "prod"):
+                events.append((ev.type, ev.object["metadata"]["name"]))
+                if len(events) == 2:
+                    return
+
+        task = asyncio.create_task(consume())
+        await asyncio.sleep(0.01)
+        await api.create("Pod", make_pod("c").to_dict())
+        await api.patch("Pod", "c", "prod", {"metadata": {"labels": {"z": "1"}}})
+        await asyncio.wait_for(task, 2)
+        assert events == [("ADDED", "c"), ("MODIFIED", "c")]
+
+    run(body())
+
+
+def test_fake_api_watch_close_raises():
+    async def body():
+        api = FakeKubeApi()
+
+        async def consume():
+            async for _ in api.watch("Pod"):
+                pass
+
+        task = asyncio.create_task(consume())
+        await asyncio.sleep(0.01)
+        assert api.close_watches() == 1
+        with pytest.raises(WatchClosed):
+            await asyncio.wait_for(task, 2)
+
+    run(body())
+
+
+# --- storage (reference AnalysisStorageService semantics) ------------------
+
+
+def storage_fixture(config=None):
+    api = FakeKubeApi()
+    config = config or OperatorConfig(conflict_backoff_base_s=0.001)
+    return api, AnalysisStorageService(api, config), config
+
+
+def test_storage_annotations_and_status_ring():
+    async def body():
+        api, storage, config = storage_fixture()
+        pod = make_pod()
+        await api.create("Pod", pod.to_dict())
+        pm = Podmortem(metadata=ObjectMeta(name="pm", namespace="prod"), spec=PodmortemSpec())
+        await api.create("Podmortem", pm.to_dict())
+        result = make_result()
+        ai = AIResponse(explanation="Root Cause: X.\nFix: Y.")
+        # store 12 failures -> ring caps at 10, newest first
+        for i in range(12):
+            await storage.store_analysis_results(
+                result, ai, pod, pm, failure_time=f"2026-07-28T09:14:{i:02d}Z"
+            )
+        stored = await api.get("Pod", "web-1", "prod")
+        ann = stored["metadata"]["annotations"]
+        assert ann[ANNOTATION_ANALYSIS] == "Root Cause: X.\nFix: Y."
+        assert ann[ANNOTATION_SEVERITY] == "HIGH"
+        assert ANNOTATION_ANALYZED_AT in ann
+        status = (await api.get("Podmortem", "pm", "prod"))["status"]
+        failures = status["recentFailures"]
+        assert len(failures) == 10
+        assert failures[0]["failureTime"] == "2026-07-28T09:14:11Z"  # newest first
+        assert failures[0]["analysisStatus"] == "Analyzed"
+
+    run(body())
+
+
+def test_storage_409_retry_succeeds():
+    async def body():
+        api, storage, _ = storage_fixture()
+        pod = make_pod()
+        await api.create("Pod", pod.to_dict())
+        pm = Podmortem(metadata=ObjectMeta(name="pm", namespace="prod"))
+        await api.create("Podmortem", pm.to_dict())
+        api.inject_conflicts(3, op="patch_status")  # fewer than the 5 retries
+        ok = await storage.store_to_podmortem_status(
+            pm, pod, make_result(), None, "explanation", failure_time="t"
+        )
+        assert ok
+        status = (await api.get("Podmortem", "pm", "prod"))["status"]
+        assert status["recentFailures"][0]["analysisStatus"] == "PatternOnly"
+
+    run(body())
+
+
+def test_storage_409_storm_gives_up():
+    async def body():
+        api, storage, config = storage_fixture()
+        pod = make_pod()
+        await api.create("Pod", pod.to_dict())
+        pm = Podmortem(metadata=ObjectMeta(name="pm", namespace="prod"))
+        await api.create("Podmortem", pm.to_dict())
+        api.inject_conflicts(99, op="patch_status")
+        ok = await storage.store_to_podmortem_status(
+            pm, pod, make_result(), None, "x", failure_time="t"
+        )
+        assert not ok  # gave up after max retries, no crash
+
+    run(body())
+
+
+def test_storage_403_rbac_warning_no_retry():
+    async def body():
+        from operator_tpu.operator import ForbiddenError
+
+        api, storage, _ = storage_fixture()
+        pod = make_pod()
+        await api.create("Pod", pod.to_dict())
+        calls = {"n": 0}
+
+        def hook(op, kind, name):
+            if op == "patch":
+                calls["n"] += 1
+                return ForbiddenError("rbac says no")
+            return None
+
+        api.error_hooks.append(hook)
+        ok = await storage.store_to_pod_annotations(pod, make_result(), "text")
+        assert not ok
+        assert calls["n"] == 1  # 403 is terminal, not retried
+
+    run(body())
+
+
+def test_storage_target_deleted_mid_flight():
+    async def body():
+        api, storage, _ = storage_fixture()
+        pod = make_pod()
+        ok = await storage.store_to_pod_annotations(pod, make_result(), "text")
+        assert not ok  # pod never existed; handled, not raised
+
+    run(body())
+
+
+# --- events ---------------------------------------------------------------
+
+
+def test_truncate_preserves_root_cause_and_fix():
+    text = ("Intro paragraph. " * 30
+            + "\nRoot Cause: the port was taken by a zombie process.\n"
+            + "Details: " + "blah " * 100
+            + "\nFix: kill the zombie and restart."
+            + "\nAppendix: " + "junk " * 200)
+    out = truncate_message(text, 1024)
+    assert len(out) <= 1024
+    assert "Root Cause: the port was taken" in out
+    assert "Fix: kill the zombie" in out
+    assert "Appendix" not in out
+
+
+def test_truncate_short_passthrough_and_plain():
+    assert truncate_message("short", 1024) == "short"
+    long_plain = "x" * 2000
+    out = truncate_message(long_plain, 1024)
+    assert len(out) == 1024 and out.endswith("...")
+
+
+def test_events_three_targets_with_owner_chase():
+    async def body():
+        api = FakeKubeApi()
+        await api.create("Deployment", {
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "web", "namespace": "prod"}})
+        await api.create("ReplicaSet", {
+            "apiVersion": "apps/v1", "kind": "ReplicaSet",
+            "metadata": {"name": "web-abc", "namespace": "prod",
+                         "ownerReferences": [{"kind": "Deployment", "name": "web"}]}})
+        pod = make_pod(owners=[OwnerReference(kind="ReplicaSet", name="web-abc")])
+        await api.create("Pod", pod.to_dict())
+        pm = Podmortem(metadata=ObjectMeta(name="pm", namespace="prod"))
+        await api.create("Podmortem", pm.to_dict())
+
+        service = EventService(api)
+        await service.emit_failure_detected(pod, pm)
+        events = await api.list("Event")
+        targets = sorted(f"{e['regarding']['kind']}/{e['regarding']['name']}" for e in events)
+        assert targets == ["Deployment/web", "Pod/web-1", "Podmortem/pm"]
+        assert all(e["reason"] == "PodFailureDetected" for e in events)
+        assert all(e["type"] == "Warning" for e in events)
+        assert all(e["reportingController"] == "podmortem.operator" for e in events)
+
+    run(body())
+
+
+def test_events_emission_failure_does_not_raise():
+    async def body():
+        api = FakeKubeApi()
+        pod = make_pod()
+        pm = Podmortem(metadata=ObjectMeta(name="pm", namespace="prod"))
+        from operator_tpu.operator import ApiError
+
+        api.inject_errors("create", lambda: ApiError("event quota", 500), times=10)
+        service = EventService(api)
+        await service.emit_analysis_error(pod, pm, "boom")  # must not raise
+
+    run(body())
+
+
+# --- health ---------------------------------------------------------------
+
+
+def test_readiness_gating():
+    async def body():
+        api = FakeKubeApi()
+        config = OperatorConfig(pattern_cache_directory="/nonexistent-xyz")
+        check = ReadinessCheck(api, config)
+        # no PatternLibrary CRs -> ready (reference :38-41)
+        assert (await check.check()).ready
+        pl = PatternLibrary(metadata=ObjectMeta(name="pl", namespace="ns"))
+        await api.create("PatternLibrary", pl.to_dict())
+        # CRs exist, no cache -> not ready
+        assert not (await check.check()).ready
+        # grace elapsed -> ready anyway (reference :45-50,72-76)
+        import time
+
+        check.started_at = time.monotonic() - 301
+        assert (await check.check()).ready
+
+    run(body())
+
+
+def test_readiness_with_cached_patterns(tmp_path):
+    async def body():
+        api = FakeKubeApi()
+        pl = PatternLibrary(metadata=ObjectMeta(name="pl", namespace="ns"))
+        await api.create("PatternLibrary", pl.to_dict())
+        (tmp_path / "lib").mkdir()
+        (tmp_path / "lib" / "x.yaml").write_text("patterns: []")
+        check = ReadinessCheck(api, OperatorConfig(pattern_cache_directory=str(tmp_path)))
+        status = await check.check()
+        assert status.ready and "pattern file" in status.reason
+
+    run(body())
+
+
+# --- providers ------------------------------------------------------------
+
+
+def test_resolve_provider_config_with_secret():
+    async def body():
+        api = FakeKubeApi()
+        token = base64.b64encode(b"sk-secret-token\n").decode()
+        secret = Secret(metadata=ObjectMeta(name="ai-auth", namespace="ns"),
+                        data={"token": token})
+        await api.create("Secret", secret.to_dict())
+        provider = AIProvider(
+            metadata=ObjectMeta(name="prov", namespace="ns"),
+            spec=AIProviderSpec(
+                provider_id="openai", api_url="http://x", model_id="gpt",
+                authentication_ref=AuthenticationRef(secret_name="ai-auth", secret_key="token"),
+                temperature=0.1, max_tokens=64,
+            ),
+        )
+        config = await resolve_provider_config(api, provider)
+        assert config.auth_token == "sk-secret-token"  # base64-decoded + stripped
+        assert config.temperature == 0.1
+        assert config.max_tokens == 64
+
+    run(body())
+
+
+def test_resolve_provider_missing_secret_degrades():
+    async def body():
+        api = FakeKubeApi()
+        provider = AIProvider(
+            metadata=ObjectMeta(name="prov", namespace="ns"),
+            spec=AIProviderSpec(provider_id="openai",
+                                authentication_ref=AuthenticationRef(secret_name="nope")),
+        )
+        config = await resolve_provider_config(api, provider)
+        assert config.auth_token is None
+
+    run(body())
+
+
+def test_template_provider_sections():
+    async def body():
+        provider = TemplateProvider()
+        response = await provider.generate(AnalysisRequest(analysis_result=make_result()))
+        assert response.explanation.startswith("Root Cause:")
+        assert "Fix:" in response.explanation
+        empty = await provider.generate(AnalysisRequest(analysis_result=AnalysisResult()))
+        assert "no known failure pattern" in empty.explanation
+
+    run(body())
+
+
+def test_registry_unknown_provider():
+    from operator_tpu.operator import ProviderError
+
+    registry = default_registry()
+    with pytest.raises(ProviderError):
+        registry.resolve("quantum-oracle")
+    assert "template" in registry.known_ids()

@@ -1,0 +1,487 @@
+"""Integration tests of the hot path: watcher dedupe/fan-out/restart, the
+shared pipeline's degradation ladder, reconcilers, and the openai-compatible
+provider against a fake transport."""
+
+import asyncio
+import json
+
+from operator_tpu.operator import (
+    AIProviderReconciler,
+    AnalysisPipeline,
+    FakeKubeApi,
+    OpenAICompatProvider,
+    PodFailureWatcher,
+    PodmortemCache,
+    PodmortemReconciler,
+    default_registry,
+    has_pod_failed,
+)
+from operator_tpu.patterns import PatternEngine
+from operator_tpu.schema import (
+    AIProvider,
+    AIProviderConfig,
+    AIProviderRef,
+    AIProviderSpec,
+    AnalysisRequest,
+    ContainerState,
+    ContainerStateTerminated,
+    ContainerStateWaiting,
+    ContainerStatus,
+    LabelSelector,
+    ObjectMeta,
+    Pod,
+    Podmortem,
+    PodmortemSpec,
+    PodStatus,
+)
+from operator_tpu.utils.config import OperatorConfig
+from operator_tpu.utils.timing import MetricsRegistry
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def failed_pod(name="web-1", namespace="prod", labels=None, exit_code=1,
+               finished_at="2026-07-28T09:00:00Z", waiting=None, reason=None):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=namespace, labels=labels or {"app": "web"}),
+        status=PodStatus(
+            phase="Running",
+            container_statuses=[ContainerStatus(
+                name="app",
+                restart_count=1,
+                state=ContainerState(
+                    waiting=ContainerStateWaiting(reason=waiting) if waiting else None,
+                    terminated=None if waiting else ContainerStateTerminated(
+                        exit_code=exit_code, reason=reason, finished_at=finished_at),
+                ),
+                last_state=ContainerState(terminated=ContainerStateTerminated(
+                    exit_code=exit_code, finished_at=finished_at)) if waiting else None,
+            )],
+        ),
+    )
+
+
+def healthy_pod(name="ok-1", namespace="prod"):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=namespace, labels={"app": "web"}),
+        status=PodStatus(phase="Running", container_statuses=[
+            ContainerStatus(name="app", restart_count=0,
+                            state=ContainerState(running={"startedAt": "x"}))]),
+    )
+
+
+async def make_stack(config=None, providers=None):
+    api = FakeKubeApi()
+    config = config or OperatorConfig(
+        pattern_cache_directory="/nonexistent", watch_restart_delay_s=0.01,
+        conflict_backoff_base_s=0.001,
+    )
+    engine = PatternEngine()
+    metrics = MetricsRegistry()
+    pipeline = AnalysisPipeline(api, engine, config=config, metrics=metrics,
+                                providers=providers or default_registry())
+    cache = PodmortemCache(api, resync_delay_s=0.01)
+    watcher = PodFailureWatcher(api, pipeline, config=config, metrics=metrics, cache=cache)
+    return api, pipeline, watcher, metrics
+
+
+# --- failure detection ----------------------------------------------------
+
+
+def test_has_pod_failed_variants():
+    assert has_pod_failed(failed_pod(exit_code=137))
+    assert has_pod_failed(failed_pod(waiting="CrashLoopBackOff"))
+    assert has_pod_failed(failed_pod(waiting="ImagePullBackOff"))
+    assert not has_pod_failed(healthy_pod())
+    assert not has_pod_failed(failed_pod(exit_code=0))
+    pod = healthy_pod()
+    pod.status.phase = "Failed"
+    assert has_pod_failed(pod)
+
+
+# --- watcher behaviour ----------------------------------------------------
+
+
+def test_watcher_dedupe_and_fanout():
+    async def body():
+        api, pipeline, watcher, metrics = await make_stack()
+        pm1 = Podmortem(metadata=ObjectMeta(name="pm1", namespace="ns"),
+                        spec=PodmortemSpec(pod_selector=LabelSelector(match_labels={"app": "web"})))
+        pm2 = Podmortem(metadata=ObjectMeta(name="pm2", namespace="ns"),
+                        spec=PodmortemSpec(pod_selector=LabelSelector(match_labels={"app": "web"})))
+        pm3 = Podmortem(metadata=ObjectMeta(name="pm-other", namespace="ns"),
+                        spec=PodmortemSpec(pod_selector=LabelSelector(match_labels={"app": "db"})))
+        for pm in (pm1, pm2, pm3):
+            await api.create("Podmortem", pm.to_dict())
+        await watcher.cache.prime()
+
+        pod = failed_pod()
+        await api.create("Pod", pod.to_dict())
+        api.set_pod_log("prod", "web-1", "java.lang.OutOfMemoryError: Java heap space")
+
+        launched = await watcher.handle_pod_event("MODIFIED", pod)
+        assert launched == 2  # both matching CRs, not the db one
+        # same failure-time again -> dedupe
+        assert await watcher.handle_pod_event("MODIFIED", pod) == 0
+        # new failure time -> processed again
+        pod2 = failed_pod(finished_at="2026-07-28T10:00:00Z")
+        assert await watcher.handle_pod_event("MODIFIED", pod2) == 2
+        await watcher.drain()
+        status = (await api.get("Podmortem", "pm1", "ns"))["status"]
+        assert len(status["recentFailures"]) == 2
+
+    run(body())
+
+
+def test_watcher_namespace_allowlist():
+    async def body():
+        config = OperatorConfig(pattern_cache_directory="/nonexistent",
+                                watch_namespaces=["allowed"])
+        api, pipeline, watcher, _ = await make_stack(config=config)
+        pm = Podmortem(metadata=ObjectMeta(name="pm", namespace="ns"),
+                       spec=PodmortemSpec(pod_selector=LabelSelector()))
+        await api.create("Podmortem", pm.to_dict())
+        await watcher.cache.prime()
+        denied = failed_pod(namespace="denied")
+        await api.create("Pod", denied.to_dict())
+        assert await watcher.handle_pod_event("MODIFIED", denied) == 0
+        allowed = failed_pod(namespace="allowed")
+        await api.create("Pod", allowed.to_dict())
+        assert await watcher.handle_pod_event("MODIFIED", allowed) == 1
+        await watcher.drain()
+
+    run(body())
+
+
+def test_watcher_auto_restart_on_close():
+    async def body():
+        api, pipeline, watcher, metrics = await make_stack()
+        stop = asyncio.Event()
+        task = asyncio.create_task(watcher.run(stop))
+        await asyncio.sleep(0.05)
+        api.close_watches()          # server drops every stream
+        await asyncio.sleep(0.1)     # restart delay is 0.01
+        # watch must be re-established: a new failure still gets processed
+        pm = Podmortem(metadata=ObjectMeta(name="pm", namespace="ns"),
+                       spec=PodmortemSpec(pod_selector=LabelSelector(match_labels={"app": "web"})))
+        await api.create("Podmortem", pm.to_dict())
+        await asyncio.sleep(0.05)
+        pod = failed_pod()
+        await api.create("Pod", pod.to_dict())
+        await api.patch("Pod", "web-1", "prod", {"metadata": {"labels": {"touch": "1"}}})
+        await asyncio.sleep(0.1)
+        await watcher.drain()
+        stop.set()
+        api.close_watches()  # unblock the loop so it can observe stop
+        await asyncio.wait_for(asyncio.gather(task, return_exceptions=True), 5)
+        assert watcher.restarts >= 1
+        status = (await api.get("Podmortem", "pm", "ns")).get("status") or {}
+        assert status.get("recentFailures"), "failure after restart was not processed"
+
+    run(body())
+
+
+# --- pipeline degradation ladder ------------------------------------------
+
+
+def test_pipeline_ai_disabled_stores_pattern_only():
+    async def body():
+        api, pipeline, watcher, _ = await make_stack()
+        pm = Podmortem(metadata=ObjectMeta(name="pm", namespace="ns"),
+                       spec=PodmortemSpec(ai_analysis_enabled=False))
+        await api.create("Podmortem", pm.to_dict())
+        pod = failed_pod()
+        await api.create("Pod", pod.to_dict())
+        api.set_pod_log("prod", "web-1", "java.lang.OutOfMemoryError: Java heap space")
+        result = await pipeline.process_pod_failure(pod, pm, failure_time="t1")
+        assert result is not None
+        status = (await api.get("Podmortem", "pm", "ns"))["status"]
+        entry = status["recentFailures"][0]
+        assert entry["analysisStatus"] == "PatternOnly"
+        assert "Pattern analysis" in entry["explanation"]
+
+    run(body())
+
+
+def test_pipeline_provider_missing_degrades():
+    async def body():
+        api, pipeline, watcher, metrics = await make_stack()
+        pm = Podmortem(
+            metadata=ObjectMeta(name="pm", namespace="ns"),
+            spec=PodmortemSpec(ai_provider_ref=AIProviderRef(name="ghost", namespace="ns")),
+        )
+        await api.create("Podmortem", pm.to_dict())
+        pod = failed_pod()
+        await api.create("Pod", pod.to_dict())
+        api.set_pod_log("prod", "web-1", "java.lang.NullPointerException")
+        result = await pipeline.process_pod_failure(pod, pm, failure_time="t1")
+        assert result is not None
+        status = (await api.get("Podmortem", "pm", "ns"))["status"]
+        assert status["recentFailures"][0]["analysisStatus"] == "Failed"
+        events = await api.list("Event")
+        reasons = {e["reason"] for e in events}
+        assert "PodmortemAnalysisError" in reasons
+        assert "PodmortemAnalysisComplete" in reasons  # still completed w/ pattern result
+
+    run(body())
+
+
+def test_pipeline_ai_success_and_cache():
+    async def body():
+        api, pipeline, watcher, metrics = await make_stack()
+        provider = AIProvider(metadata=ObjectMeta(name="prov", namespace="ns"),
+                              spec=AIProviderSpec(provider_id="template", model_id="m"))
+        await api.create("AIProvider", provider.to_dict())
+        pm = Podmortem(
+            metadata=ObjectMeta(name="pm", namespace="ns"),
+            spec=PodmortemSpec(ai_provider_ref=AIProviderRef(name="prov", namespace="ns")),
+        )
+        await api.create("Podmortem", pm.to_dict())
+        pod = failed_pod()
+        await api.create("Pod", pod.to_dict())
+        api.set_pod_log("prod", "web-1", "java.lang.OutOfMemoryError: Java heap space")
+        await pipeline.process_pod_failure(pod, pm, failure_time="t1")
+        status = (await api.get("Podmortem", "pm", "ns"))["status"]
+        assert status["recentFailures"][0]["analysisStatus"] == "Analyzed"
+        assert status["recentFailures"][0]["explanation"].startswith("Root Cause:")
+        # second identical failure hits the response cache
+        await pipeline.process_pod_failure(pod, pm, failure_time="t2")
+        assert metrics.counter("ai_cache_hits") == 1
+
+    run(body())
+
+
+def test_pipeline_log_fetch_failure_continues_with_status_evidence():
+    async def body():
+        api, pipeline, watcher, _ = await make_stack()
+        pm = Podmortem(metadata=ObjectMeta(name="pm", namespace="ns"),
+                       spec=PodmortemSpec(ai_analysis_enabled=False))
+        await api.create("Podmortem", pm.to_dict())
+        pod = failed_pod(exit_code=137, reason="OOMKilled")
+        await api.create("Pod", pod.to_dict())
+        from operator_tpu.operator import ApiError
+
+        api.inject_errors("get_log", lambda: ApiError("kubelet unreachable", 500), times=1)
+        result = await pipeline.process_pod_failure(pod, pm, failure_time="t")
+        # no logs, but the synthetic container-status line (reason=OOMKilled,
+        # exit code 137) still matches oom-killed
+        assert result is not None
+        assert any(e.matched_pattern.id == "oom-killed" for e in result.events)
+
+    run(body())
+
+
+# --- reconcilers ----------------------------------------------------------
+
+
+def test_podmortem_reconciler_poll_path_stores():
+    async def body():
+        api, pipeline, watcher, _ = await make_stack()
+        reconciler = PodmortemReconciler(api, pipeline)
+        pm = Podmortem(metadata=ObjectMeta(name="pm", namespace="ns"),
+                       spec=PodmortemSpec(pod_selector=LabelSelector(match_labels={"app": "web"}),
+                                          ai_analysis_enabled=False))
+        await api.create("Podmortem", pm.to_dict())
+        await api.create("Pod", failed_pod().to_dict())
+        await api.create("Pod", healthy_pod().to_dict())
+        api.set_pod_log("prod", "web-1", "Traceback (most recent call last)\nKeyError: 'x'")
+        await reconciler.reconcile(pm)
+        status = (await api.get("Podmortem", "pm", "ns"))["status"]
+        assert status["phase"] == "Ready"
+        # the poll path STORES results (unlike the reference, SURVEY §3.3)
+        assert status["recentFailures"][0]["podName"] == "web-1"
+        # idempotent on second pass (same failureTime)
+        await reconciler.reconcile(pm)
+        status2 = (await api.get("Podmortem", "pm", "ns"))["status"]
+        assert len(status2["recentFailures"]) == 1
+
+    run(body())
+
+
+def test_aiprovider_reconciler_validation():
+    async def body():
+        api = FakeKubeApi()
+        reconciler = AIProviderReconciler(api)
+        good = AIProvider(metadata=ObjectMeta(name="good", namespace="ns"),
+                          spec=AIProviderSpec(provider_id="template"))
+        await api.create("AIProvider", good.to_dict())
+        assert await reconciler.reconcile(good) == "Ready"
+        bad = AIProvider(metadata=ObjectMeta(name="bad", namespace="ns"),
+                         spec=AIProviderSpec(provider_id="openai", model_id="gpt"))  # no apiUrl
+        await api.create("AIProvider", bad.to_dict())
+        assert await reconciler.reconcile(bad) == "Failed"
+        status = (await api.get("AIProvider", "bad", "ns"))["status"]
+        assert "apiUrl" in status["message"]
+        unknown = AIProvider(metadata=ObjectMeta(name="unk", namespace="ns"),
+                             spec=AIProviderSpec(provider_id="quantum", model_id="m"))
+        await api.create("AIProvider", unknown.to_dict())
+        assert await reconciler.reconcile(unknown) == "Failed"
+
+    run(body())
+
+
+def test_shared_dedupe_between_watcher_and_reconciler():
+    async def body():
+        api, pipeline, watcher, _ = await make_stack()
+        reconciler = PodmortemReconciler(api, pipeline)
+        pm = Podmortem(metadata=ObjectMeta(name="pm", namespace="ns"),
+                       spec=PodmortemSpec(pod_selector=LabelSelector(match_labels={"app": "web"}),
+                                          ai_analysis_enabled=False))
+        await api.create("Podmortem", pm.to_dict())
+        pod = failed_pod()
+        await api.create("Pod", pod.to_dict())
+        api.set_pod_log("prod", "web-1", "java.lang.NullPointerException")
+        await watcher.cache.prime()
+        # watcher handles it first
+        assert await watcher.handle_pod_event("MODIFIED", pod) == 1
+        await watcher.drain()
+        # the reconciler sweep must NOT re-analyse the same failureTime
+        await reconciler.reconcile(pm)
+        status = (await api.get("Podmortem", "pm", "ns"))["status"]
+        assert len(status["recentFailures"]) == 1
+
+    run(body())
+
+
+def test_failed_analysis_can_be_retried():
+    async def body():
+        api, pipeline, watcher, _ = await make_stack()
+        pm = Podmortem(metadata=ObjectMeta(name="pm", namespace="ns"),
+                       spec=PodmortemSpec(ai_analysis_enabled=False))
+        await api.create("Podmortem", pm.to_dict())
+        pod = failed_pod()
+        # pod NOT created in the store -> collect fails (NotFound on log+pod)
+        results = await pipeline.process_failure_group(pod, [pm], failure_time="t1")
+        assert results == [None]
+        # the claim was released, so a retry (e.g. next reconcile sweep) works
+        await api.create("Pod", pod.to_dict())
+        api.set_pod_log("prod", "web-1", "java.lang.NullPointerException")
+        results2 = await pipeline.process_failure_group(pod, [pm], failure_time="t1")
+        assert results2 and results2[0] is not None
+
+    run(body())
+
+
+def test_watcher_survives_api_error_not_just_watchclosed():
+    async def body():
+        api, pipeline, watcher, metrics = await make_stack()
+        from operator_tpu.operator import ApiError
+
+        stop = asyncio.Event()
+        # prime will fail once with a transient 500 -> cache must retry, not die
+        api.inject_errors("list", lambda: ApiError("apiserver hiccup", 500), times=1)
+        task = asyncio.create_task(watcher.run(stop))
+        await asyncio.sleep(0.1)
+        pm = Podmortem(metadata=ObjectMeta(name="pm", namespace="ns"),
+                       spec=PodmortemSpec(pod_selector=LabelSelector(match_labels={"app": "web"})))
+        await api.create("Podmortem", pm.to_dict())
+        await asyncio.sleep(0.05)
+        pod = failed_pod()
+        await api.create("Pod", pod.to_dict())
+        await api.patch("Pod", "web-1", "prod", {"metadata": {"labels": {"t": "1"}}})
+        await asyncio.sleep(0.1)
+        await watcher.drain()
+        stop.set()
+        api.close_watches()
+        await asyncio.gather(task, return_exceptions=True)
+        status = (await api.get("Podmortem", "pm", "ns")).get("status") or {}
+        assert status.get("recentFailures"), "cache died on transient ApiError"
+
+    run(body())
+
+
+def test_reconciler_no_status_churn_when_unchanged():
+    async def body():
+        api, pipeline, watcher, _ = await make_stack()
+        reconciler = PodmortemReconciler(api, pipeline)
+        pm = Podmortem(metadata=ObjectMeta(name="pm", namespace="ns"),
+                       spec=PodmortemSpec(pod_selector=LabelSelector(match_labels={"app": "none"})))
+        await api.create("Podmortem", pm.to_dict())
+        await reconciler.reconcile(pm)
+        rv1 = (await api.get("Podmortem", "pm", "ns"))["metadata"]["resourceVersion"]
+        await reconciler.reconcile(pm)
+        await reconciler.reconcile(pm)
+        rv2 = (await api.get("Podmortem", "pm", "ns"))["metadata"]["resourceVersion"]
+        assert rv1 == rv2  # steady state writes nothing
+
+    run(body())
+
+
+# --- openai-compatible provider over a fake transport ----------------------
+
+
+class FakeHTTPResponse:
+    def __init__(self, payload: dict):
+        self._payload = payload
+
+    def read(self):
+        return json.dumps(self._payload).encode()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def test_openai_compat_provider_success_and_retry():
+    async def body():
+        calls = []
+
+        def opener(req, timeout=None):
+            calls.append({"url": req.full_url, "auth": req.headers.get("Authorization"),
+                          "body": json.loads(req.data.decode()), "timeout": timeout})
+            if len(calls) == 1:
+                raise OSError("connection reset")  # first attempt fails -> retry
+            return FakeHTTPResponse({
+                "choices": [{"message": {"content": "Root Cause: A.\nFix: B."}}],
+                "usage": {"prompt_tokens": 10, "completion_tokens": 5},
+            })
+
+        provider = OpenAICompatProvider(opener=opener)
+        from tests.test_operator import make_result
+
+        request = AnalysisRequest(
+            analysis_result=make_result(),
+            provider_config=AIProviderConfig(
+                provider_id="openai", api_url="http://ai.example", model_id="gpt-x",
+                auth_token="tok", max_retries=3, timeout_seconds=7, max_tokens=99,
+            ),
+        )
+        response = await provider.generate(request)
+        assert response.explanation == "Root Cause: A.\nFix: B."
+        assert response.prompt_tokens == 10
+        assert len(calls) == 2
+        assert calls[1]["url"] == "http://ai.example/v1/chat/completions"
+        assert calls[1]["auth"] == "Bearer tok"
+        assert calls[1]["body"]["max_tokens"] == 99
+        assert calls[1]["timeout"] == 7
+
+        # the documented OpenAI base already ends in /v1 — no double prefix
+        request.provider_config.api_url = "https://api.openai.com/v1"
+        await provider.generate(request)
+        assert calls[-1]["url"] == "https://api.openai.com/v1/chat/completions"
+
+    run(body())
+
+
+def test_openai_compat_provider_exhausts_retries():
+    async def body():
+        def opener(req, timeout=None):
+            raise OSError("nope")
+
+        provider = OpenAICompatProvider(opener=opener)
+        from tests.test_operator import make_result
+
+        request = AnalysisRequest(
+            analysis_result=make_result(),
+            provider_config=AIProviderConfig(provider_id="openai", api_url="http://x",
+                                             max_retries=2),
+        )
+        response = await provider.generate(request)
+        assert response.error and "nope" in response.error
+        assert response.explanation is None
+
+    run(body())
