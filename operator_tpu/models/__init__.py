@@ -1,0 +1,29 @@
+"""JAX model implementations (SURVEY.md §7 stages 3-4): the Llama-family
+decoder (TinyLlama-1.1B / Llama-3-8B / Mistral-7B) and, in ``minilm``, the
+sentence-embedding encoder for semantic pattern matching.
+
+Import of this package must not require an accelerator; jax is imported at
+module level but devices are only touched when arrays are created."""
+
+from .configs import (
+    LLAMA_3_8B,
+    MISTRAL_7B,
+    TINY_TEST,
+    TINYLLAMA_1_1B,
+    ModelConfig,
+    get_config,
+    register_config,
+    scaled,
+)
+from .llama import (
+    KVCache,
+    decode_step,
+    forward,
+    init_params,
+    param_count,
+    rms_norm,
+)
+from .loader import convert_hf_state_dict, load_params
+from .tokenizer import ByteTokenizer, HFTokenizer, Tokenizer, load_tokenizer
+
+__all__ = [name for name in dir() if not name.startswith("_")]

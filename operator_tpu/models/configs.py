@@ -1,0 +1,118 @@
+"""Model configurations for the Llama family.
+
+One decoder architecture covers every model the system serves (BASELINE
+configs 2/4/5): RMSNorm + RoPE + grouped-query attention + SiLU-gated MLP.
+Mistral adds a sliding attention window; Llama-3 a larger vocab and RoPE
+theta.  Sizes are from the public model cards / HF config.json files.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    rope_theta: float = 10_000.0
+    rms_norm_eps: float = 1e-5
+    max_seq_len: int = 2048
+    sliding_window: Optional[int] = None  # Mistral-style local attention
+    tie_embeddings: bool = False
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+    def __post_init__(self) -> None:
+        assert self.num_heads % self.num_kv_heads == 0, "heads must divide evenly into kv groups"
+
+
+TINYLLAMA_1_1B = ModelConfig(
+    name="tinyllama-1.1b",
+    vocab_size=32000,
+    hidden_size=2048,
+    intermediate_size=5632,
+    num_layers=22,
+    num_heads=32,
+    num_kv_heads=4,
+    head_dim=64,
+    rope_theta=10_000.0,
+    max_seq_len=2048,
+)
+
+LLAMA_3_8B = ModelConfig(
+    name="llama-3-8b",
+    vocab_size=128256,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=128,
+    rope_theta=500_000.0,
+    max_seq_len=8192,
+)
+
+MISTRAL_7B = ModelConfig(
+    name="mistral-7b",
+    vocab_size=32000,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=128,
+    rope_theta=10_000.0,
+    sliding_window=4096,
+    max_seq_len=8192,
+)
+
+#: small config for tests and the compile-check entry point: real arrays,
+#: real architecture, laptop-sized
+TINY_TEST = ModelConfig(
+    name="tiny-test",
+    vocab_size=512,
+    hidden_size=128,
+    intermediate_size=352,
+    num_layers=3,
+    num_heads=8,
+    num_kv_heads=2,
+    head_dim=16,
+    rope_theta=10_000.0,
+    max_seq_len=256,
+)
+
+_REGISTRY = {
+    cfg.name: cfg for cfg in (TINYLLAMA_1_1B, LLAMA_3_8B, MISTRAL_7B, TINY_TEST)
+}
+
+
+def get_config(name: str) -> ModelConfig:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}") from None
+
+
+def register_config(config: ModelConfig) -> None:
+    _REGISTRY[config.name] = config
+
+
+def scaled(config: ModelConfig, *, num_layers: Optional[int] = None,
+           max_seq_len: Optional[int] = None) -> ModelConfig:
+    """A reduced variant (fewer layers / shorter context) for smoke tests."""
+    kwargs = {}
+    if num_layers is not None:
+        kwargs["num_layers"] = num_layers
+    if max_seq_len is not None:
+        kwargs["max_seq_len"] = max_seq_len
+    return replace(config, name=f"{config.name}-scaled", **kwargs)

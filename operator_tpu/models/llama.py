@@ -1,0 +1,303 @@
+"""Llama-family decoder in pure functional JAX.
+
+TPU-first design decisions (not a port of any torch implementation):
+
+- **scan over layers**: per-layer parameters are stacked along a leading
+  ``num_layers`` axis and the layer loop is ``jax.lax.scan`` — compile time
+  is O(1) in depth and XLA sees one fused layer body;
+- **bfloat16 compute, float32 accumulation** where it matters (RMSNorm mean,
+  softmax, logits) — the MXU natively multiplies bf16 with f32 accumulate;
+- **grouped-query attention without materialising repeated KV**: the query
+  tensor is shaped [B, T, kv_heads, q_per_kv, head_dim] and contracted
+  against [B, S, kv_heads, head_dim] in one einsum, so GQA costs no extra
+  HBM bandwidth;
+- **explicit KV cache** as a pytree of [layers, batch, max_seq, kv_heads,
+  head_dim] arrays updated with ``dynamic_update_slice`` inside the same
+  scan — prefill and decode are the same jitted function at different
+  sequence lengths (the serving engine in ``operator_tpu.serving`` drives
+  it; the paged variant lives in ``operator_tpu.ops.paged_attention``).
+
+Weight layout convention: all projections are stored as ``[in_features,
+out_features]`` so the forward pass is always ``x @ W`` (no transposes at
+run time; the HF checkpoint loader transposes once at load).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .configs import ModelConfig
+
+Params = dict[str, Any]
+
+
+# --------------------------------------------------------------------------
+# parameter init
+# --------------------------------------------------------------------------
+
+
+def init_params(
+    config: ModelConfig, key: jax.Array, dtype: jnp.dtype = jnp.bfloat16
+) -> Params:
+    """Random init with per-layer params stacked on axis 0 for lax.scan."""
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    h, f = config.hidden_size, config.intermediate_size
+    kvh, qh, d = config.num_kv_heads, config.num_heads, config.head_dim
+    n = config.num_layers
+
+    def dense(key: jax.Array, shape: tuple[int, ...]) -> jax.Array:
+        scale = (shape[-2] if len(shape) >= 2 else h) ** -0.5
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+    keys = jax.random.split(k_layers, 7)
+    layers = {
+        "wq": dense(keys[0], (n, h, qh * d)),
+        "wk": dense(keys[1], (n, h, kvh * d)),
+        "wv": dense(keys[2], (n, h, kvh * d)),
+        "wo": dense(keys[3], (n, qh * d, h)),
+        "w_gate": dense(keys[4], (n, h, f)),
+        "w_up": dense(keys[5], (n, h, f)),
+        "w_down": dense(keys[6], (n, f, h)),
+        "ln_attn": jnp.ones((n, h), dtype),
+        "ln_mlp": jnp.ones((n, h), dtype),
+    }
+    params: Params = {
+        "embed": dense(k_embed, (config.vocab_size, h)),
+        "layers": layers,
+        "ln_final": jnp.ones((h,), dtype),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = dense(k_head, (h, config.vocab_size))
+    return params
+
+
+def param_count(params: Params) -> int:
+    return sum(int(p.size) for p in jax.tree_util.tree_leaves(params))
+
+
+# --------------------------------------------------------------------------
+# building blocks
+# --------------------------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    """Float32 accumulation regardless of activation dtype."""
+    x32 = x.astype(jnp.float32)
+    variance = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    normed = x32 * jax.lax.rsqrt(variance + eps)
+    return (normed * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def rope_frequencies(config: ModelConfig) -> jax.Array:
+    """Inverse frequencies [head_dim // 2] (HF half-rotation convention)."""
+    d = config.head_dim
+    exponents = jnp.arange(0, d, 2, dtype=jnp.float32) / d
+    return 1.0 / (config.rope_theta**exponents)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, inv_freq: jax.Array) -> jax.Array:
+    """x: [B, T, ..., head_dim]; positions: [B, T] — HF ``rotate_half``."""
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, T, d/2]
+    cos = jnp.cos(angles)
+    sin = jnp.sin(angles)
+    # broadcast over any head axes between T and head_dim
+    extra_axes = x.ndim - 3
+    for _ in range(extra_axes):
+        cos = cos[:, :, None]
+        sin = sin[:, :, None]
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+def make_causal_mask(
+    q_positions: jax.Array,
+    kv_positions: jax.Array,
+    kv_valid: jax.Array,
+    *,
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    """[B, Tq, S] boolean mask: causal + validity + optional sliding window.
+
+    ``q_positions``: [B, Tq] absolute positions of the query tokens;
+    ``kv_positions``: [B, S] absolute positions of cache slots;
+    ``kv_valid``: [B, S] whether the slot holds a real token.
+    """
+    causal = kv_positions[:, None, :] <= q_positions[:, :, None]
+    mask = causal & kv_valid[:, None, :]
+    if sliding_window is not None:
+        recent = kv_positions[:, None, :] > (q_positions[:, :, None] - sliding_window)
+        mask = mask & recent
+    return mask
+
+
+# --------------------------------------------------------------------------
+# KV cache
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class KVCache:
+    """Contiguous per-layer cache (the paged variant lives in ops/)."""
+
+    k: jax.Array  # [layers, B, max_seq, kv_heads, head_dim]
+    v: jax.Array  # [layers, B, max_seq, kv_heads, head_dim]
+
+    @classmethod
+    def create(
+        cls,
+        config: ModelConfig,
+        batch_size: int,
+        max_seq_len: Optional[int] = None,
+        dtype: jnp.dtype = jnp.bfloat16,
+    ) -> "KVCache":
+        shape = (
+            config.num_layers,
+            batch_size,
+            max_seq_len or config.max_seq_len,
+            config.num_kv_heads,
+            config.head_dim,
+        )
+        return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+jax.tree_util.register_pytree_node(
+    KVCache,
+    lambda cache: ((cache.k, cache.v), None),
+    lambda _, children: KVCache(k=children[0], v=children[1]),
+)
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+
+def _attention(
+    q: jax.Array,  # [B, T, QH, D]
+    k: jax.Array,  # [B, S, KH, D]
+    v: jax.Array,  # [B, S, KH, D]
+    mask: jax.Array,  # [B, T, S] bool
+    config: ModelConfig,
+) -> jax.Array:
+    b, t, qh, d = q.shape
+    kh = config.num_kv_heads
+    g = config.q_per_kv
+    q_grouped = q.reshape(b, t, kh, g, d)
+    # [B, KH, G, T, S] with f32 accumulation on the MXU
+    scores = jnp.einsum(
+        "btkgd,bskd->bkgts", q_grouped, k, preferred_element_type=jnp.float32
+    )
+    scores = scores * (d**-0.5)
+    scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, v)
+    return out.reshape(b, t, qh * d)
+
+
+def forward(
+    params: Params,
+    config: ModelConfig,
+    token_ids: jax.Array,  # [B, T] int32
+    positions: jax.Array,  # [B, T] int32 absolute positions
+    cache: Optional[KVCache] = None,
+    cache_offset: int | jax.Array = 0,
+    attn_mask: Optional[jax.Array] = None,  # [B, T, S]; default causal
+) -> tuple[jax.Array, Optional[KVCache]]:
+    """One decoder pass.
+
+    Without a cache: plain causal self-attention over the T tokens (training
+    / parity testing).  With a cache: the T tokens are written at
+    ``cache_offset`` and attend over the whole cache (prefill writes many,
+    decode writes one — same code path).
+
+    Returns (logits [B, T, vocab] float32, updated cache or None).
+    """
+    inv_freq = rope_frequencies(config)
+    x = jnp.take(params["embed"], token_ids, axis=0)  # [B, T, H]
+    b, t, h = x.shape
+
+    use_cache = cache is not None
+    if use_cache:
+        max_seq = cache.k.shape[2]
+        kv_positions = jnp.broadcast_to(jnp.arange(max_seq, dtype=jnp.int32)[None], (b, max_seq))
+        if attn_mask is None:
+            limit = jnp.asarray(cache_offset, jnp.int32) + t
+            kv_valid = kv_positions < limit
+            attn_mask = make_causal_mask(
+                positions, kv_positions, kv_valid, sliding_window=config.sliding_window
+            )
+    else:
+        if attn_mask is None:
+            kv_valid = jnp.ones((b, t), bool)
+            attn_mask = make_causal_mask(
+                positions, positions, kv_valid, sliding_window=config.sliding_window
+            )
+
+    layers = params["layers"]
+
+    def layer_step(carry: jax.Array, scanned: dict[str, jax.Array]):
+        x = carry
+        weights, layer_cache = scanned["w"], scanned.get("cache")
+        # -- attention ---------------------------------------------------
+        attn_in = rms_norm(x, weights["ln_attn"], config.rms_norm_eps)
+        q = (attn_in @ weights["wq"]).reshape(b, t, config.num_heads, config.head_dim)
+        k = (attn_in @ weights["wk"]).reshape(b, t, config.num_kv_heads, config.head_dim)
+        v = (attn_in @ weights["wv"]).reshape(b, t, config.num_kv_heads, config.head_dim)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        if layer_cache is not None:
+            offset = jnp.asarray(cache_offset, jnp.int32)
+            k_all = jax.lax.dynamic_update_slice(
+                layer_cache["k"], k.astype(layer_cache["k"].dtype), (0, offset, 0, 0)
+            )
+            v_all = jax.lax.dynamic_update_slice(
+                layer_cache["v"], v.astype(layer_cache["v"].dtype), (0, offset, 0, 0)
+            )
+            new_cache = {"k": k_all, "v": v_all}
+        else:
+            k_all, v_all = k, v
+            new_cache = None
+        attn = _attention(q, k_all.astype(q.dtype), v_all.astype(q.dtype), attn_mask, config)
+        x = x + attn @ weights["wo"]
+        # -- mlp ----------------------------------------------------------
+        mlp_in = rms_norm(x, weights["ln_mlp"], config.rms_norm_eps)
+        gate = jax.nn.silu(mlp_in @ weights["w_gate"])
+        up = mlp_in @ weights["w_up"]
+        x = x + (gate * up) @ weights["w_down"]
+        return x, new_cache
+
+    if use_cache:
+        scanned_in = {"w": layers, "cache": {"k": cache.k, "v": cache.v}}
+        x, cache_out = jax.lax.scan(
+            lambda carry, s: layer_step(carry, s), x, scanned_in
+        )
+        new_cache = KVCache(k=cache_out["k"], v=cache_out["v"])
+    else:
+        x, _ = jax.lax.scan(lambda carry, s: (layer_step(carry, {"w": s})[0], None), x, layers)
+        new_cache = None
+
+    x = rms_norm(x, params["ln_final"], config.rms_norm_eps)
+    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bth,hv->btv", x, head, preferred_element_type=jnp.float32)
+    return logits, new_cache
+
+
+def decode_step(
+    params: Params,
+    config: ModelConfig,
+    token_ids: jax.Array,  # [B, 1]
+    positions: jax.Array,  # [B, 1]
+    cache: KVCache,
+    cache_offset: jax.Array,
+) -> tuple[jax.Array, KVCache]:
+    """Single-token decode (jit once, call per step)."""
+    logits, new_cache = forward(
+        params, config, token_ids, positions, cache=cache, cache_offset=cache_offset
+    )
+    return logits[:, -1, :], new_cache

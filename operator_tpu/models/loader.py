@@ -1,0 +1,195 @@
+"""Checkpoint loading: HF-format weights -> our stacked JAX param pytree.
+
+Sources:
+- a local directory of ``*.safetensors`` files (with or without the
+  ``model.safetensors.index.json`` shard index) in Hugging Face Llama
+  layout, or
+- any in-memory mapping of HF parameter names to arrays (used by the parity
+  tests, which convert a freshly-initialised ``transformers`` model).
+
+The HF layout stores projections as ``[out_features, in_features]``; we
+transpose once at load so runtime is always ``x @ W`` (llama.py docstring),
+and stack the per-layer tensors along a leading axis for ``lax.scan``.
+
+The rebuild's "checkpoint restore" is loading weights into TPU HBM
+(SURVEY.md §5 checkpoint entry): tensors stream lazily out of the shard
+files, each stacked layer group is placed on device (optionally straight to
+its mesh sharding) the moment its last layer arrives, and the host copies
+are freed — peak host memory is the not-yet-complete groups plus one stack
+temporary, not 2x the model.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+from typing import Any, Callable, Iterable, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .configs import ModelConfig
+from .llama import Params
+
+log = logging.getLogger(__name__)
+
+_LAYER_RE = re.compile(r"model\.layers\.(\d+)\.(.+)\.weight")
+
+#: HF sub-name -> (our stacked name, transpose?)
+_LAYER_MAP = {
+    "self_attn.q_proj": ("wq", True),
+    "self_attn.k_proj": ("wk", True),
+    "self_attn.v_proj": ("wv", True),
+    "self_attn.o_proj": ("wo", True),
+    "mlp.gate_proj": ("w_gate", True),
+    "mlp.up_proj": ("w_up", True),
+    "mlp.down_proj": ("w_down", True),
+    "input_layernorm": ("ln_attn", False),
+    "post_attention_layernorm": ("ln_mlp", False),
+}
+
+
+def _to_numpy(value: Any) -> np.ndarray:
+    """Accept numpy / jax arrays and torch tensors (incl. bfloat16)."""
+    if isinstance(value, np.ndarray):
+        return value
+    if hasattr(value, "detach"):  # torch tensor, without importing torch here
+        value = value.detach()
+        if str(value.dtype) == "torch.bfloat16":
+            return value.to(dtype=__import__("torch").float32).cpu().numpy()
+        return value.cpu().numpy()
+    return np.asarray(value)
+
+
+def convert_hf_state_dict(
+    state: "Mapping[str, Any] | Iterable[tuple[str, Any]]",
+    config: ModelConfig,
+    dtype: jnp.dtype = jnp.bfloat16,
+    *,
+    put: Optional[Callable[[str, np.ndarray], jax.Array]] = None,
+) -> Params:
+    """Map HF Llama names to the stacked pytree ``llama.init_params`` uses.
+
+    ``state`` may be a dict (e.g. a torch ``state_dict()``) or a lazy
+    ``(name, tensor)`` iterable (``iter_safetensors``).  ``put(name, array)``
+    controls device placement (default: jnp.asarray with ``dtype``); native
+    checkpoint dtypes are preserved until ``put`` converts them.
+    """
+    if put is None:
+        def put(name: str, array: np.ndarray) -> jax.Array:  # noqa: ANN001
+            return jnp.asarray(array, dtype)
+
+    n = config.num_layers
+    per_layer: dict[str, list[Optional[np.ndarray]]] = {
+        ours: [None] * n for ours, _ in _LAYER_MAP.values()
+    }
+    filled: dict[str, int] = {ours: 0 for ours in per_layer}
+    layers: dict[str, jax.Array] = {}
+    top: dict[str, jax.Array] = {}
+    items = state.items() if hasattr(state, "items") else state
+    for name, raw in items:
+        if name == "model.embed_tokens.weight":
+            top["embed"] = put("embed", _to_numpy(raw))
+        elif name == "model.norm.weight":
+            top["ln_final"] = put("ln_final", _to_numpy(raw))
+        elif name == "lm_head.weight":
+            top["lm_head"] = put("lm_head", _to_numpy(raw).T)
+        else:
+            match = _LAYER_RE.fullmatch(name)
+            if not match:
+                log.debug("ignoring unknown checkpoint tensor %s", name)
+                continue
+            idx, sub = int(match.group(1)), match.group(2)
+            mapped = _LAYER_MAP.get(sub)
+            if mapped is None:
+                log.debug("ignoring unknown layer tensor %s", name)
+                continue
+            ours, transpose = mapped
+            if idx >= n:
+                continue  # scaled-down config loads a prefix of the layers
+            array = _to_numpy(raw)
+            per_layer[ours][idx] = array.T if transpose else array
+            filled[ours] += 1
+            if filled[ours] == n:
+                # group complete: stack (native dtype), place, free host refs
+                layers[ours] = put(ours, np.stack(per_layer[ours]))
+                per_layer[ours] = []
+
+    missing = [
+        f"{ours}[{i}]"
+        for ours, slots in per_layer.items()
+        if ours not in layers
+        for i, s in enumerate(slots)
+        if s is None
+    ]
+    if missing:
+        raise ValueError(f"checkpoint is missing {len(missing)} tensors, e.g. {missing[:4]}")
+    params: Params = {"embed": top["embed"], "layers": layers, "ln_final": top["ln_final"]}
+    if config.tie_embeddings:
+        if "lm_head" in top:
+            log.info("config ties embeddings; ignoring checkpoint lm_head")
+    else:
+        if "lm_head" not in top:
+            raise ValueError("checkpoint has no lm_head.weight but config does not tie embeddings")
+        params["lm_head"] = top["lm_head"]
+    return params
+
+
+# --------------------------------------------------------------------------
+# safetensors directory loading
+# --------------------------------------------------------------------------
+
+
+def iter_safetensors(checkpoint_dir: str):
+    """Yield ``(name, tensor)`` lazily across all shard files, so the loader
+    holds at most the layer tensors not yet flushed to device (completed
+    groups are stacked + placed + freed as soon as their last layer
+    arrives — see convert_hf_state_dict)."""
+    from safetensors import safe_open
+
+    index_path = os.path.join(checkpoint_dir, "model.safetensors.index.json")
+    files: list[str]
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            index = json.load(f)
+        files = sorted({os.path.join(checkpoint_dir, v) for v in index["weight_map"].values()})
+    else:
+        files = sorted(
+            os.path.join(checkpoint_dir, f)
+            for f in os.listdir(checkpoint_dir)
+            if f.endswith(".safetensors")
+        )
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {checkpoint_dir}")
+
+    for path in files:
+        with safe_open(path, framework="np") as f:
+            for name in f.keys():
+                yield name, f.get_tensor(name)
+
+
+def load_params(
+    checkpoint_dir: str,
+    config: ModelConfig,
+    dtype: jnp.dtype = jnp.bfloat16,
+    *,
+    shardings: Optional[Mapping[str, jax.sharding.Sharding]] = None,
+) -> Params:
+    """Load a HF Llama checkpoint directory onto device.
+
+    ``shardings`` optionally maps our param names (embed/lm_head/ln_final or
+    stacked layer names wq/wk/...) to ``jax.sharding.Sharding``s so each
+    tensor goes straight to its mesh placement (the TP path for Llama-3-8B
+    on v5e-4, BASELINE config ladder)."""
+    state = iter_safetensors(checkpoint_dir)
+
+    def put(name: str, array: np.ndarray) -> jax.Array:
+        value = jnp.asarray(array, dtype)
+        if shardings and name in shardings:
+            value = jax.device_put(value, shardings[name])
+        return value
+
+    return convert_hf_state_dict(state, config, dtype, put=put)

@@ -1,0 +1,84 @@
+"""Tokenizers.
+
+Two implementations behind one minimal interface (encode/decode/ids):
+
+- ``HFTokenizer`` wraps a local ``transformers`` tokenizer directory (the
+  production path for TinyLlama / Llama-3 / Mistral checkpoints on the PVC);
+- ``ByteTokenizer`` is a dependency-free byte-level fallback (vocab 256 + a
+  few specials) used by tests and air-gapped environments — this repo's CI
+  has zero egress, so nothing may require a hub download.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Protocol, Sequence
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+    bos_id: Optional[int]
+    eos_id: Optional[int]
+    pad_id: int
+
+    def encode(self, text: str, *, add_bos: bool = True) -> list[int]: ...
+
+    def decode(self, ids: Sequence[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 bytes shifted by the special-token block."""
+
+    SPECIALS = 3  # pad=0, bos=1, eos=2
+
+    def __init__(self) -> None:
+        self.pad_id = 0
+        self.bos_id = 1
+        self.eos_id = 2
+        self.vocab_size = 256 + self.SPECIALS
+
+    def encode(self, text: str, *, add_bos: bool = True) -> list[int]:
+        ids = [b + self.SPECIALS for b in text.encode("utf-8")]
+        return ([self.bos_id] if add_bos else []) + ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i - self.SPECIALS for i in ids if i >= self.SPECIALS)
+        return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """Wrapper over a *local* transformers tokenizer (no hub access)."""
+
+    def __init__(self, path: str) -> None:
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        # len(tokenizer) includes added/special tokens (Llama-3 puts bos at
+        # 128000, beyond tokenizer.vocab_size=128000's base vocab)
+        self.vocab_size = int(len(self._tok))
+        self.bos_id = self._tok.bos_token_id
+        self.eos_id = self._tok.eos_token_id
+        pad = self._tok.pad_token_id
+        self.pad_id = int(pad if pad is not None else (self.eos_id or 0))
+
+    def encode(self, text: str, *, add_bos: bool = True) -> list[int]:
+        ids = self._tok.encode(text, add_special_tokens=False)
+        if add_bos and self.bos_id is not None:
+            ids = [self.bos_id] + ids
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+
+def load_tokenizer(path: Optional[str]) -> Tokenizer:
+    """Local tokenizer dir if given and loadable, else the byte fallback."""
+    if path:
+        try:
+            return HFTokenizer(path)
+        except Exception:  # noqa: BLE001 - degrade to bytes
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "failed to load tokenizer from %s; using byte fallback", path, exc_info=True
+            )
+    return ByteTokenizer()

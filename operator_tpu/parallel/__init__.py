@@ -1,0 +1,20 @@
+"""Mesh / sharding layer (SURVEY.md §2.3, §7 stage 4): DP + TP + FSDP over
+ICI via jax.sharding, multi-host over DCN via jax.distributed."""
+
+from .mesh import (
+    AXES,
+    MeshPlan,
+    batch_spec,
+    initialize_distributed,
+    kv_cache_spec,
+    logits_spec,
+    make_mesh,
+    mesh_summary,
+    param_shardings,
+    param_specs,
+    plan_for,
+    shard_params,
+)
+from .train import TrainState, make_optimizer, make_train_step, next_token_loss
+
+__all__ = [name for name in dir() if not name.startswith("_")]

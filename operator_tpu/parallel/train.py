@@ -1,0 +1,93 @@
+"""Sharded training step — fine-tuning support for the explanation models.
+
+The serving path is inference, but the framework carries a real training
+loop so explanation models can be adapted on recorded failure/explanation
+pairs (the reference has no equivalent; its models are frozen API calls).
+The step is a single ``jax.jit`` over the mesh: batch sharded on (dp, fsdp),
+params on (fsdp, tp) per ``mesh.param_specs`` — XLA emits the
+reduce-scatter/all-gather pattern over ICI from the sharding constraints
+alone (the scaling-book recipe; no hand-written collectives).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding
+
+from ..models.configs import ModelConfig
+from ..models.llama import Params, forward
+from .mesh import batch_spec, param_shardings
+
+
+@dataclass
+class TrainState:
+    params: Params
+    opt_state: Any
+    step: jax.Array
+
+
+def next_token_loss(
+    params: Params,
+    config: ModelConfig,
+    token_ids: jax.Array,  # [B, T]
+    loss_mask: jax.Array,  # [B, T] 1.0 where the target counts
+) -> jax.Array:
+    """Mean next-token cross-entropy (float32 logits; stable logsumexp)."""
+    b, t = token_ids.shape
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
+    logits, _ = forward(params, config, token_ids, positions)
+    targets = token_ids[:, 1:]
+    logits = logits[:, :-1]
+    mask = loss_mask[:, 1:].astype(jnp.float32)
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logprobs, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return -(picked * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def make_optimizer(learning_rate: float = 1e-4, weight_decay: float = 0.01) -> optax.GradientTransformation:
+    return optax.adamw(learning_rate, b1=0.9, b2=0.95, weight_decay=weight_decay)
+
+
+def make_train_step(
+    config: ModelConfig,
+    mesh: Mesh,
+    optimizer: Optional[optax.GradientTransformation] = None,
+):
+    """Returns (init_state, train_step) both jitted over the mesh."""
+    optimizer = optimizer or make_optimizer()
+    p_shardings = param_shardings(mesh, config)
+    data_sharding = NamedSharding(mesh, batch_spec())
+
+    def init_state(params: Params) -> TrainState:
+        return TrainState(params=params, opt_state=optimizer.init(params),
+                          step=jnp.zeros((), jnp.int32))
+
+    @partial(
+        jax.jit,
+        in_shardings=(None, data_sharding, data_sharding),
+        donate_argnums=(0,),
+    )
+    def train_step(state: TrainState, token_ids: jax.Array, loss_mask: jax.Array):
+        loss, grads = jax.value_and_grad(next_token_loss)(
+            state.params, config, token_ids, loss_mask
+        )
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        # keep the placement stable across steps
+        new_params = jax.lax.with_sharding_constraint(new_params, p_shardings)
+        return TrainState(new_params, new_opt, state.step + 1), loss
+
+    return init_state, train_step
+
+
+jax.tree_util.register_pytree_node(
+    TrainState,
+    lambda s: ((s.params, s.opt_state, s.step), None),
+    lambda _, c: TrainState(params=c[0], opt_state=c[1], step=c[2]),
+)

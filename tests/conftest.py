@@ -17,3 +17,16 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    # Some environments register an experimental TPU plugin that ignores
+    # JAX_PLATFORMS=cpu; pin the default device to CPU so unit tests are
+    # hermetic and fast (perf runs opt into the TPU explicitly).
+    try:
+        import jax
+
+        cpu_devices = jax.devices("cpu")
+        jax.config.update("jax_default_device", cpu_devices[0])
+    except Exception:  # pragma: no cover - jax genuinely unavailable
+        pass

@@ -1,0 +1,126 @@
+"""Mesh/sharding tests on the 8-device virtual CPU mesh: plan selection,
+sharded-vs-single-device forward equivalence, and the jitted train step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from operator_tpu.models import TINY_TEST, get_config, init_params
+from operator_tpu.models.llama import forward
+from operator_tpu.parallel import (
+    MeshPlan,
+    make_mesh,
+    make_train_step,
+    mesh_summary,
+    param_specs,
+    plan_for,
+    shard_params,
+)
+
+
+def cpu_devices(n=8):
+    devices = jax.devices("cpu")
+    if len(devices) < n:
+        pytest.skip(f"need {n} cpu devices, have {len(devices)}")
+    return devices[:n]
+
+
+# --- planning -------------------------------------------------------------
+
+
+def test_plan_defaults_to_dp():
+    plan = plan_for(8)
+    assert plan == MeshPlan(dp=8, fsdp=1, tp=1)
+
+
+def test_plan_llama3_8b_needs_tp_on_v5e():
+    # bf16 8B ≈ 16 GB > 14 GB budget -> tp=2; kv_heads=8 divisible ✓
+    plan = plan_for(4, config=get_config("llama-3-8b"))
+    assert plan.tp >= 2
+    assert plan.total == 4
+
+
+def test_plan_small_model_stays_dp():
+    plan = plan_for(8, config=get_config("tinyllama-1.1b"))
+    assert plan.tp == 1 and plan.dp == 8
+
+
+def test_plan_rejects_oversubscription():
+    with pytest.raises(ValueError):
+        plan_for(4, tp=4, fsdp=2)
+
+
+def test_param_specs_cover_all_params():
+    params = init_params(TINY_TEST, jax.random.PRNGKey(0))
+    specs = param_specs(TINY_TEST)
+    # same tree structure -> every param has a placement rule
+    jax.tree_util.tree_map(lambda p, s: None, params, specs)
+
+
+# --- sharded execution ----------------------------------------------------
+
+
+def test_sharded_forward_matches_single_device():
+    devices = cpu_devices(8)
+    mesh = make_mesh(MeshPlan(dp=2, fsdp=2, tp=2), devices)
+    config = TINY_TEST
+    params = init_params(config, jax.random.PRNGKey(0), dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, config.vocab_size,
+                                dtype=jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(16, dtype=jnp.int32)[None], (4, 16))
+
+    ref_logits, _ = forward(params, config, tokens, positions)
+
+    sharded = shard_params(params, mesh, config)
+    # params are actually distributed
+    wq_sharding = sharded["layers"]["wq"].sharding
+    assert not wq_sharding.is_fully_replicated
+    logits, _ = jax.jit(lambda p, t, pos: forward(p, config, t, pos))(sharded, tokens, positions)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4)
+    print(mesh_summary(mesh))
+
+
+def test_train_step_learns_and_stays_sharded():
+    devices = cpu_devices(8)
+    mesh = make_mesh(MeshPlan(dp=4, fsdp=1, tp=2), devices)
+    config = TINY_TEST
+    params = init_params(config, jax.random.PRNGKey(0), dtype=jnp.float32)
+    params = shard_params(params, mesh, config)
+    init_state, train_step = make_train_step(config, mesh)
+    state = init_state(params)
+
+    # a fixed tiny batch: loss must drop when repeatedly trained on it
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (4, 32), 0, config.vocab_size,
+                                dtype=jnp.int32)
+    mask = jnp.ones((4, 32), jnp.float32)
+    losses = []
+    for _ in range(5):
+        state, loss = train_step(state, tokens, mask)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], f"loss did not drop: {losses}"
+    wq_sharding = state.params["layers"]["wq"].sharding
+    assert not wq_sharding.is_fully_replicated  # constraint kept placement
+
+
+def test_dryrun_multichip_entry():
+    cpu_devices(8)
+    import __graft_entry__ as entrypoints
+
+    entrypoints.dryrun_multichip(8)
+
+
+def test_entry_compiles_tiny():
+    import os
+
+    os.environ["GRAFT_ENTRY_MODEL"] = "tiny-test"
+    try:
+        import __graft_entry__ as entrypoints
+
+        fn, args = entrypoints.entry()
+        lowered = jax.jit(fn).lower(*args)
+        compiled = lowered.compile()
+        out = compiled(*args)
+        assert out.shape == (1, 128, 512)
+    finally:
+        os.environ.pop("GRAFT_ENTRY_MODEL", None)
