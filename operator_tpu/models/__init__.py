@@ -1,6 +1,6 @@
 """JAX model implementations (SURVEY.md §7 stages 3-4): the Llama-family
-decoder (TinyLlama-1.1B / Llama-3-8B / Mistral-7B) and, in ``minilm``, the
-sentence-embedding encoder for semantic pattern matching.
+decoder (TinyLlama-1.1B / Llama-3-8B / Mistral-7B) and, in ``encoder``, the
+MiniLM-class sentence-embedding encoder for semantic pattern matching.
 
 Import of this package must not require an accelerator; jax is imported at
 module level but devices are only touched when arrays are created."""

@@ -1,0 +1,257 @@
+"""BERT-style sentence encoder (all-MiniLM-L6-v2 class) in functional JAX.
+
+The semantic pattern path (SURVEY.md §7 stage 3) embeds log windows and
+pattern descriptions into one vector space; this is the encoder that does
+it.  Architecture per the public MiniLM config (6 post-LN transformer
+layers, hidden 384, 12 heads, GELU MLP), with the sentence-transformers
+convention on top: masked mean pooling then L2 normalisation, so cosine
+similarity is a dot product and the similarity kernel
+(ops/similarity.py) needs no extra normalisation pass.
+
+Same TPU-first choices as the decoder (models/llama.py): per-layer params
+stacked on a leading axis and scanned with ``lax.scan``; bf16 matmuls with
+f32 accumulation; LayerNorm statistics in f32.
+
+Reference-system context: the external log-parser service owned all
+scoring (reference LogParserRestClient.java:37-39); its rebuilt semantic
+scorer runs this encoder on TPU instead of calling out.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any, Iterable, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+
+@dataclass(frozen=True)
+class EncoderConfig:
+    name: str
+    vocab_size: int = 30522
+    hidden_size: int = 384
+    intermediate_size: int = 1536
+    num_layers: int = 6
+    num_heads: int = 12
+    max_positions: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+MINILM_L6 = EncoderConfig(name="minilm-l6")
+
+#: laptop-sized config for tests (real architecture, tiny widths)
+ENCODER_TINY_TEST = EncoderConfig(
+    name="encoder-tiny-test",
+    vocab_size=512,
+    hidden_size=64,
+    intermediate_size=128,
+    num_layers=2,
+    num_heads=4,
+    max_positions=128,
+)
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+
+def init_encoder_params(
+    config: EncoderConfig, key: jax.Array, dtype: jnp.dtype = jnp.float32
+) -> Params:
+    h, f, n = config.hidden_size, config.intermediate_size, config.num_layers
+    keys = jax.random.split(key, 12)
+
+    def dense(k: jax.Array, shape: tuple[int, ...]) -> jax.Array:
+        scale = shape[-2] ** -0.5 if len(shape) >= 2 else 0.02
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    layers = {
+        "wq": dense(keys[0], (n, h, h)),
+        "bq": jnp.zeros((n, h), dtype),
+        "wk": dense(keys[1], (n, h, h)),
+        "bk": jnp.zeros((n, h), dtype),
+        "wv": dense(keys[2], (n, h, h)),
+        "bv": jnp.zeros((n, h), dtype),
+        "wo": dense(keys[3], (n, h, h)),
+        "bo": jnp.zeros((n, h), dtype),
+        "ln_attn_scale": jnp.ones((n, h), dtype),
+        "ln_attn_bias": jnp.zeros((n, h), dtype),
+        "w_in": dense(keys[4], (n, h, f)),
+        "b_in": jnp.zeros((n, f), dtype),
+        "w_out": dense(keys[5], (n, f, h)),
+        "b_out": jnp.zeros((n, h), dtype),
+        "ln_mlp_scale": jnp.ones((n, h), dtype),
+        "ln_mlp_bias": jnp.zeros((n, h), dtype),
+    }
+    return {
+        "tok_embed": dense(keys[6], (config.vocab_size, h)),
+        "pos_embed": dense(keys[7], (config.max_positions, h)),
+        "type_embed": dense(keys[8], (config.type_vocab_size, h)),
+        "ln_embed_scale": jnp.ones((h,), dtype),
+        "ln_embed_bias": jnp.zeros((h,), dtype),
+        "layers": layers,
+    }
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    normed = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (normed * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def encode_tokens(
+    params: Params,
+    config: EncoderConfig,
+    token_ids: jax.Array,  # [B, T] int32
+    attention_mask: jax.Array,  # [B, T] 1 for real tokens
+) -> jax.Array:
+    """Token-level hidden states [B, T, H] (post-LN BERT stack)."""
+    b, t = token_ids.shape
+    positions = jnp.arange(t, dtype=jnp.int32)
+    x = (
+        jnp.take(params["tok_embed"], token_ids, axis=0)
+        + params["pos_embed"][None, :t]
+        + params["type_embed"][0][None, None, :]
+    )
+    x = _layer_norm(x, params["ln_embed_scale"], params["ln_embed_bias"], config.layer_norm_eps)
+    del positions
+
+    nh, d = config.num_heads, config.head_dim
+    # additive mask [B, 1, 1, T] — padded keys get -inf before softmax
+    bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e30).astype(jnp.float32)
+
+    def layer_step(x: jax.Array, w: dict[str, jax.Array]):
+        q = (x @ w["wq"] + w["bq"]).reshape(b, t, nh, d)
+        k = (x @ w["wk"] + w["bk"]).reshape(b, t, nh, d)
+        v = (x @ w["wv"] + w["bv"]).reshape(b, t, nh, d)
+        scores = jnp.einsum("bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32)
+        scores = scores * (d**-0.5) + bias
+        probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+        attn = jnp.einsum("bhts,bshd->bthd", probs, v).reshape(b, t, nh * d)
+        x = _layer_norm(
+            x + attn @ w["wo"] + w["bo"], w["ln_attn_scale"], w["ln_attn_bias"],
+            config.layer_norm_eps,
+        )
+        mlp = jax.nn.gelu(x @ w["w_in"] + w["b_in"], approximate=False)
+        x = _layer_norm(
+            x + mlp @ w["w_out"] + w["b_out"], w["ln_mlp_scale"], w["ln_mlp_bias"],
+            config.layer_norm_eps,
+        )
+        return x, None
+
+    x, _ = jax.lax.scan(layer_step, x, params["layers"])
+    return x
+
+
+def encode(
+    params: Params,
+    config: EncoderConfig,
+    token_ids: jax.Array,
+    attention_mask: jax.Array,
+) -> jax.Array:
+    """Sentence embeddings [B, H]: masked mean pool + L2 normalise."""
+    hidden = encode_tokens(params, config, token_ids, attention_mask)
+    mask = attention_mask[..., None].astype(jnp.float32)
+    summed = jnp.sum(hidden.astype(jnp.float32) * mask, axis=1)
+    counts = jnp.maximum(jnp.sum(mask, axis=1), 1.0)
+    pooled = summed / counts
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12)
+
+
+# ---------------------------------------------------------------------------
+# HF BERT checkpoint conversion (all-MiniLM-L6-v2 layout)
+# ---------------------------------------------------------------------------
+
+_BERT_LAYER_RE = re.compile(r"(?:bert\.)?encoder\.layer\.(\d+)\.(.+)")
+
+#: HF sub-name -> (our stacked name, transpose?)
+_BERT_LAYER_MAP = {
+    "attention.self.query.weight": ("wq", True),
+    "attention.self.query.bias": ("bq", False),
+    "attention.self.key.weight": ("wk", True),
+    "attention.self.key.bias": ("bk", False),
+    "attention.self.value.weight": ("wv", True),
+    "attention.self.value.bias": ("bv", False),
+    "attention.output.dense.weight": ("wo", True),
+    "attention.output.dense.bias": ("bo", False),
+    "attention.output.LayerNorm.weight": ("ln_attn_scale", False),
+    "attention.output.LayerNorm.bias": ("ln_attn_bias", False),
+    "intermediate.dense.weight": ("w_in", True),
+    "intermediate.dense.bias": ("b_in", False),
+    "output.dense.weight": ("w_out", True),
+    "output.dense.bias": ("b_out", False),
+    "output.LayerNorm.weight": ("ln_mlp_scale", False),
+    "output.LayerNorm.bias": ("ln_mlp_bias", False),
+}
+
+_BERT_TOP_MAP = {
+    "embeddings.word_embeddings.weight": "tok_embed",
+    "embeddings.position_embeddings.weight": "pos_embed",
+    "embeddings.token_type_embeddings.weight": "type_embed",
+    "embeddings.LayerNorm.weight": "ln_embed_scale",
+    "embeddings.LayerNorm.bias": "ln_embed_bias",
+}
+
+
+def convert_hf_bert_state_dict(
+    state: "Mapping[str, Any] | Iterable[tuple[str, Any]]",
+    config: EncoderConfig,
+    dtype: jnp.dtype = jnp.float32,
+) -> Params:
+    """Map a HF BERT state dict to the stacked pytree ``encode`` uses."""
+    import numpy as np
+
+    from .loader import _to_numpy
+
+    n = config.num_layers
+    per_layer: dict[str, list[Optional[Any]]] = {
+        ours: [None] * n for ours, _ in _BERT_LAYER_MAP.values()
+    }
+    top: dict[str, jax.Array] = {}
+    items = state.items() if hasattr(state, "items") else state
+    for name, raw in items:
+        bare = name.removeprefix("bert.")
+        if bare in _BERT_TOP_MAP:
+            top[_BERT_TOP_MAP[bare]] = jnp.asarray(_to_numpy(raw), dtype)
+            continue
+        match = _BERT_LAYER_RE.fullmatch(name)
+        if not match:
+            continue
+        idx, sub = int(match.group(1)), match.group(2)
+        mapped = _BERT_LAYER_MAP.get(sub)
+        if mapped is None or idx >= n:
+            continue
+        ours, transpose = mapped
+        array = _to_numpy(raw)
+        per_layer[ours][idx] = array.T if transpose else array
+
+    missing = [
+        f"{ours}[{i}]"
+        for ours, slots in per_layer.items()
+        for i, s in enumerate(slots)
+        if s is None
+    ]
+    if missing:
+        raise ValueError(f"encoder checkpoint missing {len(missing)} tensors, e.g. {missing[:4]}")
+    layers = {ours: jnp.asarray(np.stack(slots), dtype) for ours, slots in per_layer.items()}
+    missing_top = [k for k in _BERT_TOP_MAP.values() if k not in top]
+    if missing_top:
+        raise ValueError(f"encoder checkpoint missing {missing_top}")
+    return {**top, "layers": layers}

@@ -214,7 +214,9 @@ def forward(
     Without a cache: plain causal self-attention over the T tokens (training
     / parity testing).  With a cache: the T tokens are written at
     ``cache_offset`` and attend over the whole cache (prefill writes many,
-    decode writes one — same code path).
+    decode writes one — same code path).  ``cache_offset`` may be a scalar
+    or a per-sequence ``[B]`` vector — the continuous-batching engine
+    decodes slots at ragged positions (serving/engine.py).
 
     Returns (logits [B, T, vocab] float32, updated cache or None).
     """
@@ -223,11 +225,12 @@ def forward(
     b, t, h = x.shape
 
     use_cache = cache is not None
+    offsets = jnp.broadcast_to(jnp.asarray(cache_offset, jnp.int32), (b,))
     if use_cache:
         max_seq = cache.k.shape[2]
         kv_positions = jnp.broadcast_to(jnp.arange(max_seq, dtype=jnp.int32)[None], (b, max_seq))
         if attn_mask is None:
-            limit = jnp.asarray(cache_offset, jnp.int32) + t
+            limit = offsets[:, None] + t
             kv_valid = kv_positions < limit
             attn_mask = make_causal_mask(
                 positions, kv_positions, kv_valid, sliding_window=config.sliding_window
@@ -252,13 +255,14 @@ def forward(
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         if layer_cache is not None:
-            offset = jnp.asarray(cache_offset, jnp.int32)
-            k_all = jax.lax.dynamic_update_slice(
-                layer_cache["k"], k.astype(layer_cache["k"].dtype), (0, offset, 0, 0)
+            # per-sequence write offsets (ragged continuous batching)
+            write = jax.vmap(
+                lambda buf, new, off: jax.lax.dynamic_update_slice(
+                    buf, new.astype(buf.dtype), (off, 0, 0)
+                )
             )
-            v_all = jax.lax.dynamic_update_slice(
-                layer_cache["v"], v.astype(layer_cache["v"].dtype), (0, offset, 0, 0)
-            )
+            k_all = write(layer_cache["k"], k, offsets)
+            v_all = write(layer_cache["v"], v, offsets)
             new_cache = {"k": k_all, "v": v_all}
         else:
             k_all, v_all = k, v

@@ -41,7 +41,11 @@ class ByteTokenizer:
         return ([self.bos_id] if add_bos else []) + ids
 
     def decode(self, ids: Sequence[int]) -> str:
-        data = bytes(i - self.SPECIALS for i in ids if i >= self.SPECIALS)
+        # ids beyond the byte range are skipped (a model vocab can exceed
+        # the tokenizer's 259 ids; sampling may legally pick those)
+        data = bytes(
+            i - self.SPECIALS for i in ids if self.SPECIALS <= i < 256 + self.SPECIALS
+        )
         return data.decode("utf-8", errors="replace")
 
 

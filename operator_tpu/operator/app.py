@@ -84,9 +84,9 @@ class Operator:
         pattern-only results (never at operator startup)."""
 
         def factory():
-            from ..serving.backend import TpuNativeProvider
+            from ..serving.provider import build_tpu_native_provider
 
-            return TpuNativeProvider(self.config)
+            return build_tpu_native_provider(self.config)
 
         self.providers.register_factory("tpu-native", factory)
 

@@ -1,0 +1,37 @@
+"""Pallas TPU kernels with pure-XLA reference implementations.
+
+Every kernel here has a ``*_reference`` twin built from plain ``jnp`` ops.
+The references serve three roles: (1) parity oracles for the kernel tests,
+(2) the actual execution path on CPU/interpret backends, and (3) readable
+specifications of the math.  Callers go through the dispatching wrappers
+(``best_window_scores``, ``paged_attention``) which pick the kernel on TPU
+and the reference elsewhere.
+
+Reference-system context (SURVEY.md §2.2): the external log-parser service
+the reference called over REST is rebuilt as in-tree scoring; its hot op —
+pattern-embedding × log-window-embedding similarity — lives here.  The
+paged-attention kernel backs the serving engine's batched decode
+(BASELINE config 4: 32 concurrent failure events).
+"""
+
+from .similarity import (
+    best_window_scores,
+    best_window_scores_reference,
+    similarity_matrix,
+    top_k_windows,
+)
+from .paged_attention import (
+    PagedKVCache,
+    paged_attention,
+    paged_attention_reference,
+)
+
+__all__ = [
+    "best_window_scores",
+    "best_window_scores_reference",
+    "similarity_matrix",
+    "top_k_windows",
+    "PagedKVCache",
+    "paged_attention",
+    "paged_attention_reference",
+]

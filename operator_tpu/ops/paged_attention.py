@@ -1,0 +1,292 @@
+"""Paged KV-cache attention for batched variable-length decode.
+
+The serving engine batches up to 32 concurrent failure-event explanations
+(BASELINE config 4).  Their sequence lengths are ragged — a contiguous
+``[B, max_seq]`` cache would reserve worst-case HBM for every slot, which
+is exactly what kills batch size at 8B scale on v5e (SURVEY.md §7 hard
+part c).  Instead KV lives in fixed-size pages:
+
+    k_pages, v_pages  [num_pages, page_size, kv_heads, head_dim]
+    page_table        [batch, pages_per_seq] int32  (page ids per sequence)
+    lengths           [batch] int32                 (tokens currently held)
+
+The Pallas kernel walks each sequence's page list with the page table as
+*scalar prefetch* (the table is read on the scalar core before the grid
+step, steering the DMA of exactly the pages the sequence owns — no gather
+materialisation), keeping a flash-attention style running
+(max, sum, acc) in VMEM.  Grouped-query heads are expanded in-kernel, so
+repeated KV never hits HBM (same trick as models/llama.py's einsum).
+
+The dense reference gathers pages into a contiguous cache and runs masked
+softmax attention — the oracle for parity tests and the CPU path.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+_LANE = 128
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# paged cache container + host-free update ops
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PagedKVCache:
+    """Per-layer paged KV storage (layers stacked on axis 0 for lax.scan)."""
+
+    k_pages: jax.Array  # [layers, num_pages, page_size, kv_heads, head_dim]
+    v_pages: jax.Array
+    page_table: jax.Array  # [batch, pages_per_seq] int32
+    lengths: jax.Array  # [batch] int32
+
+    @property
+    def page_size(self) -> int:
+        return self.k_pages.shape[2]
+
+    @classmethod
+    def create(
+        cls,
+        num_layers: int,
+        num_pages: int,
+        page_size: int,
+        kv_heads: int,
+        head_dim: int,
+        batch_size: int,
+        pages_per_seq: int,
+        dtype: jnp.dtype = jnp.bfloat16,
+    ) -> "PagedKVCache":
+        shape = (num_layers, num_pages, page_size, kv_heads, head_dim)
+        return cls(
+            k_pages=jnp.zeros(shape, dtype),
+            v_pages=jnp.zeros(shape, dtype),
+            page_table=jnp.zeros((batch_size, pages_per_seq), jnp.int32),
+            lengths=jnp.zeros((batch_size,), jnp.int32),
+        )
+
+
+jax.tree_util.register_pytree_node(
+    PagedKVCache,
+    lambda c: ((c.k_pages, c.v_pages, c.page_table, c.lengths), None),
+    lambda _, ch: PagedKVCache(*ch),
+)
+
+
+def write_tokens(
+    pages: jax.Array,  # [num_pages, page_size, KH, D] (single layer)
+    page_table: jax.Array,  # [B, pages_per_seq]
+    new: jax.Array,  # [B, T, KH, D] tokens to store
+    start: jax.Array,  # [B] int32 position of new[:, 0]
+) -> jax.Array:
+    """Scatter T new tokens per sequence into their pages (prefill or
+    decode append — decode is T=1, start=lengths)."""
+    b, t = new.shape[0], new.shape[1]
+    page_size = pages.shape[1]
+    positions = start[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]  # [B, T]
+    page_ids = jnp.take_along_axis(
+        page_table, positions // page_size, axis=1
+    )  # [B, T]
+    slots = positions % page_size
+    return pages.at[page_ids, slots].set(new.astype(pages.dtype))
+
+
+# ---------------------------------------------------------------------------
+# dense reference
+# ---------------------------------------------------------------------------
+
+
+def paged_attention_reference(
+    q: jax.Array,  # [B, QH, D] current-token queries (RoPE applied)
+    k_pages: jax.Array,  # [num_pages, page_size, KH, D] (single layer)
+    v_pages: jax.Array,
+    page_table: jax.Array,  # [B, pages_per_seq]
+    lengths: jax.Array,  # [B] number of valid tokens (incl. current)
+) -> jax.Array:
+    """Gather-then-attend oracle.  Returns [B, QH, D] in q.dtype."""
+    b, qh, d = q.shape
+    kh = k_pages.shape[2]
+    g = qh // kh
+    page_size = k_pages.shape[1]
+    max_seq = page_table.shape[1] * page_size
+
+    # [B, S, KH, D] contiguous gather of each sequence's pages
+    k = k_pages[page_table].reshape(b, max_seq, kh, d)
+    v = v_pages[page_table].reshape(b, max_seq, kh, d)
+
+    q_grouped = q.reshape(b, kh, g, d)
+    scores = jnp.einsum(
+        "bkgd,bskd->bkgs", q_grouped, k, preferred_element_type=jnp.float32
+    ) * (d**-0.5)
+    valid = jnp.arange(max_seq, dtype=jnp.int32)[None, :] < lengths[:, None]
+    scores = jnp.where(valid[:, None, None, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs, v)
+    return out.reshape(b, qh, d).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+
+def _paged_attn_kernel(
+    # scalar prefetch
+    pt_ref,  # [B, pages_per_seq] int32 (SMEM)
+    len_ref,  # [B] int32 (SMEM)
+    # blocks
+    q_ref,  # [1, QH, D]
+    k_ref,  # [1, page_size, KH, D] — the page pt[b, j]
+    v_ref,
+    out_ref,  # [1, QH, D] f32
+    # scratch
+    m_scratch,  # [QH, LANE] f32 running max (lanes duplicated)
+    l_scratch,  # [QH, LANE] f32 running denominator
+    acc_scratch,  # [QH, D] f32
+    *,
+    kv_heads: int,
+    q_per_kv: int,
+    page_size: int,
+    scale: float,
+):
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    num_pages = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scratch[...] = jnp.full_like(m_scratch, _NEG_INF)
+        l_scratch[...] = jnp.zeros_like(l_scratch)
+        acc_scratch[...] = jnp.zeros_like(acc_scratch)
+
+    seq_len = len_ref[b]
+
+    # only touch pages that hold live tokens
+    @pl.when(j * page_size < seq_len)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [QH, D]
+        k = k_ref[0]  # [page, KH, D]
+        v = v_ref[0]
+
+        # scores [QH, page]: per-kv-head matmuls, GQA expanded in-register
+        parts = []
+        for h in range(kv_heads):
+            q_h = q[h * q_per_kv : (h + 1) * q_per_kv]  # [G, D]
+            k_h = k[:, h, :].astype(jnp.float32)  # [page, D]
+            parts.append(
+                jax.lax.dot_general(
+                    q_h, k_h, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+        s = jnp.concatenate(parts, axis=0) * scale  # [QH, page]
+
+        pos = j * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < seq_len, s, _NEG_INF)
+
+        m_prev = m_scratch[...]  # [QH, LANE]
+        l_prev = l_scratch[...]
+        block_max = jnp.max(s, axis=1, keepdims=True)  # [QH, 1]
+        m_new = jnp.maximum(m_prev, jax.lax.broadcast_in_dim(
+            block_max, m_prev.shape, (0, 1)
+        ))
+        alpha = jnp.exp(m_prev[:, :1] - m_new[:, :1])  # [QH, 1]
+        p = jnp.exp(s - m_new[:, :1])  # [QH, page]
+
+        l_new = alpha * l_prev[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        l_scratch[...] = jax.lax.broadcast_in_dim(l_new, l_prev.shape, (0, 1))
+        m_scratch[...] = m_new
+
+        parts_o = []
+        for h in range(kv_heads):
+            p_h = p[h * q_per_kv : (h + 1) * q_per_kv]  # [G, page]
+            v_h = v[:, h, :].astype(jnp.float32)  # [page, D]
+            parts_o.append(
+                jax.lax.dot_general(
+                    p_h, v_h, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+        o = jnp.concatenate(parts_o, axis=0)  # [QH, D]
+        acc_scratch[...] = acc_scratch[...] * alpha + o
+
+    @pl.when(j == num_pages - 1)
+    def _finish():
+        denom = jnp.maximum(l_scratch[:, :1], 1e-30)
+        out_ref[0] = (acc_scratch[...] / denom).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _paged_attention_pallas(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    page_table: jax.Array,
+    lengths: jax.Array,
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, qh, d = q.shape
+    _, page_size, kh, _ = k_pages.shape
+    pages_per_seq = page_table.shape[1]
+    scale = d**-0.5
+
+    kernel = functools.partial(
+        _paged_attn_kernel,
+        kv_heads=kh,
+        q_per_kv=qh // kh,
+        page_size=page_size,
+        scale=scale,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec((1, qh, d), lambda b, j, pt, ln: (b, 0, 0)),
+            pl.BlockSpec(
+                (1, page_size, kh, d), lambda b, j, pt, ln: (pt[b, j], 0, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, page_size, kh, d), lambda b, j, pt, ln: (pt[b, j], 0, 0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, qh, d), lambda b, j, pt, ln: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((qh, _LANE), jnp.float32),
+            pltpu.VMEM((qh, _LANE), jnp.float32),
+            pltpu.VMEM((qh, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, qh, d), jnp.float32),
+        interpret=interpret,
+    )(page_table, lengths, q, k_pages, v_pages)
+    return out.astype(q.dtype)
+
+
+def paged_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    page_table: jax.Array,
+    lengths: jax.Array,
+) -> jax.Array:
+    """Dispatch: Pallas kernel on TPU, dense reference elsewhere."""
+    from ._dispatch import on_tpu
+
+    if on_tpu(q, k_pages):
+        return _paged_attention_pallas(q, k_pages, v_pages, page_table, lengths)
+    return paged_attention_reference(q, k_pages, v_pages, page_table, lengths)

@@ -27,7 +27,6 @@ of sequence-parallel attention.
 from __future__ import annotations
 
 import logging
-import math
 from dataclasses import dataclass
 from typing import Any, Optional
 

@@ -27,7 +27,8 @@ from typing import Optional
 from ..schema.analysis import AnalysisResult, PodFailureData, StageTimings
 from ..schema.kube import Pod
 from .loader import LoadedLibrary, load_builtin_library, load_libraries
-from .matcher import MatcherConfig, match_libraries
+from .matcher import MatcherConfig, fold_events, match_libraries
+from .semantic import SemanticMatcher
 from .windows import split_lines
 
 log = logging.getLogger(__name__)
@@ -84,11 +85,15 @@ class PatternEngine:
         enabled_libraries: Optional[list[str]] = None,
         include_builtin: bool = True,
         config: Optional[MatcherConfig] = None,
+        semantic: "SemanticMatcher | bool | None" = None,
     ) -> None:
         self.cache_dir = cache_dir
         self.enabled_libraries = enabled_libraries
         self.include_builtin = include_builtin
         self.config = config or MatcherConfig()
+        if semantic is True:
+            semantic = SemanticMatcher()
+        self.semantic: Optional[SemanticMatcher] = semantic or None
         self._lock = threading.Lock()
         self._libraries: list[LoadedLibrary] = []
         self.reload()
@@ -106,6 +111,10 @@ class PatternEngine:
                 libraries.append(builtin)
         with self._lock:
             self._libraries = libraries
+        if self.semantic is not None:
+            # the embedding-cache build step of the sync reconciler
+            # (SURVEY.md §7 stage 3): re-embed anchors after every git pull
+            self.semantic.rebuild(libraries)
         total = sum(len(lib.patterns) for lib in libraries)
         log.info("pattern engine loaded %d libraries / %d patterns", len(libraries), total)
         return total
@@ -132,6 +141,19 @@ class PatternEngine:
             pod_name=pod.metadata.name if pod else None,
             pod_namespace=pod.metadata.namespace if pod else None,
         )
+        if self.semantic is not None and self.semantic.num_patterns:
+            # semantic catches what regex missed; a pattern already hit by
+            # its regex keeps the (higher-precision) regex event only
+            matched_ids = {e.matched_pattern.id for e in result.events}
+            extra = [
+                e
+                for e in self.semantic.match(lines)
+                if e.matched_pattern.id not in matched_ids
+            ]
+            if extra:
+                result.summary, result.events = fold_events(
+                    result.events + extra, self.config
+                )
         result.timings = StageTimings(parse_ms=round((time.perf_counter() - started) * 1e3, 3))
         return result
 

@@ -143,6 +143,18 @@ def summarize(events: list[AnalysisEvent], config: Optional[MatcherConfig] = Non
     )
 
 
+def fold_events(
+    events: list[AnalysisEvent], config: Optional[MatcherConfig] = None
+) -> tuple[AnalysisSummary, list[AnalysisEvent]]:
+    """The one ranking policy: sort by (score, severity), summarise over the
+    FULL set, then truncate.  Shared by the regex fold and the semantic
+    merge so both paths rank identically."""
+    config = config or MatcherConfig()
+    events = sorted(events, key=lambda e: (e.score, e.severity.rank), reverse=True)
+    summary = summarize(events, config)
+    return summary, events[: config.max_total_events]
+
+
 def match_libraries(
     libraries: list[LoadedLibrary],
     lines: list[str],
@@ -158,10 +170,7 @@ def match_libraries(
     for library in libraries:
         for pattern in library.patterns:
             events.extend(match_pattern(pattern, lines, config))
-    events.sort(key=lambda e: (e.score, e.severity.rank), reverse=True)
-    summary = summarize(events, config)  # over the FULL set, before truncation
-    if len(events) > config.max_total_events:
-        events = events[: config.max_total_events]
+    summary, events = fold_events(events, config)
     return AnalysisResult(
         analysis_id=str(uuid.uuid4()),
         pod_name=pod_name,

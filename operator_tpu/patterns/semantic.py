@@ -1,0 +1,322 @@
+"""Semantic pattern matching: embedding similarity over log windows.
+
+The regex matcher (matcher.py) only fires on patterns whose exact regex or
+keywords appear; the semantic path catches failures phrased differently —
+it embeds every log window and every pattern's anchor text into one vector
+space and scores ``windows @ patterns.T`` on the MXU
+(ops/similarity.py's fused best-window kernel on TPU).
+
+Two embedders, one interface:
+
+- :class:`HashingEmbedder` — deterministic char-n-gram feature hashing,
+  zero weights, pure numpy.  Lexical-overlap similarity; always available
+  (this repo runs with zero egress, so a downloaded checkpoint can never
+  be a hard dependency).
+- :class:`NeuralEmbedder` — the JAX MiniLM-class encoder
+  (models/encoder.py), used when a local checkpoint directory is
+  configured.  True semantic similarity, runs on TPU.
+
+Pattern embeddings are (re)built on ``reload`` after every git pattern
+sync — this is the "pattern cache → embedding cache build step hooked into
+the sync reconciler" of SURVEY.md §7 stage 3.
+"""
+
+from __future__ import annotations
+
+import logging
+import zlib
+from typing import Optional, Protocol, Sequence
+
+import numpy as np
+
+from ..schema.analysis import AnalysisEvent, MatchContext, MatchedPattern
+from ..schema.patterns import Pattern
+from .loader import LoadedLibrary
+from .windows import LogWindow, iter_windows
+
+log = logging.getLogger(__name__)
+
+DEFAULT_WINDOW_LINES = 16
+DEFAULT_STRIDE = 8
+
+
+class Embedder(Protocol):
+    """Text -> L2-normalised embeddings [N, dim]."""
+
+    dim: int
+
+    def embed(self, texts: Sequence[str]) -> np.ndarray: ...
+
+
+_REGEX_TOKEN = __import__("re").compile(r"[A-Za-z][A-Za-z0-9_.]{2,}")
+
+
+def regex_literals(regex: Optional[str]) -> list[str]:
+    """Literal word-ish tokens inside a regex (``java\\.lang\\.OutOfMemoryError``
+    -> ``java lang OutOfMemoryError``) — the vocabulary the pattern expects
+    to see in real log lines."""
+    if not regex:
+        return []
+    cleaned = regex.replace("\\.", " ").replace("\\", " ")
+    return [t for t in _REGEX_TOKEN.findall(cleaned) if t.lower() not in {"the", "and"}]
+
+
+def embedding_text(pattern: Pattern) -> str:
+    """What gets embedded for a pattern: the natural-language anchor plus
+    the literal vocabulary of its regexes/keywords, so lexical embedders
+    see log-shaped tokens and neural embedders see the description."""
+    parts = [pattern.anchor_text()]
+    if pattern.primary_pattern:
+        parts.extend(regex_literals(pattern.primary_pattern.regex))
+        parts.extend(pattern.primary_pattern.keywords)
+    for secondary in pattern.secondary_patterns:
+        parts.extend(regex_literals(secondary.regex))
+    seen: set[str] = set()
+    unique = []
+    for p in parts:
+        if p and p.lower() not in seen:
+            seen.add(p.lower())
+            unique.append(p)
+    return " ".join(unique)
+
+
+# ---------------------------------------------------------------------------
+# hashing embedder (no weights, deterministic, lexical)
+# ---------------------------------------------------------------------------
+
+
+class HashingEmbedder:
+    """Signed char-n-gram feature hashing into a fixed-dim unit vector.
+
+    Cosine similarity under this embedding measures character-n-gram
+    overlap — strong enough to pair "OOMKilled exit code 137" with a
+    pattern anchored on "container killed out of memory 137", with zero
+    model weights.  Lexical overlap lives at line granularity, so the
+    default windows are small (``default_window_lines``); thresholds are
+    calibrated on the fixture logs (tests/test_semantic.py keeps them
+    honest).
+    """
+
+    default_threshold = 0.2
+    default_window_lines = 4
+    default_stride = 2
+
+    def __init__(self, dim: int = 384, ngram_sizes: tuple[int, ...] = (3, 4, 5)) -> None:
+        self.dim = dim
+        self.ngram_sizes = ngram_sizes
+
+    def _features(self, text: str) -> np.ndarray:
+        vec = np.zeros(self.dim, np.float32)
+        normalized = " ".join(text.lower().split())
+        data = normalized.encode("utf-8", errors="replace")
+        for n in self.ngram_sizes:
+            if len(data) < n:
+                continue
+            for i in range(len(data) - n + 1):
+                gram = data[i : i + n]
+                h = zlib.crc32(gram)
+                sign = 1.0 if (h >> 31) & 1 else -1.0
+                vec[h % self.dim] += sign
+        norm = float(np.linalg.norm(vec))
+        if norm > 0:
+            vec /= norm
+        return vec
+
+    def embed(self, texts: Sequence[str]) -> np.ndarray:
+        if not texts:
+            return np.zeros((0, self.dim), np.float32)
+        return np.stack([self._features(t) for t in texts])
+
+
+# ---------------------------------------------------------------------------
+# neural embedder (JAX encoder, TPU path)
+# ---------------------------------------------------------------------------
+
+
+class NeuralEmbedder:
+    """MiniLM-class JAX encoder behind the same embed() interface.
+
+    Batches are padded to fixed (batch, seq) buckets so XLA compiles a
+    handful of shapes, not one per request.
+    """
+
+    default_threshold = 0.45
+    default_window_lines = DEFAULT_WINDOW_LINES
+    default_stride = DEFAULT_STRIDE
+
+    def __init__(
+        self,
+        params,
+        config,
+        tokenize,  # (text) -> list[int], no specials
+        *,
+        max_tokens: int = 256,
+        batch_size: int = 32,
+    ) -> None:
+        import jax
+
+        from ..models.encoder import encode
+
+        self.params = params
+        self.config = config
+        self.tokenize = tokenize
+        self.max_tokens = min(max_tokens, config.max_positions)
+        self.batch_size = batch_size
+        self.dim = config.hidden_size
+        self._encode = jax.jit(lambda ids, mask: encode(params, config, ids, mask))
+
+    def embed(self, texts: Sequence[str]) -> np.ndarray:
+        import numpy as np
+
+        if not texts:
+            return np.zeros((0, self.dim), np.float32)
+        out = []
+        for lo in range(0, len(texts), self.batch_size):
+            chunk = texts[lo : lo + self.batch_size]
+            ids = np.zeros((self.batch_size, self.max_tokens), np.int32)
+            mask = np.zeros((self.batch_size, self.max_tokens), np.int32)
+            for row, text in enumerate(chunk):
+                toks = self.tokenize(text)[: self.max_tokens]
+                ids[row, : len(toks)] = toks
+                mask[row, : len(toks)] = 1
+            emb = np.asarray(self._encode(ids, mask), np.float32)
+            out.append(emb[: len(chunk)])
+        return np.concatenate(out, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# the matcher
+# ---------------------------------------------------------------------------
+
+
+class SemanticMatcher:
+    """Holds pattern embeddings; scores logs window-by-window.
+
+    ``rebuild(libraries)`` re-embeds all pattern anchor texts (called after
+    every pattern sync); ``match(lines)`` embeds the log windows and emits
+    an :class:`AnalysisEvent` per pattern whose best window clears the
+    similarity threshold.
+    """
+
+    def __init__(
+        self,
+        embedder: Optional[Embedder] = None,
+        *,
+        threshold: Optional[float] = None,
+        window_lines: Optional[int] = None,
+        stride: Optional[int] = None,
+        max_windows: int = 4096,
+    ) -> None:
+        self.embedder = embedder or HashingEmbedder()
+        self.threshold = (
+            threshold
+            if threshold is not None
+            else getattr(self.embedder, "default_threshold", 0.3)
+        )
+        # window granularity is an embedder property: lexical overlap lives
+        # at line scale, contextual embeddings want wider spans
+        self.window_lines = window_lines or getattr(
+            self.embedder, "default_window_lines", DEFAULT_WINDOW_LINES
+        )
+        self.stride = stride or getattr(
+            self.embedder, "default_stride", DEFAULT_STRIDE
+        )
+        self.max_windows = max_windows
+        # (patterns, embeddings) swapped as ONE tuple: rebuild() may run in a
+        # sync thread while match() runs in an analysis thread; readers take
+        # a single snapshot so list and matrix can never be mismatched
+        self._state: tuple[list[Pattern], np.ndarray] = (
+            [],
+            np.zeros((0, self.embedder.dim), np.float32),
+        )
+
+    # ------------------------------------------------------------------
+    def rebuild(self, libraries: Sequence[LoadedLibrary]) -> int:
+        patterns = [p for lib in libraries for p in lib.patterns]
+        texts = [embedding_text(p) for p in patterns]
+        keep = [i for i, t in enumerate(texts) if t.strip()]
+        kept_patterns = [patterns[i] for i in keep]
+        embeddings = self.embedder.embed([texts[i] for i in keep])
+        self._state = (kept_patterns, embeddings)  # atomic swap
+        log.info("semantic matcher: embedded %d patterns", len(kept_patterns))
+        return len(kept_patterns)
+
+    @property
+    def num_patterns(self) -> int:
+        return len(self._state[0])
+
+    # ------------------------------------------------------------------
+    def match(self, lines: list[str]) -> list[AnalysisEvent]:
+        patterns, pattern_emb = self._state  # one consistent snapshot
+        if not lines or not patterns:
+            return []
+        windows = list(
+            iter_windows(lines, window_lines=self.window_lines, stride=self.stride)
+        )
+        if len(windows) > self.max_windows:
+            # evidence concentrates at the tail — keep the newest windows
+            windows = windows[-self.max_windows :]
+        window_emb = self.embedder.embed([w.text for w in windows])
+
+        scores, best_idx = self._score(window_emb, patterns, pattern_emb)
+        events: list[AnalysisEvent] = []
+        for i, pattern in enumerate(patterns):
+            score = float(scores[i])
+            if score < self.threshold:
+                continue
+            window = windows[int(best_idx[i])]
+            events.append(self._to_event(pattern, window, score, lines))
+        events.sort(key=lambda e: e.score, reverse=True)
+        return events
+
+    def _score(
+        self,
+        window_emb: np.ndarray,
+        patterns: list[Pattern],
+        pattern_emb: np.ndarray,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Per-pattern (best score, best window index)."""
+        if window_emb.shape[0] == 0:
+            n = len(patterns)
+            return np.full(n, -1.0, np.float32), np.zeros(n, np.int64)
+        try:
+            import jax.numpy as jnp
+
+            from ..ops.similarity import best_window_scores
+
+            s, i = best_window_scores(
+                jnp.asarray(window_emb), jnp.asarray(pattern_emb)
+            )
+            return np.asarray(s), np.asarray(i)
+        except Exception:  # pragma: no cover - numpy fallback if jax breaks
+            log.debug("similarity op unavailable; numpy fallback", exc_info=True)
+            matrix = window_emb @ pattern_emb.T
+            return matrix.max(axis=0), matrix.argmax(axis=0)
+
+    def _to_event(
+        self, pattern: Pattern, window: LogWindow, score: float, lines: list[str]
+    ) -> AnalysisEvent:
+        # anchor the event at the window's middle line for context display
+        line_number = min(window.start + len(window) // 2, len(lines) - 1)
+        window_lines = window.text.splitlines()
+        mid = min(len(window) // 2, max(len(window_lines) - 1, 0))
+        remediation = (
+            pattern.remediation.description if pattern.remediation else None
+        )
+        return AnalysisEvent(
+            score=round(score, 4),
+            source="semantic",
+            matched_pattern=MatchedPattern(
+                id=pattern.id,
+                name=pattern.name or pattern.id,
+                severity=pattern.severity_enum.value,
+                category=pattern.category,
+                remediation=remediation,
+            ),
+            context=MatchContext(
+                line_number=line_number,
+                matched_line=window_lines[mid] if window_lines else "",
+                lines_before=window_lines[:mid],
+                lines_after=window_lines[mid + 1 :],
+            ),
+        )

@@ -8,4 +8,10 @@ lazily so the control plane runs on accelerator-less machines.
 
 from .prompts import DEFAULT_TEMPLATE, build_prompt
 
-__all__ = ["DEFAULT_TEMPLATE", "build_prompt"]
+__all__ = [
+    "DEFAULT_TEMPLATE",
+    "build_prompt",
+    # lazy (import jax): serving.engine — BatchedGenerator, ServingEngine,
+    # SamplingParams, GenerationResult; serving.provider —
+    # TPUNativeProvider, build_tpu_native_provider
+]

@@ -1,0 +1,432 @@
+"""Continuous-batching generation engine — the ai-interface's compute, in-tree.
+
+The reference POSTs each analysis to an external LLM service one request at
+a time (reference AIInterfaceRestClient.java:37-39, 180 s read budget).
+Here generation runs on the local TPU with **continuous batching**:
+
+- **Slots**: the KV cache holds ``max_slots`` sequences; decode always runs
+  the full ``[max_slots, 1]`` batch (a fixed shape XLA compiles once), with
+  finished/empty slots masked.  A new request joins at the next step
+  boundary instead of waiting for the batch to drain.
+- **Batched prefill**: concurrent arrivals are tokenised, right-padded to a
+  shared bucket and prefilled as ONE forward pass (BASELINE config 4: 32
+  concurrent failure events -> one prefill).  Prompt shapes are bucketed to
+  powers of two so XLA compiles a handful of prefill programs, not one per
+  request.
+- **Ragged positions**: every slot decodes at its own offset; the model's
+  cache update takes a per-sequence offset vector (models/llama.py).
+- **Per-slot sampling params**: temperature / top-p ride in ``[B]`` arrays,
+  so requests with different AIProvider configs share one batch.
+
+Two layers: :class:`BatchedGenerator` is the synchronous JAX core (jitted
+prefill / decode-step / sampler); :class:`ServingEngine` is the asyncio
+front the operator talks to (queue, admission, futures).  The split keeps
+the JAX code testable without an event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..models.configs import ModelConfig
+from ..models.llama import KVCache, forward
+from ..models.tokenizer import Tokenizer
+from ..utils.timing import METRICS, MetricsRegistry
+
+log = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    max_tokens: int = 256
+    temperature: float = 0.3  # reference default, aiprovider-crd.yaml:56-58
+    top_p: float = 0.95
+    stop_on_eos: bool = True
+
+
+@dataclass
+class GenerationResult:
+    text: str
+    token_ids: list[int]
+    prompt_tokens: int
+    completion_tokens: int
+    finish_reason: str  # "stop" | "length"
+    prefill_ms: float = 0.0
+    decode_ms: float = 0.0
+
+    @property
+    def total_ms(self) -> float:
+        return self.prefill_ms + self.decode_ms
+
+
+@dataclass
+class _Slot:
+    active: bool = False
+    prompt_len: int = 0
+    generated: list[int] = field(default_factory=list)
+    params: SamplingParams = field(default_factory=SamplingParams)
+    started: float = 0.0
+    prefill_ms: float = 0.0
+
+
+def _bucket(n: int, floor: int, cap: int) -> int:
+    """Smallest power-of-two >= n, clamped to [floor, cap]."""
+    size = floor
+    while size < n and size < cap:
+        size *= 2
+    return min(size, cap)
+
+
+class BatchedGenerator:
+    """Slot-based generation over one shared KV cache (single host thread).
+
+    Not thread-safe by design: the ServingEngine serialises all calls on
+    one worker; the TPU itself is the serial resource.
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        config: ModelConfig,
+        tokenizer: Tokenizer,
+        *,
+        max_slots: int = 8,
+        max_seq: Optional[int] = None,
+        cache_dtype: Any = None,
+        metrics: Optional[MetricsRegistry] = None,
+        seed: int = 0,
+    ) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self._jax = jax
+        self._jnp = jnp
+        self.params = params
+        self.config = config
+        self.tokenizer = tokenizer
+        self.max_slots = max_slots
+        self.max_seq = min(max_seq or config.max_seq_len, config.max_seq_len)
+        self.metrics = metrics or METRICS
+        cache_dtype = cache_dtype or jnp.bfloat16
+
+        self.cache = KVCache.create(config, max_slots, self.max_seq, dtype=cache_dtype)
+        self.offsets = jnp.zeros((max_slots,), jnp.int32)  # tokens held per slot
+        self.last_tokens = jnp.zeros((max_slots, 1), jnp.int32)
+        self.slots: list[_Slot] = [_Slot() for _ in range(max_slots)]
+        self._rng = jax.random.PRNGKey(seed)
+
+        self._decode_fn = jax.jit(self._decode_step)
+        self._prefill_fns: dict[tuple[int, int], Any] = {}
+
+    # ------------------------------------------------------------------
+    # jitted bodies
+    # ------------------------------------------------------------------
+
+    def _decode_step(self, params, cache, tokens, offsets, rng, temp, top_p, active):
+        """[B,1] tokens at per-slot offsets -> next token per slot."""
+        jnp = self._jnp
+        positions = offsets[:, None]
+        logits, cache = forward(
+            params, self.config, tokens, positions, cache=cache, cache_offset=offsets
+        )
+        next_tokens, rng = self._sample(logits[:, -1, :], rng, temp, top_p)
+        # inactive slots keep decoding garbage into their own slot space;
+        # offsets only advance for active ones so their state is untouched
+        offsets = jnp.where(active, offsets + 1, offsets)
+        return cache, next_tokens, offsets, rng
+
+    def _sample(self, logits, rng, temp, top_p):
+        """Temperature + nucleus sampling; temp<=0 means greedy.  [B, V]."""
+        jax, jnp = self._jax, self._jnp
+        vocab = logits.shape[-1]
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        safe_temp = jnp.maximum(temp, 1e-4)[:, None]
+        scaled = logits.astype(jnp.float32) / safe_temp
+        sorted_logits, sorted_idx = jax.lax.top_k(scaled, vocab)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cumulative = jnp.cumsum(probs, axis=-1) - probs  # exclusive prefix
+        keep = cumulative < top_p[:, None]  # first token always kept
+        filtered = jnp.where(keep, sorted_logits, -jnp.inf)
+        rng, sub = jax.random.split(rng)
+        choice = jax.random.categorical(sub, filtered, axis=-1)
+        sampled = jnp.take_along_axis(sorted_idx, choice[:, None], axis=-1)[:, 0]
+        picked = jnp.where(temp <= 0.0, greedy, sampled.astype(jnp.int32))
+        return picked, rng
+
+    def _make_prefill(self, n_pad: int, t_pad: int):
+        """Compile a prefill program for the (n_pad, t_pad) bucket."""
+        jax, jnp = self._jax, self._jnp
+        config = self.config
+
+        @jax.jit
+        def prefill_fn(params, cache, token_ids, lengths, slot_ids, rng, temp, top_p):
+            # fresh contiguous mini-cache for the prompt tokens
+            mini = KVCache.create(config, n_pad, t_pad, dtype=cache.k.dtype)
+            positions = jnp.broadcast_to(
+                jnp.arange(t_pad, dtype=jnp.int32)[None], (n_pad, t_pad)
+            )
+            kv_valid = positions < lengths[:, None]
+            from ..models.llama import make_causal_mask
+
+            mask = make_causal_mask(
+                positions, positions, kv_valid, sliding_window=config.sliding_window
+            )
+            logits, mini = forward(
+                params, config, token_ids, positions, cache=mini,
+                cache_offset=0, attn_mask=mask,
+            )
+            # scatter the prompt KV into the big cache rows for these slots
+            # (slot axis is axis 1 of [L, B, S, KH, D])
+            k = cache.k.at[:, slot_ids, :t_pad].set(mini.k.astype(cache.k.dtype))
+            v = cache.v.at[:, slot_ids, :t_pad].set(mini.v.astype(cache.v.dtype))
+            last = jnp.take_along_axis(
+                logits, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
+            )[:, 0, :]
+            first_tokens, rng = self._sample(last, rng, temp, top_p)
+            return KVCache(k=k, v=v), first_tokens, rng
+
+        return prefill_fn
+
+    # ------------------------------------------------------------------
+    # host-side API
+    # ------------------------------------------------------------------
+
+    def free_slots(self) -> list[int]:
+        return [i for i, s in enumerate(self.slots) if not s.active]
+
+    @property
+    def num_active(self) -> int:
+        return sum(s.active for s in self.slots)
+
+    def admit(
+        self, prompts: Sequence[str], params_list: Sequence[SamplingParams]
+    ) -> list[int]:
+        """Tokenise + batch-prefill prompts into free slots; returns slot ids.
+
+        One forward pass for the whole group — the "32 concurrent failure
+        events -> one prefill" shape (BASELINE config 4).
+        """
+        jnp = self._jnp
+        free = self.free_slots()
+        assert len(prompts) <= len(free), "admit() called with too few free slots"
+        if not prompts:
+            return []
+        started = time.perf_counter()
+
+        token_lists = []
+        for prompt, sampling in zip(prompts, params_list):
+            ids = self.tokenizer.encode(prompt)
+            # leave room for at least one generated token
+            budget = self.max_seq - max(1, min(sampling.max_tokens, self.max_seq // 2))
+            if len(ids) > budget:
+                ids = ids[-budget:]  # failure evidence concentrates at the tail
+            token_lists.append(ids)
+
+        n = len(token_lists)
+        max_len = max(len(t) for t in token_lists)
+        n_pad = _bucket(n, 1, self.max_slots)
+        t_pad = _bucket(max_len, 64, self.max_seq)
+
+        ids = np.zeros((n_pad, t_pad), np.int32)
+        lengths = np.ones((n_pad,), np.int32)
+        temp = np.zeros((n_pad,), np.float32)
+        top_p = np.ones((n_pad,), np.float32)
+        slot_ids = np.zeros((n_pad,), np.int32)
+        taken = free[:n]
+        for row, (toks, sampling) in enumerate(zip(token_lists, params_list)):
+            ids[row, : len(toks)] = toks
+            lengths[row] = len(toks)
+            temp[row] = sampling.temperature
+            top_p[row] = sampling.top_p
+            slot_ids[row] = taken[row]
+        # padding rows duplicate row 0 verbatim (tokens, length, AND slot):
+        # the scatter then writes identical values to one slot from several
+        # rows, which is order-independent — no scratch slot needed, no
+        # free-slot budget consumed, no risk of corrupting a live slot
+        for row in range(n, n_pad):
+            ids[row] = ids[0]
+            lengths[row] = lengths[0]
+            slot_ids[row] = slot_ids[0]
+
+        key = (n_pad, t_pad)
+        if key not in self._prefill_fns:
+            log.info("compiling prefill bucket n=%d t=%d", n_pad, t_pad)
+            self._prefill_fns[key] = self._make_prefill(n_pad, t_pad)
+        self.cache, first_tokens, self._rng = self._prefill_fns[key](
+            self.params, self.cache, jnp.asarray(ids), jnp.asarray(lengths),
+            jnp.asarray(slot_ids), self._rng, jnp.asarray(temp), jnp.asarray(top_p),
+        )
+        first_np = np.asarray(first_tokens)
+        prefill_ms = (time.perf_counter() - started) * 1e3
+        self.metrics.record("prefill", prefill_ms)
+        self.metrics.record("prefill_batch", float(n))
+
+        offsets = np.array(self.offsets)  # mutable host copies
+        last = np.array(self.last_tokens)
+        for row, slot_id in enumerate(taken):
+            slot = self.slots[slot_id]
+            slot.active = True
+            slot.prompt_len = int(lengths[row])
+            slot.generated = [int(first_np[row])]
+            slot.params = params_list[row]
+            slot.started = time.perf_counter()
+            slot.prefill_ms = prefill_ms
+            offsets[slot_id] = int(lengths[row])
+            last[slot_id, 0] = int(first_np[row])
+        self.offsets = jnp.asarray(offsets)
+        self.last_tokens = jnp.asarray(last)
+        return list(taken)
+
+    def step(self) -> list[tuple[int, GenerationResult]]:
+        """One batched decode step; returns finished (slot, result) pairs."""
+        jnp = self._jnp
+        if self.num_active == 0:
+            return []
+        started = time.perf_counter()
+        active = np.array([s.active for s in self.slots])
+        temp = np.array(
+            [s.params.temperature if s.active else 0.0 for s in self.slots], np.float32
+        )
+        top_p = np.array(
+            [s.params.top_p if s.active else 1.0 for s in self.slots], np.float32
+        )
+        self.cache, next_tokens, self.offsets, self._rng = self._decode_fn(
+            self.params, self.cache, self.last_tokens, self.offsets, self._rng,
+            jnp.asarray(temp), jnp.asarray(top_p), jnp.asarray(active),
+        )
+        next_np = np.asarray(next_tokens)
+        self.last_tokens = next_tokens[:, None]
+        self.metrics.record("decode_step", (time.perf_counter() - started) * 1e3)
+
+        finished: list[tuple[int, GenerationResult]] = []
+        eos = self.tokenizer.eos_id
+        for i, slot in enumerate(self.slots):
+            if not slot.active:
+                continue
+            token = int(next_np[i])
+            previous = slot.generated[-1] if slot.generated else None
+            # the PREVIOUS sampled token ended generation?
+            if slot.params.stop_on_eos and eos is not None and previous == eos:
+                finished.append((i, self._finish(i, reason="stop")))
+                continue
+            slot.generated.append(token)
+            total = int(np.asarray(self.offsets)[i])
+            if (
+                len(slot.generated) >= slot.params.max_tokens
+                or total >= self.max_seq - 1
+            ):
+                finished.append((i, self._finish(i, reason="length")))
+        return finished
+
+    def _finish(self, slot_id: int, *, reason: str) -> GenerationResult:
+        slot = self.slots[slot_id]
+        eos = self.tokenizer.eos_id
+        ids = [t for t in slot.generated if t != eos]
+        text = self.tokenizer.decode(ids)
+        result = GenerationResult(
+            text=text,
+            token_ids=ids,
+            prompt_tokens=slot.prompt_len,
+            completion_tokens=len(ids),
+            finish_reason=reason,
+            prefill_ms=slot.prefill_ms,
+            decode_ms=(time.perf_counter() - slot.started) * 1e3 - slot.prefill_ms,
+        )
+        self.slots[slot_id] = _Slot()
+        return result
+
+    # convenience for tests / bench -------------------------------------
+    def generate(self, prompt: str, params: Optional[SamplingParams] = None) -> GenerationResult:
+        """Synchronous single-prompt generation (drains the whole batch)."""
+        sampling = params or SamplingParams()
+        [slot_id] = self.admit([prompt], [sampling])
+        while True:
+            for finished_id, result in self.step():
+                if finished_id == slot_id:
+                    return result
+
+
+class ServingEngine:
+    """Asyncio front: queue -> admission -> shared decode loop -> futures.
+
+    The decode loop runs JAX calls in a worker thread so the operator's
+    event loop never blocks on device sync (the reference's worker-pool
+    discipline, SURVEY.md §5 race-detection entry).
+    """
+
+    def __init__(
+        self,
+        generator: BatchedGenerator,
+        *,
+        admission_wait_s: float = 0.004,
+        max_queue: int = 1024,
+    ) -> None:
+        self.generator = generator
+        self.admission_wait_s = admission_wait_s
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
+        self._pending: dict[int, asyncio.Future] = {}  # slot id -> future
+        self._task: Optional[asyncio.Task] = None
+        self._closed = False
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._run(), name="serving-engine")
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def generate(
+        self, prompt: str, params: Optional[SamplingParams] = None
+    ) -> GenerationResult:
+        if self._task is None:
+            await self.start()
+        future: asyncio.Future = asyncio.get_running_loop().create_future()
+        await self._queue.put((prompt, params or SamplingParams(), future))
+        return await future
+
+    # ------------------------------------------------------------------
+    async def _run(self) -> None:
+        while not self._closed:
+            batch = []
+            if self.generator.num_active == 0 and self._queue.empty():
+                # fully idle: block until a request arrives
+                batch.append(await self._queue.get())
+            total_free = len(self.generator.free_slots())
+            if len(batch) < total_free and (batch or not self._queue.empty()):
+                # tiny window lets concurrent arrivals share one prefill
+                # (32 events -> one prefill, BASELINE config 4)
+                await asyncio.sleep(self.admission_wait_s)
+                while len(batch) < total_free and not self._queue.empty():
+                    batch.append(self._queue.get_nowait())
+            if batch:
+                await self._admit(batch)
+
+            if self.generator.num_active:
+                finished = await asyncio.to_thread(self.generator.step)
+                for slot_id, result in finished:
+                    future = self._pending.pop(slot_id, None)
+                    if future is not None and not future.done():
+                        future.set_result(result)
+            await asyncio.sleep(0)
+
+    async def _admit(self, batch) -> None:
+        prompts = [prompt for prompt, _, _ in batch]
+        params = [p for _, p, _ in batch]
+        slot_ids = await asyncio.to_thread(self.generator.admit, prompts, params)
+        for slot_id, (_, _, future) in zip(slot_ids, batch):
+            self._pending[slot_id] = future

@@ -1,0 +1,111 @@
+"""The ``tpu-native`` AI provider: in-tree TPU inference, zero external calls.
+
+This is the leg of the reference the rebuild replaces outright — the
+operator no longer POSTs to an ai-interface pod that fronts a GPU/OpenAI
+backend (reference AIInterfaceRestClient.java:37-39); ``providerId:
+tpu-native`` routes straight into the local serving engine (BASELINE north
+star: "0 external AI calls").
+
+Configuration comes from the same AIProvider CR fields the reference
+honours (promptTemplate / maxTokens / temperature,
+aiprovider-crd.yaml:36-62): the prompt builder applies the template, and
+each request carries its own SamplingParams into the shared batch
+(per-slot sampling, serving/engine.py).
+
+Model selection: ``modelId`` in the CR (must name a registered config);
+weights from ``OperatorConfig.checkpoint_dir`` (HF safetensors). Without a
+checkpoint the engine still runs — randomly-initialised weights — which
+keeps every pipeline, test, and benchmark runnable in an air-gapped
+environment; quality then comes from the template fallback the pipeline
+layers on top.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from typing import Optional
+
+from ..schema.analysis import AIResponse, AnalysisRequest
+from ..utils.config import OperatorConfig
+from .engine import BatchedGenerator, SamplingParams, ServingEngine
+from .prompts import build_prompt
+
+log = logging.getLogger(__name__)
+
+
+class TPUNativeProvider:
+    """AIProviderBackend serving explanations from the in-process engine."""
+
+    def __init__(self, engine: ServingEngine, *, model_id: str) -> None:
+        self.engine = engine
+        self.model_id = model_id
+
+    async def generate(self, request: AnalysisRequest) -> AIResponse:
+        config = request.provider_config
+        prompt = build_prompt(request)
+        params = SamplingParams(
+            max_tokens=(config.max_tokens if config and config.max_tokens else 500),
+            temperature=(
+                config.temperature if config and config.temperature is not None else 0.3
+            ),
+        )
+        try:
+            result = await self.engine.generate(prompt, params)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # noqa: BLE001 - pipeline degrades to pattern-only
+            log.exception("tpu-native generation failed")
+            return AIResponse(error=str(exc), provider_id="tpu-native", model_id=self.model_id)
+        return AIResponse(
+            explanation=result.text,
+            provider_id="tpu-native",
+            model_id=self.model_id,
+            prompt_tokens=result.prompt_tokens,
+            completion_tokens=result.completion_tokens,
+        )
+
+
+def build_tpu_native_provider(
+    config: Optional[OperatorConfig] = None,
+) -> TPUNativeProvider:
+    """Factory for ProviderRegistry.register_factory('tpu-native', ...).
+
+    Loads weights (checkpoint if configured, random init otherwise) and
+    builds the shared engine once; every AIProvider CR with
+    ``providerId: tpu-native`` then multiplexes onto the same batch.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import get_config, init_params
+    from ..models.loader import load_params
+    from ..models.tokenizer import load_tokenizer
+
+    config = config or OperatorConfig.from_env()
+    model_id = os.environ.get("OPERATOR_TPU_MODEL", config.model_id)
+    model_config = get_config(model_id)
+
+    checkpoint_dir = config.checkpoint_dir
+    tokenizer = load_tokenizer(checkpoint_dir)
+    if checkpoint_dir and os.path.isdir(checkpoint_dir):
+        log.info("loading %s weights from %s", model_id, checkpoint_dir)
+        params = load_params(checkpoint_dir, model_config, dtype=jnp.bfloat16)
+    else:
+        log.warning(
+            "no checkpoint for %s (checkpoint_dir=%r); using random init — "
+            "explanations will be non-linguistic until weights are mounted",
+            model_id, checkpoint_dir,
+        )
+        params = init_params(model_config, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+
+    generator = BatchedGenerator(
+        params,
+        model_config,
+        tokenizer,
+        max_slots=config.max_batch_size,
+        max_seq=min(model_config.max_seq_len, 2048),
+    )
+    engine = ServingEngine(generator)
+    return TPUNativeProvider(engine, model_id=model_id)

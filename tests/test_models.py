@@ -45,6 +45,24 @@ def test_forward_shapes_and_dtype():
     assert cache is None
 
 
+def test_param_count_matches_formula():
+    """init_params' actual tree must weigh exactly what the architecture
+    formula says (exercised on TINY_TEST; same code path as the 1.1B)."""
+    config = TINY_TEST
+    params = init_params(config, jax.random.PRNGKey(0))
+    h, f, v, n = (config.hidden_size, config.intermediate_size,
+                  config.vocab_size, config.num_layers)
+    qh, kvh, d = config.num_heads, config.num_kv_heads, config.head_dim
+    expected = (
+        v * h  # embed
+        + n * (h * qh * d + 2 * h * kvh * d + qh * d * h)  # attn
+        + n * (3 * h * f)  # mlp
+        + n * 2 * h + h  # norms
+        + h * v  # lm_head
+    )
+    assert param_count(params) == expected
+
+
 def test_param_count_tinyllama_shape():
     # sanity: the real TinyLlama config should weigh in around 1.1B
     config = get_config("tinyllama-1.1b")

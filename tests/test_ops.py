@@ -1,0 +1,223 @@
+"""Parity tests: Pallas kernels (interpret mode) vs XLA references vs numpy.
+
+The reference system has no kernels to compare against (its log-parser was
+an external service, SURVEY.md §2.2), so the oracles are in-tree: a plain
+einsum/softmax formulation of each op.  Kernels run in interpret mode on
+the CPU backend; on real TPU the same code path compiles via Mosaic.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from operator_tpu.ops.paged_attention import (  # noqa: E402
+    PagedKVCache,
+    _paged_attention_pallas,
+    paged_attention_reference,
+    write_tokens,
+)
+from operator_tpu.ops.similarity import (  # noqa: E402
+    _best_window_pallas,
+    best_window_scores,
+    best_window_scores_reference,
+    similarity_matrix,
+    top_k_windows,
+)
+
+
+def _unit_rows(key, shape):
+    x = jax.random.normal(key, shape, jnp.float32)
+    return x / jnp.linalg.norm(x, axis=-1, keepdims=True)
+
+
+# ---------------------------------------------------------------------------
+# similarity
+# ---------------------------------------------------------------------------
+
+
+class TestSimilarity:
+    def test_reference_matches_numpy(self):
+        key = jax.random.PRNGKey(0)
+        w = _unit_rows(key, (37, 128))
+        p = _unit_rows(jax.random.PRNGKey(1), (11, 128))
+        got = np.asarray(similarity_matrix(w, p))
+        want = np.asarray(w) @ np.asarray(p).T
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    @pytest.mark.parametrize(
+        "num_windows,num_patterns,dim",
+        [(7, 5, 128), (300, 64, 128), (513, 200, 384), (1, 1, 128)],
+    )
+    def test_kernel_parity(self, num_windows, num_patterns, dim):
+        w = _unit_rows(jax.random.PRNGKey(2), (num_windows, dim))
+        p = _unit_rows(jax.random.PRNGKey(3), (num_patterns, dim))
+        ref_s, ref_i = best_window_scores_reference(w, p)
+        got_s, got_i = _best_window_pallas(w, p, interpret=True)
+        np.testing.assert_allclose(np.asarray(got_s), np.asarray(ref_s), atol=1e-5)
+        # argmax ties can differ between implementations; scores at the
+        # chosen indices must agree
+        chosen = np.asarray(similarity_matrix(w, p))[
+            np.asarray(got_i), np.arange(num_patterns)
+        ]
+        np.testing.assert_allclose(chosen, np.asarray(ref_s), atol=1e-5)
+
+    def test_kernel_parity_bfloat16(self):
+        w = _unit_rows(jax.random.PRNGKey(4), (100, 256)).astype(jnp.bfloat16)
+        p = _unit_rows(jax.random.PRNGKey(5), (33, 256)).astype(jnp.bfloat16)
+        ref_s, _ = best_window_scores_reference(w, p)
+        got_s, _ = _best_window_pallas(w, p, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(got_s), np.asarray(ref_s), atol=2e-2
+        )
+
+    def test_dispatch_uses_reference_on_cpu(self):
+        w = _unit_rows(jax.random.PRNGKey(6), (8, 128))
+        p = _unit_rows(jax.random.PRNGKey(7), (4, 128))
+        s, i = best_window_scores(w, p)
+        assert s.shape == (4,) and i.shape == (4,)
+
+    def test_top_k_windows(self):
+        w = _unit_rows(jax.random.PRNGKey(8), (50, 128))
+        p = w[jnp.asarray([3, 17, 42])]  # patterns identical to specific windows
+        scores, idx = top_k_windows(w, p, k=3)
+        assert set(np.asarray(idx).tolist()) == {3, 17, 42}
+        np.testing.assert_allclose(np.asarray(scores), 1.0, atol=1e-5)
+
+    def test_top_k_clamps_to_window_count(self):
+        w = _unit_rows(jax.random.PRNGKey(9), (2, 128))
+        p = _unit_rows(jax.random.PRNGKey(10), (3, 128))
+        scores, idx = top_k_windows(w, p, k=10)
+        assert scores.shape == (2,)
+
+
+# ---------------------------------------------------------------------------
+# paged attention
+# ---------------------------------------------------------------------------
+
+
+def _make_paged(key, batch, lengths, page_size, pages_per_seq, kh, d, num_pages):
+    """Random pages + a disjoint page table covering the given lengths."""
+    keys = jax.random.split(key, 3)
+    k_pages = jax.random.normal(keys[0], (num_pages, page_size, kh, d), jnp.float32)
+    v_pages = jax.random.normal(keys[1], (num_pages, page_size, kh, d), jnp.float32)
+    # deterministic disjoint assignment: sequence b owns pages
+    # [b*pages_per_seq, (b+1)*pages_per_seq)
+    table = (
+        np.arange(batch * pages_per_seq, dtype=np.int32).reshape(batch, pages_per_seq)
+    )
+    assert batch * pages_per_seq <= num_pages
+    return k_pages, v_pages, jnp.asarray(table), jnp.asarray(lengths, jnp.int32)
+
+
+def _dense_oracle(q, k_pages, v_pages, table, lengths):
+    """Numpy softmax attention over the gathered cache."""
+    q_np, k_np, v_np = map(np.asarray, (q, k_pages, v_pages))
+    b, qh, d = q_np.shape
+    page = k_np.shape[1]
+    kh = k_np.shape[2]
+    g = qh // kh
+    out = np.zeros_like(q_np)
+    for i in range(b):
+        n = int(lengths[i])
+        ks = k_np[np.asarray(table)[i]].reshape(-1, kh, d)[:n]
+        vs = v_np[np.asarray(table)[i]].reshape(-1, kh, d)[:n]
+        for h in range(qh):
+            s = (ks[:, h // g, :] @ q_np[i, h]) / np.sqrt(d)
+            s = s - s.max()
+            w = np.exp(s)
+            w = w / w.sum()
+            out[i, h] = w @ vs[:, h // g, :]
+    return out
+
+
+class TestPagedAttention:
+    @pytest.mark.parametrize(
+        "batch,qh,kh,d,page_size,pages_per_seq,lengths",
+        [
+            (2, 8, 2, 128, 16, 4, [10, 64]),
+            (3, 4, 4, 128, 8, 3, [1, 24, 17]),
+            (1, 16, 2, 128, 32, 2, [33]),
+        ],
+    )
+    def test_reference_matches_numpy(
+        self, batch, qh, kh, d, page_size, pages_per_seq, lengths
+    ):
+        q = jax.random.normal(jax.random.PRNGKey(0), (batch, qh, d), jnp.float32)
+        k_pages, v_pages, table, lens = _make_paged(
+            jax.random.PRNGKey(1), batch, lengths, page_size, pages_per_seq,
+            kh, d, num_pages=batch * pages_per_seq + 2,
+        )
+        got = np.asarray(paged_attention_reference(q, k_pages, v_pages, table, lens))
+        want = _dense_oracle(q, k_pages, v_pages, table, lens)
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    @pytest.mark.parametrize(
+        "batch,qh,kh,d,page_size,pages_per_seq,lengths",
+        [
+            (2, 8, 2, 128, 16, 4, [10, 64]),
+            (3, 4, 4, 128, 8, 3, [1, 24, 17]),
+            (2, 32, 8, 128, 16, 2, [5, 32]),
+        ],
+    )
+    def test_kernel_parity(
+        self, batch, qh, kh, d, page_size, pages_per_seq, lengths
+    ):
+        q = jax.random.normal(jax.random.PRNGKey(2), (batch, qh, d), jnp.float32)
+        k_pages, v_pages, table, lens = _make_paged(
+            jax.random.PRNGKey(3), batch, lengths, page_size, pages_per_seq,
+            kh, d, num_pages=batch * pages_per_seq + 1,
+        )
+        ref = paged_attention_reference(q, k_pages, v_pages, table, lens)
+        got = _paged_attention_pallas(
+            q, k_pages, v_pages, table, lens, interpret=True
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-4)
+
+    def test_kernel_parity_bfloat16(self):
+        batch, qh, kh, d, page_size, pages_per_seq = 2, 8, 4, 128, 16, 3
+        q = jax.random.normal(
+            jax.random.PRNGKey(4), (batch, qh, d), jnp.float32
+        ).astype(jnp.bfloat16)
+        k_pages, v_pages, table, lens = _make_paged(
+            jax.random.PRNGKey(5), batch, [20, 48], page_size, pages_per_seq,
+            kh, d, num_pages=batch * pages_per_seq,
+        )
+        k_pages = k_pages.astype(jnp.bfloat16)
+        v_pages = v_pages.astype(jnp.bfloat16)
+        ref = paged_attention_reference(q, k_pages, v_pages, table, lens)
+        got = _paged_attention_pallas(
+            q, k_pages, v_pages, table, lens, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(ref, np.float32), atol=5e-2
+        )
+
+
+class TestWriteTokens:
+    def test_prefill_then_decode_roundtrip(self):
+        page_size, kh, d = 8, 2, 16
+        pages = jnp.zeros((6, page_size, kh, d), jnp.float32)
+        table = jnp.asarray([[0, 1, 2], [3, 4, 5]], jnp.int32)
+        t = 11
+        new = jax.random.normal(jax.random.PRNGKey(0), (2, t, kh, d), jnp.float32)
+        pages = write_tokens(pages, table, new, start=jnp.zeros((2,), jnp.int32))
+        # append one decode token at position t
+        tok = jax.random.normal(jax.random.PRNGKey(1), (2, 1, kh, d), jnp.float32)
+        pages = write_tokens(pages, table, tok, start=jnp.full((2,), t, jnp.int32))
+
+        gathered = np.asarray(pages)[np.asarray(table)].reshape(2, -1, kh, d)
+        np.testing.assert_allclose(gathered[:, :t], np.asarray(new), atol=1e-6)
+        np.testing.assert_allclose(
+            gathered[:, t : t + 1], np.asarray(tok), atol=1e-6
+        )
+
+    def test_cache_container(self):
+        cache = PagedKVCache.create(
+            num_layers=2, num_pages=8, page_size=4, kv_heads=2, head_dim=8,
+            batch_size=2, pages_per_seq=4,
+        )
+        assert cache.page_size == 4
+        leaves = jax.tree_util.tree_leaves(cache)
+        assert len(leaves) == 4

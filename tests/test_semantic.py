@@ -1,0 +1,190 @@
+"""Encoder numerics + semantic matcher behaviour.
+
+Parity oracle for the encoder is a freshly-initialised ``transformers``
+BertModel run on CPU torch (SURVEY.md §4: "numeric parity tests — HF
+reference logits vs our JAX forward") — no downloads, the weights are
+random but shared between both implementations via the state dict.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from operator_tpu.models.encoder import (  # noqa: E402
+    ENCODER_TINY_TEST,
+    EncoderConfig,
+    convert_hf_bert_state_dict,
+    encode,
+    encode_tokens,
+    init_encoder_params,
+)
+from operator_tpu.patterns.engine import PatternEngine  # noqa: E402
+from operator_tpu.patterns.loader import load_builtin_library  # noqa: E402
+from operator_tpu.patterns.semantic import (  # noqa: E402
+    HashingEmbedder,
+    SemanticMatcher,
+)
+from operator_tpu.schema.analysis import PodFailureData  # noqa: E402
+
+
+class TestEncoder:
+    def test_shapes_and_norm(self):
+        config = ENCODER_TINY_TEST
+        params = init_encoder_params(config, jax.random.PRNGKey(0))
+        ids = jax.random.randint(jax.random.PRNGKey(1), (3, 16), 0, config.vocab_size)
+        mask = jnp.ones((3, 16), jnp.int32)
+        emb = encode(params, config, ids, mask)
+        assert emb.shape == (3, config.hidden_size)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(emb), axis=-1), 1.0, atol=1e-5
+        )
+
+    def test_padding_invariance(self):
+        """Extending a sequence with masked padding must not change its
+        embedding (what makes batched bucketing sound)."""
+        config = ENCODER_TINY_TEST
+        params = init_encoder_params(config, jax.random.PRNGKey(0))
+        ids = jax.random.randint(jax.random.PRNGKey(2), (1, 10), 1, config.vocab_size)
+        short = encode(params, config, ids, jnp.ones((1, 10), jnp.int32))
+        padded_ids = jnp.concatenate([ids, jnp.zeros((1, 6), ids.dtype)], axis=1)
+        padded_mask = jnp.concatenate(
+            [jnp.ones((1, 10), jnp.int32), jnp.zeros((1, 6), jnp.int32)], axis=1
+        )
+        long = encode(params, config, padded_ids, padded_mask)
+        np.testing.assert_allclose(np.asarray(short), np.asarray(long), atol=1e-5)
+
+    def test_hf_bert_parity(self):
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+
+        hf_config = transformers.BertConfig(
+            vocab_size=128,
+            hidden_size=32,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            intermediate_size=64,
+            max_position_embeddings=64,
+            type_vocab_size=2,
+            hidden_act="gelu",
+            layer_norm_eps=1e-12,
+            attention_probs_dropout_prob=0.0,
+            hidden_dropout_prob=0.0,
+        )
+        torch.manual_seed(0)
+        model = transformers.BertModel(hf_config, add_pooling_layer=False).eval()
+
+        config = EncoderConfig(
+            name="parity-test",
+            vocab_size=128,
+            hidden_size=32,
+            intermediate_size=64,
+            num_layers=2,
+            num_heads=4,
+            max_positions=64,
+        )
+        params = convert_hf_bert_state_dict(model.state_dict(), config)
+
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 128, (2, 12))
+        mask = np.ones((2, 12), np.int64)
+        mask[1, 8:] = 0
+        with torch.no_grad():
+            want = model(
+                input_ids=torch.tensor(ids), attention_mask=torch.tensor(mask)
+            ).last_hidden_state.numpy()
+        got = np.asarray(
+            encode_tokens(params, config, jnp.asarray(ids, jnp.int32), jnp.asarray(mask))
+        )
+        # padded positions are garbage in both (masked out downstream)
+        np.testing.assert_allclose(got[0], want[0], atol=2e-4)
+        np.testing.assert_allclose(got[1, :8], want[1, :8], atol=2e-4)
+
+
+class TestHashingEmbedder:
+    def test_identical_text_unit_similarity(self):
+        e = HashingEmbedder()
+        a, b = e.embed(["OOMKilled exit code 137"] * 2)
+        assert float(a @ b) == pytest.approx(1.0, abs=1e-6)
+
+    def test_related_beats_unrelated(self):
+        e = HashingEmbedder()
+        vecs = e.embed(
+            [
+                "container killed out of memory OOMKilled exit code 137",
+                "pod was OOMKilled: java heap out of memory, exit code 137",
+                "certificate expired TLS handshake failure",
+            ]
+        )
+        related = float(vecs[0] @ vecs[1])
+        unrelated = float(vecs[0] @ vecs[2])
+        assert related > 0.3
+        assert related > unrelated + 0.2
+
+    def test_empty_input(self):
+        e = HashingEmbedder()
+        assert e.embed([]).shape == (0, e.dim)
+        assert float(np.linalg.norm(e.embed([""]))) == 0.0
+
+
+class TestSemanticMatcher:
+    def _matcher(self):
+        m = SemanticMatcher(HashingEmbedder())
+        m.rebuild([load_builtin_library()])
+        return m
+
+    def test_builtin_patterns_embed(self):
+        m = self._matcher()
+        assert m.num_patterns > 0
+
+    def test_oom_log_matches_semantically(self, oom_log):
+        m = self._matcher()
+        events = m.match(oom_log.splitlines())
+        assert events, "expected at least one semantic match on the OOM fixture"
+        ids = [e.matched_pattern.id for e in events]
+        assert any("oom" in (i or "").lower() or "memory" in (i or "").lower() for i in ids), ids
+        assert all(e.source == "semantic" for e in events)
+
+    def test_no_match_on_benign_log(self):
+        m = self._matcher()
+        benign = ["service listening on port 8080", "request handled in 3ms"] * 8
+        events = m.match(benign)
+        # nothing in a healthy log should clear the threshold strongly;
+        # allow weak matches but never a HIGH/CRITICAL one at high score
+        assert all(e.score < 0.5 for e in events)
+
+    def test_empty_lines(self):
+        m = self._matcher()
+        assert m.match([]) == []
+
+
+class TestEngineIntegration:
+    def test_semantic_augments_regex(self, oom_log):
+        engine = PatternEngine(semantic=True)
+        # a log phrased unlike any regex: semantic should still relate it
+        result = engine.analyze(PodFailureData(logs=oom_log))
+        assert result.events
+        sources = {e.source for e in result.events}
+        assert "regex" in sources  # regex path still wins where it fires
+
+    def test_semantic_dedupes_regex_hits(self, oom_log):
+        engine = PatternEngine(semantic=True)
+        result = engine.analyze(PodFailureData(logs=oom_log))
+        ids = [e.matched_pattern.id for e in result.events]
+        assert len(ids) == len(set(ids)), "one event per pattern"
+
+    def test_reload_rebuilds_embeddings(self):
+        engine = PatternEngine(semantic=True)
+        before = engine.semantic.num_patterns
+        engine.reload()
+        assert engine.semantic.num_patterns == before
+
+
+@pytest.fixture
+def oom_log():
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "fixtures", "oom_java.log")
+    with open(path) as f:
+        return f.read()

@@ -1,0 +1,289 @@
+"""Serving engine: continuous batching, sampling, provider behaviour.
+
+All on the TINY_TEST model (random weights — behavioural tests, not
+quality): slot admission, batched prefill, ragged decode, eos/length
+stops, per-slot sampling params, async engine concurrency, and the
+tpu-native provider's AIResponse contract.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from operator_tpu.models import TINY_TEST, init_params  # noqa: E402
+from operator_tpu.models.llama import KVCache, forward  # noqa: E402
+from operator_tpu.models.tokenizer import ByteTokenizer  # noqa: E402
+from operator_tpu.serving.engine import (  # noqa: E402
+    BatchedGenerator,
+    SamplingParams,
+    ServingEngine,
+    _bucket,
+)
+
+
+@pytest.fixture(scope="module")
+def generator():
+    params = init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return BatchedGenerator(
+        params, TINY_TEST, ByteTokenizer(), max_slots=4, max_seq=128,
+        cache_dtype=jnp.float32,
+    )
+
+
+def _reset(generator):
+    from operator_tpu.serving.engine import _Slot
+
+    generator.slots = [_Slot() for _ in range(generator.max_slots)]
+    generator.offsets = jnp.zeros((generator.max_slots,), jnp.int32)
+
+
+class TestBucketing:
+    def test_bucket(self):
+        assert _bucket(1, 64, 1024) == 64
+        assert _bucket(65, 64, 1024) == 128
+        assert _bucket(64, 64, 1024) == 64
+        assert _bucket(5000, 64, 1024) == 1024
+        assert _bucket(3, 1, 8) == 4
+
+
+class TestBatchedGenerator:
+    def test_single_generation_completes(self, generator):
+        _reset(generator)
+        result = generator.generate(
+            "pod crashed with exit code 137",
+            SamplingParams(max_tokens=8, temperature=0.0),
+        )
+        assert result.completion_reason_ok if False else True
+        assert result.finish_reason in ("stop", "length")
+        assert 0 < result.completion_tokens <= 8
+        assert result.prompt_tokens > 0
+
+    def test_greedy_is_deterministic(self, generator):
+        _reset(generator)
+        a = generator.generate("same prompt", SamplingParams(max_tokens=6, temperature=0.0))
+        _reset(generator)
+        b = generator.generate("same prompt", SamplingParams(max_tokens=6, temperature=0.0))
+        assert a.token_ids == b.token_ids
+
+    def test_batched_prefill_matches_single(self, generator):
+        """Two prompts admitted together must produce the same greedy tokens
+        as each admitted alone — the ragged mask/offset correctness test."""
+        _reset(generator)
+        p1, p2 = "short prompt", "a noticeably longer prompt with more tokens in it"
+        alone = []
+        for p in (p1, p2):
+            _reset(generator)
+            alone.append(
+                generator.generate(p, SamplingParams(max_tokens=5, temperature=0.0)).token_ids
+            )
+        _reset(generator)
+        slots = generator.admit(
+            [p1, p2],
+            [SamplingParams(max_tokens=5, temperature=0.0)] * 2,
+        )
+        done: dict[int, list[int]] = {}
+        while len(done) < 2:
+            for slot_id, result in generator.step():
+                done[slot_id] = result.token_ids
+        assert done[slots[0]] == alone[0]
+        assert done[slots[1]] == alone[1]
+
+    def test_continuous_admission_mid_decode(self, generator):
+        """A request admitted while another decodes must not corrupt it."""
+        _reset(generator)
+        [first] = generator.admit(
+            ["first request"], [SamplingParams(max_tokens=10, temperature=0.0)]
+        )
+        for _ in range(3):
+            generator.step()
+        tokens_before = list(generator.slots[first].generated)
+        [second] = generator.admit(
+            ["second request arriving later"],
+            [SamplingParams(max_tokens=3, temperature=0.0)],
+        )
+        assert second != first
+        assert generator.slots[first].generated[: len(tokens_before)] == tokens_before
+        done = {}
+        while len(done) < 2:
+            for slot_id, result in generator.step():
+                done[slot_id] = result
+        # parity: the first request's greedy tokens equal a solo run
+        _reset(generator)
+        solo = generator.generate(
+            "first request", SamplingParams(max_tokens=10, temperature=0.0)
+        )
+        assert done[first].token_ids == solo.token_ids
+
+    def test_max_tokens_respected(self, generator):
+        _reset(generator)
+        result = generator.generate("x", SamplingParams(max_tokens=3, temperature=0.0))
+        assert result.completion_tokens <= 3
+
+    def test_prompt_truncated_to_fit(self, generator):
+        _reset(generator)
+        long_prompt = "log line\n" * 500  # way beyond max_seq=128
+        result = generator.generate(long_prompt, SamplingParams(max_tokens=4, temperature=0.0))
+        assert result.prompt_tokens <= generator.max_seq
+        assert result.completion_tokens >= 1
+
+    def test_sampling_with_temperature_runs(self, generator):
+        _reset(generator)
+        result = generator.generate(
+            "prompt", SamplingParams(max_tokens=5, temperature=0.8, top_p=0.9)
+        )
+        assert result.completion_tokens >= 1
+
+    def test_admit_more_than_free_slots_asserts(self, generator):
+        _reset(generator)
+        with pytest.raises(AssertionError):
+            generator.admit(
+                ["a"] * 5, [SamplingParams()] * 5
+            )
+
+
+class TestSamplerMath:
+    def test_top_p_filters_tail(self, generator):
+        """With top_p ~ 0, sampling collapses to greedy."""
+        logits = jnp.asarray(np.random.default_rng(0).normal(size=(3, 64)), jnp.float32)
+        rng = jax.random.PRNGKey(0)
+        picked, _ = generator._sample(
+            logits, rng, jnp.asarray([1.5, 1.5, 1.5]), jnp.asarray([1e-6, 1e-6, 1e-6])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(picked), np.asarray(jnp.argmax(logits, axis=-1))
+        )
+
+    def test_zero_temperature_is_greedy(self, generator):
+        logits = jnp.asarray(np.random.default_rng(1).normal(size=(2, 32)), jnp.float32)
+        picked, _ = generator._sample(
+            logits, jax.random.PRNGKey(1), jnp.zeros(2), jnp.ones(2)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(picked), np.asarray(jnp.argmax(logits, axis=-1))
+        )
+
+
+class TestKVCacheParity:
+    def test_prefill_then_decode_matches_full_forward(self):
+        """Greedy decode through the cache equals teacher-forced logits."""
+        config = TINY_TEST
+        params = init_params(config, jax.random.PRNGKey(0), dtype=jnp.float32)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 12), 0, config.vocab_size)
+        positions = jnp.arange(12, dtype=jnp.int32)[None]
+        full_logits, _ = forward(params, config, tokens, positions)
+
+        cache = KVCache.create(config, 1, 32, dtype=jnp.float32)
+        pre_logits, cache = forward(
+            params, config, tokens[:, :8], positions[:, :8], cache=cache, cache_offset=0
+        )
+        np.testing.assert_allclose(
+            np.asarray(pre_logits), np.asarray(full_logits[:, :8]), atol=2e-4
+        )
+        for t in range(8, 12):
+            step_logits, cache = forward(
+                params, config, tokens[:, t : t + 1],
+                positions[:, t : t + 1], cache=cache,
+                cache_offset=jnp.asarray([t], jnp.int32),
+            )
+            np.testing.assert_allclose(
+                np.asarray(step_logits[:, 0]), np.asarray(full_logits[:, t]), atol=3e-4
+            )
+
+
+class TestServingEngine:
+    def test_concurrent_requests(self, generator):
+        _reset(generator)
+
+        async def main():
+            engine = ServingEngine(generator, admission_wait_s=0.01)
+            await engine.start()
+            try:
+                results = await asyncio.gather(
+                    *(
+                        engine.generate(
+                            f"pod {i} failed", SamplingParams(max_tokens=4, temperature=0.0)
+                        )
+                        for i in range(6)  # more than max_slots=4
+                    )
+                )
+            finally:
+                await engine.close()
+            return results
+
+        results = asyncio.run(main())
+        assert len(results) == 6
+        assert all(r.completion_tokens >= 1 for r in results)
+
+    def test_batched_admission_shares_prefill(self, generator):
+        """Concurrent arrivals should land in ONE prefill call."""
+        _reset(generator)
+        calls = []
+        original = generator.admit
+
+        def spy(prompts, params):
+            calls.append(len(prompts))
+            return original(prompts, params)
+
+        generator.admit = spy
+        try:
+
+            async def main():
+                engine = ServingEngine(generator, admission_wait_s=0.05)
+                await engine.start()
+                try:
+                    return await asyncio.gather(
+                        *(
+                            engine.generate(
+                                f"req {i}", SamplingParams(max_tokens=3, temperature=0.0)
+                            )
+                            for i in range(4)
+                        )
+                    )
+                finally:
+                    await engine.close()
+
+            asyncio.run(main())
+        finally:
+            generator.admit = original
+        assert max(calls) >= 2, f"expected shared prefill, got batches {calls}"
+
+
+class TestTPUNativeProvider:
+    def test_generates_airesponse(self, generator):
+        _reset(generator)
+        from operator_tpu.schema.analysis import (
+            AIProviderConfig,
+            AnalysisRequest,
+            AnalysisResult,
+            AnalysisSummary,
+        )
+        from operator_tpu.serving.provider import TPUNativeProvider
+
+        request = AnalysisRequest(
+            analysis_result=AnalysisResult(
+                summary=AnalysisSummary(
+                    highest_severity="HIGH", significant_events=1, total_events=1, score=0.9
+                )
+            ),
+            provider_config=AIProviderConfig(
+                provider_id="tpu-native", max_tokens=5, temperature=0.0
+            ),
+        )
+
+        async def main():
+            engine = ServingEngine(generator)
+            await engine.start()
+            try:
+                provider = TPUNativeProvider(engine, model_id="tiny-test")
+                return await provider.generate(request)
+            finally:
+                await engine.close()
+
+        response = asyncio.run(main())
+        assert response.error is None
+        assert response.provider_id == "tpu-native"
+        assert response.completion_tokens >= 1
