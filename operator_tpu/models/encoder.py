@@ -124,14 +124,12 @@ def encode_tokens(
 ) -> jax.Array:
     """Token-level hidden states [B, T, H] (post-LN BERT stack)."""
     b, t = token_ids.shape
-    positions = jnp.arange(t, dtype=jnp.int32)
     x = (
         jnp.take(params["tok_embed"], token_ids, axis=0)
         + params["pos_embed"][None, :t]
         + params["type_embed"][0][None, None, :]
     )
     x = _layer_norm(x, params["ln_embed_scale"], params["ln_embed_bias"], config.layer_norm_eps)
-    del positions
 
     nh, d = config.num_heads, config.head_dim
     # additive mask [B, 1, 1, T] — padded keys get -inf before softmax

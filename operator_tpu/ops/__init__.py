@@ -10,8 +10,10 @@ and the reference elsewhere.
 Reference-system context (SURVEY.md §2.2): the external log-parser service
 the reference called over REST is rebuilt as in-tree scoring; its hot op —
 pattern-embedding × log-window-embedding similarity — lives here.  The
-paged-attention kernel backs the serving engine's batched decode
-(BASELINE config 4: 32 concurrent failure events).
+paged-attention kernel is the ragged-KV building block for batched decode
+at 8B scale (BASELINE config 4); the serving engine currently runs on a
+contiguous per-slot KV cache and adopts the paged path when the KV budget
+(batch × max_seq) outgrows HBM — see serving/engine.py.
 """
 
 from .similarity import (

@@ -22,12 +22,13 @@ from __future__ import annotations
 import logging
 import threading
 import time
+import uuid
 from typing import Optional
 
 from ..schema.analysis import AnalysisResult, PodFailureData, StageTimings
 from ..schema.kube import Pod
 from .loader import LoadedLibrary, load_builtin_library, load_libraries
-from .matcher import MatcherConfig, fold_events, match_libraries
+from .matcher import MatcherConfig, collect_events, fold_events
 from .semantic import SemanticMatcher
 from .windows import split_lines
 
@@ -134,26 +135,27 @@ class PatternEngine:
         lines.extend(event_evidence_lines(failure))
         lines.extend(status_evidence_lines(failure.pod))
         pod = failure.pod
-        result = match_libraries(
-            self.libraries,
-            lines,
-            self.config,
-            pod_name=pod.metadata.name if pod else None,
-            pod_namespace=pod.metadata.namespace if pod else None,
-        )
+        # collect the UNtruncated regex/keyword hits first so the semantic
+        # merge dedupes and summarises over the full set — one fold at the
+        # end ranks/truncates exactly once
+        events = collect_events(self.libraries, lines, self.config)
         if self.semantic is not None and self.semantic.num_patterns:
             # semantic catches what regex missed; a pattern already hit by
             # its regex keeps the (higher-precision) regex event only
-            matched_ids = {e.matched_pattern.id for e in result.events}
-            extra = [
+            matched_ids = {e.matched_pattern.id for e in events}
+            events.extend(
                 e
                 for e in self.semantic.match(lines)
                 if e.matched_pattern.id not in matched_ids
-            ]
-            if extra:
-                result.summary, result.events = fold_events(
-                    result.events + extra, self.config
-                )
+            )
+        summary, folded = fold_events(events, self.config)
+        result = AnalysisResult(
+            analysis_id=str(uuid.uuid4()),
+            pod_name=pod.metadata.name if pod else None,
+            pod_namespace=pod.metadata.namespace if pod else None,
+            summary=summary,
+            events=folded,
+        )
         result.timings = StageTimings(parse_ms=round((time.perf_counter() - started) * 1e3, 3))
         return result
 

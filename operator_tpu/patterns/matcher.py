@@ -155,6 +155,22 @@ def fold_events(
     return summary, events[: config.max_total_events]
 
 
+def collect_events(
+    libraries: list[LoadedLibrary],
+    lines: list[str],
+    config: Optional[MatcherConfig] = None,
+) -> list[AnalysisEvent]:
+    """Score every pattern of every library against the log lines; returns
+    the UNtruncated event list so callers can merge other sources (e.g. the
+    semantic matcher) before the single fold_events ranking pass."""
+    config = config or MatcherConfig()
+    events: list[AnalysisEvent] = []
+    for library in libraries:
+        for pattern in library.patterns:
+            events.extend(match_pattern(pattern, lines, config))
+    return events
+
+
 def match_libraries(
     libraries: list[LoadedLibrary],
     lines: list[str],
@@ -166,11 +182,7 @@ def match_libraries(
     """Score every pattern of every library against the log lines and fold
     the hits into one AnalysisResult (highest-scoring events first)."""
     config = config or MatcherConfig()
-    events: list[AnalysisEvent] = []
-    for library in libraries:
-        for pattern in library.patterns:
-            events.extend(match_pattern(pattern, lines, config))
-    summary, events = fold_events(events, config)
+    summary, events = fold_events(collect_events(libraries, lines, config), config)
     return AnalysisResult(
         analysis_id=str(uuid.uuid4()),
         pod_name=pod_name,

@@ -24,6 +24,7 @@ the sync reconciler" of SURVEY.md §7 stage 3.
 from __future__ import annotations
 
 import logging
+import re
 import zlib
 from typing import Optional, Protocol, Sequence
 
@@ -48,7 +49,7 @@ class Embedder(Protocol):
     def embed(self, texts: Sequence[str]) -> np.ndarray: ...
 
 
-_REGEX_TOKEN = __import__("re").compile(r"[A-Za-z][A-Za-z0-9_.]{2,}")
+_REGEX_TOKEN = re.compile(r"[A-Za-z][A-Za-z0-9_.]{2,}")
 
 
 def regex_literals(regex: Optional[str]) -> list[str]:

@@ -308,6 +308,7 @@ class BatchedGenerator:
 
         finished: list[tuple[int, GenerationResult]] = []
         eos = self.tokenizer.eos_id
+        offsets_np = np.asarray(self.offsets)  # one device fetch per step
         for i, slot in enumerate(self.slots):
             if not slot.active:
                 continue
@@ -317,8 +318,13 @@ class BatchedGenerator:
             if slot.params.stop_on_eos and eos is not None and previous == eos:
                 finished.append((i, self._finish(i, reason="stop")))
                 continue
+            if len(slot.generated) >= slot.params.max_tokens:
+                # budget already consumed (the prefill-sampled token counts);
+                # discard this step's token so max_tokens is exact
+                finished.append((i, self._finish(i, reason="length")))
+                continue
             slot.generated.append(token)
-            total = int(np.asarray(self.offsets)[i])
+            total = int(offsets_np[i])
             if (
                 len(slot.generated) >= slot.params.max_tokens
                 or total >= self.max_seq - 1
@@ -338,7 +344,9 @@ class BatchedGenerator:
             completion_tokens=len(ids),
             finish_reason=reason,
             prefill_ms=slot.prefill_ms,
-            decode_ms=(time.perf_counter() - slot.started) * 1e3 - slot.prefill_ms,
+            # slot.started is stamped AFTER prefill completes, so this span
+            # is pure decode time already
+            decode_ms=(time.perf_counter() - slot.started) * 1e3,
         )
         self.slots[slot_id] = _Slot()
         return result
@@ -373,8 +381,10 @@ class ServingEngine:
         self.admission_wait_s = admission_wait_s
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
         self._pending: dict[int, asyncio.Future] = {}  # slot id -> future
+        self._inflight: list = []  # popped from queue, not yet in _pending
         self._task: Optional[asyncio.Task] = None
         self._closed = False
+        self._error: Optional[BaseException] = None
 
     async def start(self) -> None:
         if self._task is None:
@@ -389,20 +399,57 @@ class ServingEngine:
             except asyncio.CancelledError:
                 pass
             self._task = None
+        self._fail_outstanding(asyncio.CancelledError("serving engine closed"))
+
+    def _fail_outstanding(self, exc: BaseException) -> None:
+        """Resolve every in-flight and queued future so callers never hang."""
+        for future in self._pending.values():
+            if not future.done():
+                future.set_exception(exc)
+        self._pending.clear()
+        for _, _, future in self._inflight:  # popped but not yet admitted
+            if not future.done():
+                future.set_exception(exc)
+        self._inflight.clear()
+        while not self._queue.empty():
+            _, _, future = self._queue.get_nowait()
+            if not future.done():
+                future.set_exception(exc)
 
     async def generate(
         self, prompt: str, params: Optional[SamplingParams] = None
     ) -> GenerationResult:
+        if self._closed:
+            raise RuntimeError("serving engine is closed")
+        if self._error is not None:
+            raise RuntimeError("serving engine loop died") from self._error
         if self._task is None:
             await self.start()
         future: asyncio.Future = asyncio.get_running_loop().create_future()
         await self._queue.put((prompt, params or SamplingParams(), future))
+        # the put may have landed after close()/loop-death drained the
+        # queue; _closed/_error were set before the drain, so re-checking
+        # here closes that window
+        if (self._closed or self._error is not None) and not future.done():
+            future.set_exception(RuntimeError("serving engine is closed"))
         return await future
 
     # ------------------------------------------------------------------
     async def _run(self) -> None:
+        try:
+            await self._serve()
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # generator/device failure: fail fast, loudly
+            log.exception("serving engine loop died")
+            self._error = exc
+            self._fail_outstanding(exc)
+
+    async def _serve(self) -> None:
         while not self._closed:
-            batch = []
+            # requests live in self._inflight between queue pop and slot
+            # admission so cancellation/crash cleanup can always see them
+            batch = self._inflight
             if self.generator.num_active == 0 and self._queue.empty():
                 # fully idle: block until a request arrives
                 batch.append(await self._queue.get())
@@ -415,6 +462,7 @@ class ServingEngine:
                     batch.append(self._queue.get_nowait())
             if batch:
                 await self._admit(batch)
+                self._inflight = []
 
             if self.generator.num_active:
                 finished = await asyncio.to_thread(self.generator.step)
@@ -427,6 +475,14 @@ class ServingEngine:
     async def _admit(self, batch) -> None:
         prompts = [prompt for prompt, _, _ in batch]
         params = [p for _, p, _ in batch]
-        slot_ids = await asyncio.to_thread(self.generator.admit, prompts, params)
+        try:
+            slot_ids = await asyncio.to_thread(self.generator.admit, prompts, params)
+        except BaseException as exc:
+            # the batch futures are out of the queue but not yet in
+            # _pending — fail them here or their callers hang forever
+            for _, _, future in batch:
+                if not future.done():
+                    future.set_exception(exc)
+            raise
         for slot_id, (_, _, future) in zip(slot_ids, batch):
             self._pending[slot_id] = future

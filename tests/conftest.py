@@ -9,7 +9,19 @@ which is why this lives at conftest import time.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force (not setdefault): the environment may pre-set JAX_PLATFORMS to a
+# tunneled TPU backend, and unit tests must never depend on tunnel health
+os.environ["JAX_PLATFORMS"] = "cpu"
+# the env's sitecustomize may have ALREADY imported jax and registered a
+# TPU plugin at interpreter boot, in which case the env var above is read
+# too late — jax.config.update rewrites the live flag before any backend
+# is initialised, keeping unit tests off the (possibly unhealthy) tunnel
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # pragma: no cover - jax genuinely unavailable
+    pass
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

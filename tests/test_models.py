@@ -208,6 +208,7 @@ def test_logit_parity_float64_strict(hf_tiny_model, tmp_path):
 import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
+jax.config.update("jax_platforms", "cpu")  # sitecustomize may pre-import jax
 jax.config.update("jax_enable_x64", True)
 import numpy as np, torch, jax.numpy as jnp
 import sys

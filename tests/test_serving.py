@@ -57,7 +57,6 @@ class TestBatchedGenerator:
             "pod crashed with exit code 137",
             SamplingParams(max_tokens=8, temperature=0.0),
         )
-        assert result.completion_reason_ok if False else True
         assert result.finish_reason in ("stop", "length")
         assert 0 < result.completion_tokens <= 8
         assert result.prompt_tokens > 0
@@ -117,6 +116,15 @@ class TestBatchedGenerator:
             "first request", SamplingParams(max_tokens=10, temperature=0.0)
         )
         assert done[first].token_ids == solo.token_ids
+
+    def test_max_tokens_one_is_exact(self, generator):
+        """The prefill-sampled token counts; maxTokens: 1 means ONE token."""
+        _reset(generator)
+        result = generator.generate(
+            "boom", SamplingParams(max_tokens=1, temperature=0.0, stop_on_eos=False)
+        )
+        assert result.completion_tokens == 1
+        assert result.finish_reason == "length"
 
     def test_max_tokens_respected(self, generator):
         _reset(generator)
@@ -250,6 +258,46 @@ class TestServingEngine:
         finally:
             generator.admit = original
         assert max(calls) >= 2, f"expected shared prefill, got batches {calls}"
+
+    def test_close_resolves_inflight_futures(self, generator):
+        """close() must never strand a caller awaiting generate()."""
+        _reset(generator)
+
+        async def main():
+            engine = ServingEngine(generator)
+            task = asyncio.create_task(
+                engine.generate("pod stuck", SamplingParams(max_tokens=512))
+            )
+            await asyncio.sleep(0.05)  # let it enter the queue / a slot
+            await engine.close()
+            with pytest.raises((asyncio.CancelledError, RuntimeError)):
+                await task
+            with pytest.raises(RuntimeError):
+                await engine.generate("after close")
+
+        asyncio.run(main())
+
+    def test_loop_death_fails_fast(self, generator):
+        """A generator crash must reject in-flight and future callers."""
+        _reset(generator)
+        original = generator.admit
+
+        def boom(prompts, params):
+            raise ValueError("device fell over")
+
+        generator.admit = boom
+        try:
+
+            async def main():
+                engine = ServingEngine(generator)
+                with pytest.raises(ValueError):
+                    await engine.generate("pod failed", SamplingParams(max_tokens=2))
+                with pytest.raises(RuntimeError):
+                    await engine.generate("next request")
+
+            asyncio.run(main())
+        finally:
+            generator.admit = original
 
 
 class TestTPUNativeProvider:
