@@ -1,0 +1,27 @@
+# Runtime image (role of the reference's Dockerfile.native: a single
+# self-contained artifact).  The reference AOT-compiles Java to a native
+# binary; the TPU equivalent of that ahead-of-time work is XLA compilation,
+# which happens at startup against the attached TPU and is cached — so the
+# image stays a slim Python layer over libtpu.
+FROM python:3.12-slim AS base
+
+RUN useradd -u 1001 -m operator && apt-get update \
+    && apt-get install -y --no-install-recommends git \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+
+# jax[tpu] pulls libtpu via the google releases index; pinned for
+# reproducible serving behaviour
+RUN pip install --no-cache-dir "jax[tpu]==0.9.0" \
+      -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    && pip install --no-cache-dir pyyaml
+
+COPY operator_tpu/ operator_tpu/
+COPY pyproject.toml README.md ./
+RUN pip install --no-cache-dir --no-deps .
+
+USER 1001
+# health + metrics endpoint probed by the kubelet (deploy/operator-deployment.yaml)
+EXPOSE 8080
+ENTRYPOINT ["python", "-m", "operator_tpu.operator"]

@@ -23,6 +23,7 @@ from ..utils.config import OperatorConfig
 from ..utils.timing import METRICS, MetricsRegistry
 from .events import EventService
 from .health import LivenessCheck, ReadinessCheck
+from .httpserver import HealthServer
 from .kubeapi import FakeKubeApi, KubeApi
 from .patternsync import GitSyncService, PatternLibraryReconciler
 from .pipeline import AnalysisPipeline
@@ -75,6 +76,15 @@ class Operator:
         )
         self.readiness = ReadinessCheck(api, self.config)
         self.liveness = LivenessCheck()
+        self.health_server: Optional[HealthServer] = None
+        if self.config.health_port >= 0:
+            self.health_server = HealthServer(
+                self.liveness,
+                self.readiness,
+                metrics=self.metrics,
+                host=self.config.health_host,
+                port=self.config.health_port,
+            )
         self._stop = asyncio.Event()
         self._tasks: list[asyncio.Task] = []
 
@@ -95,6 +105,8 @@ class Operator:
         log.info("operator starting (namespaces: %s)",
                  self.config.watch_namespaces or "ALL")
         self._stop.clear()
+        if self.health_server is not None:
+            await self.health_server.start()
         self._tasks = [
             asyncio.create_task(self.watcher.run(self._stop), name="pod-watcher"),
             asyncio.create_task(self.podmortem_reconciler.run(self._stop), name="podmortem-reconciler"),
@@ -104,6 +116,8 @@ class Operator:
 
     async def stop(self) -> None:
         self._stop.set()
+        if self.health_server is not None:
+            await self.health_server.stop()
         await self.watcher.drain()
         for task in self._tasks:
             task.cancel()
@@ -146,7 +160,10 @@ async def run_demo(logfile: Optional[str] = None, provider_id: str = "template")
     from ..schema.crds import Podmortem
 
     api = FakeKubeApi()
-    config = OperatorConfig(pattern_cache_directory="/nonexistent-demo-cache")
+    config = OperatorConfig(
+        pattern_cache_directory="/nonexistent-demo-cache",
+        health_port=0,  # ephemeral: demo runs shouldn't contend for :8080
+    )
     operator = Operator(api, config=config)
 
     # user objects: one AIProvider + one Podmortem watching app=payment
@@ -227,8 +244,17 @@ def _main(argv: Optional[list[str]] = None) -> int:
     logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO,
                         format="%(asctime)s %(levelname)-7s %(name)s: %(message)s")
     if not args.demo:
-        parser.error("only --demo mode is available without a cluster "
-                     "(in-cluster mode arrives with operator_tpu.operator.httpapi)")
+        from .kubeapi import ApiError
+
+        try:
+            return asyncio.run(_run_real(OperatorConfig.from_env()))
+        except (ApiError, FileNotFoundError) as exc:
+            print(
+                f"error: no cluster access ({exc}); "
+                "run in-cluster, point KUBECONFIG at a cluster, or use --demo",
+                file=sys.stderr,
+            )
+            return 2
     try:
         summary = asyncio.run(run_demo(args.logfile, args.provider))
     except OSError as exc:
@@ -238,6 +264,32 @@ def _main(argv: Optional[list[str]] = None) -> int:
         print(json.dumps(summary, indent=2))
     except BrokenPipeError:
         sys.stderr.close()
+    return 0
+
+
+async def _run_real(config: OperatorConfig) -> int:
+    """In-cluster / kubeconfig mode: the shipped deployment's entrypoint
+    (deploy/operator-deployment.yaml runs ``python -m operator_tpu.operator``)."""
+    import signal
+
+    from .httpapi import HttpKubeApi
+
+    api = HttpKubeApi.from_env()
+    operator = Operator(api, config=config)
+    loop = asyncio.get_running_loop()
+    stop = asyncio.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    await operator.start()
+    try:
+        stopped = asyncio.create_task(stop.wait())
+        tasks = [*operator._tasks, stopped]
+        done, _ = await asyncio.wait(tasks, return_when=asyncio.FIRST_COMPLETED)
+        for task in done:
+            if task is not stopped and task.exception() is not None:
+                raise task.exception()
+    finally:
+        await operator.stop()
     return 0
 
 

@@ -1,0 +1,123 @@
+"""Health + metrics HTTP endpoint (stdlib asyncio, no framework).
+
+The reference serves MicroProfile health at ``/q/health/{live,ready}`` and
+is probed by the kubelet (reference operator-deployment.yaml:61-78); it has
+no metrics endpoint at all (SURVEY.md §5 tracing entry).  Here one tiny
+asyncio HTTP server exposes:
+
+- ``GET /healthz/live``  — liveness (event loop answers)
+- ``GET /healthz/ready`` — readiness (pattern cache gating, health.py)
+- ``GET /metrics``       — JSON snapshot of the per-stage latency registry
+  (detect→collect→parse→prefill→decode→store), the observability the
+  p50<2s SLO needs
+
+Responses are JSON; probe failures return 503 so the kubelet treats the
+pod exactly as it treats the reference's native binary.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional
+
+from ..utils.timing import METRICS, MetricsRegistry
+from .health import LivenessCheck, ReadinessCheck
+
+log = logging.getLogger(__name__)
+
+_MAX_REQUEST_LINE = 8192
+
+
+class HealthServer:
+    """Minimal HTTP/1.1 server for kubelet probes and metrics scrapes.
+
+    Close-delimited responses (``Connection: close``) keep the parser
+    trivial: read the request line, ignore headers, answer, close.
+    """
+
+    def __init__(
+        self,
+        liveness: LivenessCheck,
+        readiness: ReadinessCheck,
+        *,
+        metrics: Optional[MetricsRegistry] = None,
+        host: str = "0.0.0.0",
+        port: int = 8080,
+    ) -> None:
+        self.liveness = liveness
+        self.readiness = readiness
+        self.metrics = metrics or METRICS
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    @property
+    def bound_port(self) -> Optional[int]:
+        """The actual port (differs from ``port`` when 0 = ephemeral)."""
+        if self._server is None or not self._server.sockets:
+            return None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        log.info("health server listening on %s:%s", self.host, self.bound_port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # ------------------------------------------------------------------
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            line = await reader.readline()
+            if len(line) > _MAX_REQUEST_LINE or not line:
+                return
+            parts = line.decode("latin-1").split()
+            if len(parts) < 2:
+                return
+            method, path = parts[0], parts[1].split("?")[0]
+            status, body = await self._route(method, path)
+            payload = json.dumps(body).encode()
+            writer.write(
+                b"HTTP/1.1 %d %s\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: %d\r\n"
+                b"Connection: close\r\n\r\n"
+                % (status, b"OK" if status == 200 else b"ERR", len(payload))
+            )
+            if method != "HEAD":  # HEAD: headers only, no body
+                writer.write(payload)
+            await writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _route(self, method: str, path: str) -> tuple[int, dict]:
+        if method not in ("GET", "HEAD"):
+            return 405, {"error": "method not allowed"}
+        if path in ("/healthz/live", "/livez"):
+            status = await self.liveness.check()
+            return (200 if status.ready else 503), {
+                "status": "UP" if status.ready else "DOWN",
+                "reason": status.reason,
+            }
+        if path in ("/healthz/ready", "/readyz"):
+            status = await self.readiness.check()
+            return (200 if status.ready else 503), {
+                "status": "UP" if status.ready else "DOWN",
+                "reason": status.reason,
+            }
+        if path == "/metrics":
+            return 200, self.metrics.snapshot()
+        return 404, {"error": f"no route {path}"}

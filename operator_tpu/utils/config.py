@@ -43,6 +43,11 @@ class OperatorConfig:
     ai_timeout_s: float = 180.0
     log_tail_bytes: int = 1_000_000  # cap on fetched pod log
 
+    # --- health / metrics endpoint (reference operator-deployment.yaml:61-78
+    # probes /q/health/*; ours serves /healthz/* + /metrics) ---------------
+    health_host: str = "0.0.0.0"
+    health_port: int = 8080  # 0 = ephemeral (tests), -1 = disabled
+
     # --- serving ----------------------------------------------------------
     model_id: str = "tinyllama-1.1b"
     checkpoint_dir: Optional[str] = None

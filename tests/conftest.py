@@ -15,13 +15,13 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # the env's sitecustomize may have ALREADY imported jax and registered a
 # TPU plugin at interpreter boot, in which case the env var above is read
 # too late — jax.config.update rewrites the live flag before any backend
-# is initialised, keeping unit tests off the (possibly unhealthy) tunnel
-try:
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-except Exception:  # pragma: no cover - jax genuinely unavailable
-    pass
+# is initialised, keeping unit tests off the (possibly unhealthy) tunnel.
+# Only needed when jax is pre-imported; otherwise skip the costly import.
+if "jax" in sys.modules:
+    try:
+        sys.modules["jax"].config.update("jax_platforms", "cpu")
+    except Exception:  # pragma: no cover - partially initialised jax
+        pass
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,236 @@
+"""HttpKubeApi against a local fake apiserver (stdlib http.server).
+
+Covers path construction, label-selector encoding, merge-patch with
+resourceVersion (409 mapping), status subresource, pod logs, watch
+streaming + server-close semantics, and config loading (in-cluster files
+and kubeconfig parsing).
+"""
+
+import asyncio
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from operator_tpu.operator.httpapi import (
+    ClusterConfig,
+    HttpKubeApi,
+    _selector_string,
+    load_incluster_config,
+    load_kubeconfig,
+)
+from operator_tpu.operator.kubeapi import (
+    ApiError,
+    ConflictError,
+    ForbiddenError,
+    NotFoundError,
+    WatchClosed,
+)
+from operator_tpu.schema.meta import LabelSelector, LabelSelectorRequirement
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Canned apiserver: records requests on the server object."""
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _send(self, status, body: bytes, content_type="application/json"):
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        self.server.requests.append(("GET", self.path, dict(self.headers), None))
+        if self.path.startswith("/api/v1/namespaces/default/pods/crashy/log"):
+            self._send(200, b"line1\nline2\n", "text/plain")
+        elif "watch=true" in self.path:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            for event in self.server.watch_events:
+                self.wfile.write(json.dumps(event).encode() + b"\n")
+                self.wfile.flush()
+            # then close: client must raise WatchClosed
+        elif self.path.startswith("/apis/podmortem.tpu.dev/v1alpha1/namespaces/ns1/podmortems/missing"):
+            self._send(404, json.dumps({"message": "podmortems \"missing\" not found"}).encode())
+        elif self.path.startswith("/api/v1/namespaces/locked"):
+            self._send(403, json.dumps({"message": "forbidden"}).encode())
+        elif "/pods" in self.path:
+            items = [{"metadata": {"name": "p1", "namespace": "default"}}]
+            self._send(200, json.dumps({"kind": "PodList", "items": items}).encode())
+        else:
+            self._send(200, json.dumps({"metadata": {"name": "obj", "resourceVersion": "7"}}).encode())
+
+    def do_PATCH(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(length)) if length else {}
+        self.server.requests.append(("PATCH", self.path, dict(self.headers), body))
+        if body.get("metadata", {}).get("resourceVersion") == "stale":
+            self._send(409, json.dumps({"message": "conflict"}).encode())
+        else:
+            merged = {**body, "metadata": {**body.get("metadata", {}), "resourceVersion": "8"}}
+            self._send(200, json.dumps(merged).encode())
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(length)) if length else {}
+        self.server.requests.append(("POST", self.path, dict(self.headers), body))
+        self._send(201, json.dumps(body).encode())
+
+    def do_DELETE(self):
+        self.server.requests.append(("DELETE", self.path, dict(self.headers), None))
+        self._send(200, b"{}")
+
+
+@pytest.fixture()
+def fake_apiserver():
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+    server.requests = []
+    server.watch_events = [
+        {"type": "ADDED", "object": {"metadata": {"name": "a"}}},
+        {"type": "BOOKMARK", "object": {}},
+        {"type": "MODIFIED", "object": {"metadata": {"name": "a"}}},
+    ]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield server
+    server.shutdown()
+    thread.join(timeout=5)
+
+
+@pytest.fixture()
+def api(fake_apiserver):
+    config = ClusterConfig(
+        host="127.0.0.1", port=fake_apiserver.server_address[1],
+        scheme="http", token="tok-123", namespace="default",
+    )
+    return HttpKubeApi(config)
+
+
+def test_selector_string_full():
+    selector = LabelSelector(
+        match_labels={"app": "payment", "tier": "api"},
+        match_expressions=[
+            LabelSelectorRequirement(key="env", operator="In", values=["prod", "dev"]),
+            LabelSelectorRequirement(key="canary", operator="DoesNotExist"),
+        ],
+    )
+    assert _selector_string(selector) == "app=payment,tier=api,env in (prod,dev),!canary"
+    assert _selector_string(None) is None
+    assert _selector_string(LabelSelector()) is None
+
+
+def test_list_sends_selector_and_bearer(api, fake_apiserver):
+    pods = asyncio.run(api.list("Pod", "default", LabelSelector(match_labels={"app": "x"})))
+    assert pods == [{"metadata": {"name": "p1", "namespace": "default"}, "kind": "Pod"}]
+    method, path, headers, _ = fake_apiserver.requests[-1]
+    assert path.startswith("/api/v1/namespaces/default/pods?labelSelector=")
+    assert "app%3Dx" in path
+    assert headers["Authorization"] == "Bearer tok-123"
+
+
+def test_crd_paths_and_errors(api):
+    with pytest.raises(NotFoundError):
+        asyncio.run(api.get("Podmortem", "missing", "ns1"))
+    with pytest.raises(ForbiddenError):
+        asyncio.run(api.list("Pod", "locked"))
+    with pytest.raises(ApiError):
+        asyncio.run(api.get("Gizmo", "x", "ns"))
+
+
+def test_patch_status_merge_and_conflict(api, fake_apiserver):
+    result = asyncio.run(
+        api.patch_status("Podmortem", "pm1", "ns1", {"phase": "Ready"}, resource_version="7")
+    )
+    method, path, headers, body = fake_apiserver.requests[-1]
+    assert path == "/apis/podmortem.tpu.dev/v1alpha1/namespaces/ns1/podmortems/pm1/status"
+    assert headers["Content-Type"] == "application/merge-patch+json"
+    assert body["status"] == {"phase": "Ready"}
+    assert body["metadata"]["resourceVersion"] == "7"
+    assert result["metadata"]["resourceVersion"] == "8"
+
+    with pytest.raises(ConflictError):
+        asyncio.run(
+            api.patch("Pod", "p1", "default", {"metadata": {"labels": {}}},
+                      resource_version="stale")
+        )
+
+
+def test_get_log_params(api, fake_apiserver):
+    text = asyncio.run(
+        api.get_log("crashy", "default", container="app", previous=True, tail_bytes=512)
+    )
+    assert text == "line1\nline2\n"
+    _, path, _, _ = fake_apiserver.requests[-1]
+    assert "container=app" in path and "previous=true" in path and "limitBytes=512" in path
+
+
+def test_watch_streams_then_raises_closed(api):
+    async def main():
+        seen = []
+        with pytest.raises(WatchClosed):
+            async for event in api.watch("Pod", "default"):
+                seen.append(event)
+        return seen
+
+    events = asyncio.run(main())
+    # bookmark filtered out
+    assert [e.type for e in events] == ["ADDED", "MODIFIED"]
+    assert events[0].object["kind"] == "Pod"
+
+
+def test_connect_timeout_semantics(api):
+    # omitted -> default; explicit None -> unbounded (watch streams must
+    # never die on idle clusters)
+    assert api._connect().timeout == api.request_timeout_s
+    assert api._connect(timeout=None).timeout is None
+
+
+def test_incluster_config(tmp_path, monkeypatch):
+    (tmp_path / "token").write_text("sa-token\n")
+    (tmp_path / "namespace").write_text("podmortem-system")
+    (tmp_path / "ca.crt").write_text("fake-ca")
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+    monkeypatch.setenv("KUBERNETES_SERVICE_PORT", "6443")
+    config = load_incluster_config(str(tmp_path))
+    assert config.host == "10.0.0.1" and config.port == 6443
+    assert config.token == "sa-token"
+    assert config.namespace == "podmortem-system"
+    assert config.ca_file == str(tmp_path / "ca.crt")
+
+
+def test_kubeconfig_parsing(tmp_path):
+    ca_b64 = base64.b64encode(b"ca-bytes").decode()
+    doc = {
+        "current-context": "dev",
+        "contexts": [{"name": "dev", "context": {"cluster": "c1", "user": "u1", "namespace": "team-a"}}],
+        "clusters": [{"name": "c1", "cluster": {"server": "https://k8s.example:6443",
+                                                 "certificate-authority-data": ca_b64}}],
+        "users": [{"name": "u1", "user": {"token": "kc-token"}}],
+    }
+    path = tmp_path / "config"
+    path.write_text(json.dumps(doc))  # json is valid yaml
+    config = load_kubeconfig(str(path))
+    assert config.host == "k8s.example" and config.port == 6443
+    assert config.token == "kc-token"
+    assert config.namespace == "team-a"
+    with open(config.ca_file, "rb") as f:
+        assert f.read() == b"ca-bytes"
+
+
+def test_kubeconfig_exec_plugin_rejected(tmp_path):
+    doc = {
+        "current-context": "dev",
+        "contexts": [{"name": "dev", "context": {"cluster": "c1", "user": "u1"}}],
+        "clusters": [{"name": "c1", "cluster": {"server": "https://h:1"}}],
+        "users": [{"name": "u1", "user": {"exec": {"command": "aws"}}}],
+    }
+    path = tmp_path / "config"
+    path.write_text(json.dumps(doc))
+    with pytest.raises(ApiError, match="exec"):
+        load_kubeconfig(str(path))

@@ -1,0 +1,118 @@
+"""Health/metrics HTTP endpoint: probe semantics over a real socket.
+
+Covers the kubelet contract (200 when UP, 503 when DOWN — reference
+operator-deployment.yaml:61-78 probes) and the /metrics JSON snapshot.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from operator_tpu.operator.health import HealthStatus, LivenessCheck, ReadinessCheck
+from operator_tpu.operator.httpserver import HealthServer
+from operator_tpu.operator.kubeapi import FakeKubeApi
+from operator_tpu.utils.config import OperatorConfig
+from operator_tpu.utils.timing import MetricsRegistry
+
+
+async def _get(port: int, path: str) -> tuple[int, dict]:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    await writer.wait_closed()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    status = int(head.split()[1])
+    return status, json.loads(body)
+
+
+class _DownCheck:
+    async def check(self) -> HealthStatus:
+        return HealthStatus(False, "not yet")
+
+
+@pytest.fixture()
+def server_factory(tmp_path):
+    """Returns (start(ready_check) -> server) bound to an ephemeral port.
+
+    Each test owns its loop via asyncio.run and must stop the server inside
+    that loop — a teardown here would run after the owning loop closed.
+    """
+
+    async def start(readiness=None):
+        api = FakeKubeApi()
+        config = OperatorConfig(pattern_cache_directory=str(tmp_path))
+        metrics = MetricsRegistry()
+        metrics.record("parse", 12.5)
+        metrics.incr("failures_detected")
+        server = HealthServer(
+            LivenessCheck(),
+            readiness or ReadinessCheck(api, config),
+            metrics=metrics,
+            host="127.0.0.1",
+            port=0,
+        )
+        await server.start()
+        return server
+
+    return start
+
+
+def test_live_and_ready_up(server_factory):
+    async def main():
+        server = await server_factory()
+        live_status, live = await _get(server.bound_port, "/healthz/live")
+        ready_status, ready = await _get(server.bound_port, "/healthz/ready")
+        await server.stop()
+        return live_status, live, ready_status, ready
+
+    live_status, live, ready_status, ready = asyncio.run(main())
+    assert live_status == 200 and live["status"] == "UP"
+    # no PatternLibrary CRs -> ready (reference readiness check :38-41)
+    assert ready_status == 200 and ready["status"] == "UP"
+
+
+def test_ready_down_is_503(server_factory):
+    async def main():
+        server = await server_factory(readiness=_DownCheck())
+        status, body = await _get(server.bound_port, "/healthz/ready")
+        await server.stop()
+        return status, body
+
+    status, body = asyncio.run(main())
+    assert status == 503
+    assert body["status"] == "DOWN"
+    assert "not yet" in body["reason"]
+
+
+def test_metrics_snapshot(server_factory):
+    async def main():
+        server = await server_factory()
+        status, body = await _get(server.bound_port, "/metrics")
+        await server.stop()
+        return status, body
+
+    status, body = asyncio.run(main())
+    assert status == 200
+    assert body["stages"]["parse"]["count"] == 1
+    assert body["counters"]["failures_detected"] == 1
+
+
+def test_unknown_route_404_and_post_405(server_factory):
+    async def main():
+        server = await server_factory()
+        missing, _ = await _get(server.bound_port, "/nope")
+        reader, writer = await asyncio.open_connection("127.0.0.1", server.bound_port)
+        writer.write(b"POST /metrics HTTP/1.1\r\n\r\n")
+        await writer.drain()
+        raw = await reader.read()
+        writer.close()
+        await writer.wait_closed()
+        await server.stop()
+        return missing, int(raw.split()[1])
+
+    missing, post_status = asyncio.run(main())
+    assert missing == 404
+    assert post_status == 405
