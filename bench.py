@@ -81,8 +81,10 @@ def main() -> None:
     params = jax.block_until_ready(init(jax.random.PRNGKey(0)))
     log(f"params initialised in {time.perf_counter() - t0:.1f}s")
 
+    paged = os.environ.get("BENCH_PAGED", "1") == "1"
     generator = BatchedGenerator(
-        params, config, load_tokenizer(None), max_slots=slots, max_seq=max_seq
+        params, config, load_tokenizer(None), max_slots=slots, max_seq=max_seq,
+        paged=paged, page_size=int(os.environ.get("BENCH_PAGE_SIZE", "64")),
     )
     prompts = [build_prompt(r) for r in build_requests(n_requests)]
     sampling = SamplingParams(max_tokens=max_tokens, temperature=0.3, stop_on_eos=False)

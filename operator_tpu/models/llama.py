@@ -305,3 +305,62 @@ def decode_step(
         params, config, token_ids, positions, cache=cache, cache_offset=cache_offset
     )
     return logits[:, -1, :], new_cache
+
+
+def decode_step_paged(
+    params: Params,
+    config: ModelConfig,
+    token_ids: jax.Array,  # [B, 1]
+    paged: "PagedKVCache",
+) -> tuple[jax.Array, "PagedKVCache"]:
+    """Single-token decode over a paged KV cache (ops/paged_attention.py).
+
+    Each sequence appends at its own ``lengths[b]`` position (the page
+    table maps it to a page/slot) and attends over exactly its own pages —
+    the ragged-batch decode of SURVEY.md §7 hard part (c).  Full attention
+    only: the paged path does not implement sliding windows (serving
+    max_seq is far below Mistral's 4096 window, so nothing is lost).
+
+    Returns (last-token logits [B, vocab] float32, cache with lengths+1).
+    """
+    from ..ops.paged_attention import PagedKVCache, paged_attention, write_tokens
+
+    inv_freq = rope_frequencies(config)
+    b = token_ids.shape[0]
+    positions = paged.lengths[:, None]  # [B, 1] append position
+    x = jnp.take(params["embed"], token_ids, axis=0)  # [B, 1, H]
+    new_lengths = paged.lengths + 1
+
+    def layer_step(carry: jax.Array, scanned: dict[str, jax.Array]):
+        x = carry
+        weights = scanned["w"]
+        attn_in = rms_norm(x, weights["ln_attn"], config.rms_norm_eps)
+        q = (attn_in @ weights["wq"]).reshape(b, 1, config.num_heads, config.head_dim)
+        k = (attn_in @ weights["wk"]).reshape(b, 1, config.num_kv_heads, config.head_dim)
+        v = (attn_in @ weights["wv"]).reshape(b, 1, config.num_kv_heads, config.head_dim)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        k_pages = write_tokens(scanned["k"], paged.page_table, k, paged.lengths)
+        v_pages = write_tokens(scanned["v"], paged.page_table, v, paged.lengths)
+        attn = paged_attention(
+            q[:, 0].astype(k_pages.dtype), k_pages, v_pages,
+            paged.page_table, new_lengths,
+        )  # [B, QH, D]
+        x = x + attn.astype(x.dtype).reshape(b, 1, -1) @ weights["wo"]
+        mlp_in = rms_norm(x, weights["ln_mlp"], config.rms_norm_eps)
+        gate = jax.nn.silu(mlp_in @ weights["w_gate"])
+        up = mlp_in @ weights["w_up"]
+        x = x + (gate * up) @ weights["w_down"]
+        return x, {"k": k_pages, "v": v_pages}
+
+    scanned_in = {"w": params["layers"], "k": paged.k_pages, "v": paged.v_pages}
+    x, pages_out = jax.lax.scan(layer_step, x, scanned_in)
+
+    x = rms_norm(x, params["ln_final"], config.rms_norm_eps)
+    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bth,hv->btv", x, head, preferred_element_type=jnp.float32)
+    new_cache = PagedKVCache(
+        k_pages=pages_out["k"], v_pages=pages_out["v"],
+        page_table=paged.page_table, lengths=new_lengths,
+    )
+    return logits[:, -1, :], new_cache

@@ -10,10 +10,10 @@ and the reference elsewhere.
 Reference-system context (SURVEY.md §2.2): the external log-parser service
 the reference called over REST is rebuilt as in-tree scoring; its hot op —
 pattern-embedding × log-window-embedding similarity — lives here.  The
-paged-attention kernel is the ragged-KV building block for batched decode
-at 8B scale (BASELINE config 4); the serving engine currently runs on a
-contiguous per-slot KV cache and adopts the paged path when the KV budget
-(batch × max_seq) outgrows HBM — see serving/engine.py.
+paged-attention kernel backs the serving engine's default paged-KV decode
+(serving/engine.py BatchedGenerator(paged=True): page allocator, partial
+admission backpressure) so batch-32 at 8B scale doesn't reserve worst-case
+HBM per slot (BASELINE config 4, SURVEY.md §7 hard part c).
 """
 
 from .similarity import (

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import functools
 from dataclasses import dataclass
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -84,9 +85,15 @@ def write_tokens(
     page_table: jax.Array,  # [B, pages_per_seq]
     new: jax.Array,  # [B, T, KH, D] tokens to store
     start: jax.Array,  # [B] int32 position of new[:, 0]
+    valid_len: Optional[jax.Array] = None,  # [B] tokens of new[] that are real
 ) -> jax.Array:
     """Scatter T new tokens per sequence into their pages (prefill or
-    decode append — decode is T=1, start=lengths)."""
+    decode append — decode is T=1, start=lengths).
+
+    Rows past ``valid_len`` (prefill padding) are redirected to page 0,
+    which the allocator reserves as a trash page (serving/engine.py) — a
+    padded row must never land in another sequence's pages.
+    """
     b, t = new.shape[0], new.shape[1]
     page_size = pages.shape[1]
     positions = start[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]  # [B, T]
@@ -94,6 +101,10 @@ def write_tokens(
         page_table, positions // page_size, axis=1
     )  # [B, T]
     slots = positions % page_size
+    if valid_len is not None:
+        valid = jnp.arange(t, dtype=jnp.int32)[None, :] < valid_len[:, None]
+        page_ids = jnp.where(valid, page_ids, 0)
+        slots = jnp.where(valid, slots, 0)
     return pages.at[page_ids, slots].set(new.astype(pages.dtype))
 
 

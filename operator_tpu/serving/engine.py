@@ -74,6 +74,11 @@ class _Slot:
     params: SamplingParams = field(default_factory=SamplingParams)
     started: float = 0.0
     prefill_ms: float = 0.0
+    pages: list[int] = field(default_factory=list)  # paged mode only
+
+
+class OversizedRequest(ValueError):
+    """A single request needs more KV pages than the whole cache holds."""
 
 
 def _bucket(n: int, floor: int, cap: int) -> int:
@@ -82,6 +87,34 @@ def _bucket(n: int, floor: int, cap: int) -> int:
     while size < n and size < cap:
         size *= 2
     return min(size, cap)
+
+
+class PageAllocator:
+    """Host-side free list for the paged KV cache (ops/paged_attention.py).
+
+    Page 0 is reserved as the trash page: padded prefill rows and released
+    slots write there, so a page handed to a live sequence is never touched
+    by anyone else.  Allocation is worst-case up front (prompt + max new
+    tokens), which keeps the device page table static for a sequence's
+    whole lifetime — no mid-decode growth, no host sync in the decode loop.
+    """
+
+    def __init__(self, num_pages: int) -> None:
+        assert num_pages >= 2, "need at least one real page beyond the trash page"
+        self.num_pages = num_pages
+        self._free = list(range(num_pages - 1, 0, -1))  # pop() yields low ids first
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    def allocate(self, count: int) -> list[int]:
+        if count > len(self._free):
+            raise MemoryError(f"KV pages exhausted: want {count}, have {len(self._free)}")
+        return [self._free.pop() for _ in range(count)]
+
+    def release(self, pages: list[int]) -> None:
+        self._free.extend(pages)
 
 
 class BatchedGenerator:
@@ -102,6 +135,9 @@ class BatchedGenerator:
         cache_dtype: Any = None,
         metrics: Optional[MetricsRegistry] = None,
         seed: int = 0,
+        paged: bool = False,
+        page_size: int = 64,
+        kv_pages: Optional[int] = None,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -116,13 +152,32 @@ class BatchedGenerator:
         self.metrics = metrics or METRICS
         cache_dtype = cache_dtype or jnp.bfloat16
 
-        self.cache = KVCache.create(config, max_slots, self.max_seq, dtype=cache_dtype)
+        self.paged = paged
+        self.page_size = page_size
+        if paged:
+            from ..ops.paged_attention import PagedKVCache
+
+            self.pages_per_seq = -(-self.max_seq // page_size)
+            # default: worst case + trash page (configure kv_pages smaller to
+            # oversubscribe HBM — admission then backpressures on the free
+            # list instead of reserving max_seq per slot up front)
+            num_pages = kv_pages or (max_slots * self.pages_per_seq + 1)
+            self.allocator = PageAllocator(num_pages)
+            self.paged_cache = PagedKVCache.create(
+                config.num_layers, num_pages, page_size, config.num_kv_heads,
+                config.head_dim, max_slots, self.pages_per_seq, dtype=cache_dtype,
+            )
+            self.cache = None
+            self._host_offsets = np.zeros((max_slots,), np.int64)
+            self._decode_fn = jax.jit(self._decode_step_paged)
+        else:
+            self.cache = KVCache.create(config, max_slots, self.max_seq, dtype=cache_dtype)
+            self._decode_fn = jax.jit(self._decode_step)
         self.offsets = jnp.zeros((max_slots,), jnp.int32)  # tokens held per slot
         self.last_tokens = jnp.zeros((max_slots, 1), jnp.int32)
         self.slots: list[_Slot] = [_Slot() for _ in range(max_slots)]
         self._rng = jax.random.PRNGKey(seed)
 
-        self._decode_fn = jax.jit(self._decode_step)
         self._prefill_fns: dict[tuple[int, int], Any] = {}
 
     # ------------------------------------------------------------------
@@ -141,6 +196,22 @@ class BatchedGenerator:
         # offsets only advance for active ones so their state is untouched
         offsets = jnp.where(active, offsets + 1, offsets)
         return cache, next_tokens, offsets, rng
+
+    def _decode_step_paged(self, params, paged, tokens, rng, temp, top_p, active):
+        """Paged twin of :meth:`_decode_step` (released slots write to the
+        trash page via their zeroed page-table row; their lengths stay put)."""
+        from ..models.llama import decode_step_paged
+        from ..ops.paged_attention import PagedKVCache
+
+        jnp = self._jnp
+        logits, new_paged = decode_step_paged(params, self.config, tokens, paged)
+        next_tokens, rng = self._sample(logits, rng, temp, top_p)
+        lengths = jnp.where(active, new_paged.lengths, paged.lengths)
+        new_paged = PagedKVCache(
+            k_pages=new_paged.k_pages, v_pages=new_paged.v_pages,
+            page_table=new_paged.page_table, lengths=lengths,
+        )
+        return new_paged, next_tokens, rng
 
     def _sample(self, logits, rng, temp, top_p):
         """Temperature + nucleus sampling; temp<=0 means greedy.  [B, V]."""
@@ -195,6 +266,46 @@ class BatchedGenerator:
 
         return prefill_fn
 
+    def _make_prefill_paged(self, n_pad: int, t_pad: int):
+        """Prefill for the paged cache: same mini-cache forward, then the
+        prompt KV scatters into each sequence's pages (write_tokens with
+        valid_len so padded rows land in the trash page)."""
+        jax, jnp = self._jax, self._jnp
+        config = self.config
+
+        @jax.jit
+        def prefill_fn(params, paged, token_ids, lengths, row_tables, rng, temp, top_p):
+            from ..models.llama import make_causal_mask
+            from ..ops.paged_attention import PagedKVCache, write_tokens
+
+            mini = KVCache.create(config, n_pad, t_pad, dtype=paged.k_pages.dtype)
+            positions = jnp.broadcast_to(
+                jnp.arange(t_pad, dtype=jnp.int32)[None], (n_pad, t_pad)
+            )
+            kv_valid = positions < lengths[:, None]
+            mask = make_causal_mask(
+                positions, positions, kv_valid, sliding_window=config.sliding_window
+            )
+            logits, mini = forward(
+                params, config, token_ids, positions, cache=mini,
+                cache_offset=0, attn_mask=mask,
+            )
+            zero = jnp.zeros((n_pad,), jnp.int32)
+            scatter = jax.vmap(write_tokens, in_axes=(0, None, 0, None, None))
+            k_pages = scatter(paged.k_pages, row_tables, mini.k, zero, lengths)
+            v_pages = scatter(paged.v_pages, row_tables, mini.v, zero, lengths)
+            last = jnp.take_along_axis(
+                logits, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
+            )[:, 0, :]
+            first_tokens, rng = self._sample(last, rng, temp, top_p)
+            new_paged = PagedKVCache(
+                k_pages=k_pages, v_pages=v_pages,
+                page_table=paged.page_table, lengths=paged.lengths,
+            )
+            return new_paged, first_tokens, rng
+
+        return prefill_fn
+
     # ------------------------------------------------------------------
     # host-side API
     # ------------------------------------------------------------------
@@ -213,6 +324,12 @@ class BatchedGenerator:
 
         One forward pass for the whole group — the "32 concurrent failure
         events -> one prefill" shape (BASELINE config 4).
+
+        In paged mode admission may be PARTIAL: when the KV free list can't
+        cover every prompt's worst case (prompt + max_tokens), only the
+        longest prefix that fits is admitted and the returned list is
+        shorter than ``prompts`` — the caller requeues the rest.  A single
+        request larger than the whole cache raises :class:`OversizedRequest`.
         """
         jnp = self._jnp
         free = self.free_slots()
@@ -230,6 +347,43 @@ class BatchedGenerator:
                 ids = ids[-budget:]  # failure evidence concentrates at the tail
             token_lists.append(ids)
 
+        page_grants: list[list[int]] = []
+        if self.paged:
+            for toks, sampling in zip(token_lists, params_list):
+                total = min(len(toks) + sampling.max_tokens, self.max_seq)
+                need = -(-total // self.page_size)
+                if need > self.allocator.num_pages - 1:
+                    if not page_grants:
+                        raise OversizedRequest(
+                            f"request needs {need} KV pages, cache holds "
+                            f"{self.allocator.num_pages - 1}"
+                        )
+                    break
+                try:
+                    page_grants.append(self.allocator.allocate(need))
+                except MemoryError:
+                    break  # backpressure: admit the prefix that fits
+            if not page_grants:
+                return []
+            token_lists = token_lists[: len(page_grants)]
+            params_list = params_list[: len(page_grants)]
+            try:
+                return self._admit_batch(token_lists, params_list, page_grants, started)
+            except BaseException:
+                for grant in page_grants:  # don't leak pages on prefill failure
+                    self.allocator.release(grant)
+                raise
+        return self._admit_batch(token_lists, params_list, [], started)
+
+    def _admit_batch(
+        self,
+        token_lists: list[list[int]],
+        params_list: Sequence[SamplingParams],
+        page_grants: list[list[int]],
+        started: float,
+    ) -> list[int]:
+        jnp = self._jnp
+        free = self.free_slots()
         n = len(token_lists)
         max_len = max(len(t) for t in token_lists)
         n_pad = _bucket(n, 1, self.max_slots)
@@ -258,19 +412,54 @@ class BatchedGenerator:
 
         key = (n_pad, t_pad)
         if key not in self._prefill_fns:
-            log.info("compiling prefill bucket n=%d t=%d", n_pad, t_pad)
-            self._prefill_fns[key] = self._make_prefill(n_pad, t_pad)
-        self.cache, first_tokens, self._rng = self._prefill_fns[key](
-            self.params, self.cache, jnp.asarray(ids), jnp.asarray(lengths),
-            jnp.asarray(slot_ids), self._rng, jnp.asarray(temp), jnp.asarray(top_p),
-        )
+            log.info("compiling prefill bucket n=%d t=%d (paged=%s)", n_pad, t_pad, self.paged)
+            self._prefill_fns[key] = (
+                self._make_prefill_paged(n_pad, t_pad)
+                if self.paged
+                else self._make_prefill(n_pad, t_pad)
+            )
+
+        if self.paged:
+            from ..ops.paged_attention import PagedKVCache
+
+            # install each admitted row's page list + prompt length in the
+            # device table BEFORE prefill; padding rows reuse row 0's table
+            # (identical duplicate writes — see the comment above)
+            row_tables = np.zeros((n_pad, self.pages_per_seq), np.int32)
+            for row, grant in enumerate(page_grants):
+                row_tables[row, : len(grant)] = grant
+            for row in range(n, n_pad):
+                row_tables[row] = row_tables[0]
+            paged = self.paged_cache
+            table = paged.page_table.at[jnp.asarray(slot_ids[:n])].set(
+                jnp.asarray(row_tables[:n])
+            )
+            lens = paged.lengths.at[jnp.asarray(slot_ids[:n])].set(
+                jnp.asarray(lengths[:n])
+            )
+            paged = PagedKVCache(
+                k_pages=paged.k_pages, v_pages=paged.v_pages,
+                page_table=table, lengths=lens,
+            )
+            self.paged_cache, first_tokens, self._rng = self._prefill_fns[key](
+                self.params, paged, jnp.asarray(ids), jnp.asarray(lengths),
+                jnp.asarray(row_tables), self._rng, jnp.asarray(temp),
+                jnp.asarray(top_p),
+            )
+        else:
+            self.cache, first_tokens, self._rng = self._prefill_fns[key](
+                self.params, self.cache, jnp.asarray(ids), jnp.asarray(lengths),
+                jnp.asarray(slot_ids), self._rng, jnp.asarray(temp), jnp.asarray(top_p),
+            )
         first_np = np.asarray(first_tokens)
         prefill_ms = (time.perf_counter() - started) * 1e3
         self.metrics.record("prefill", prefill_ms)
         self.metrics.record("prefill_batch", float(n))
 
-        offsets = np.array(self.offsets)  # mutable host copies
-        last = np.array(self.last_tokens)
+        # paged mode tracks positions in _host_offsets + paged_cache.lengths
+        # only; the device offsets array belongs to the contiguous path
+        offsets = None if self.paged else np.array(self.offsets)
+        last = np.array(self.last_tokens)  # mutable host copy
         for row, slot_id in enumerate(taken):
             slot = self.slots[slot_id]
             slot.active = True
@@ -279,9 +468,14 @@ class BatchedGenerator:
             slot.params = params_list[row]
             slot.started = time.perf_counter()
             slot.prefill_ms = prefill_ms
-            offsets[slot_id] = int(lengths[row])
+            slot.pages = page_grants[row] if self.paged else []
             last[slot_id, 0] = int(first_np[row])
-        self.offsets = jnp.asarray(offsets)
+            if self.paged:
+                self._host_offsets[slot_id] = int(lengths[row])
+            else:
+                offsets[slot_id] = int(lengths[row])
+        if not self.paged:
+            self.offsets = jnp.asarray(offsets)
         self.last_tokens = jnp.asarray(last)
         return list(taken)
 
@@ -298,17 +492,25 @@ class BatchedGenerator:
         top_p = np.array(
             [s.params.top_p if s.active else 1.0 for s in self.slots], np.float32
         )
-        self.cache, next_tokens, self.offsets, self._rng = self._decode_fn(
-            self.params, self.cache, self.last_tokens, self.offsets, self._rng,
-            jnp.asarray(temp), jnp.asarray(top_p), jnp.asarray(active),
-        )
+        if self.paged:
+            self.paged_cache, next_tokens, self._rng = self._decode_fn(
+                self.params, self.paged_cache, self.last_tokens, self._rng,
+                jnp.asarray(temp), jnp.asarray(top_p), jnp.asarray(active),
+            )
+            self._host_offsets[active] += 1
+            offsets_np = self._host_offsets  # host shadow: no device fetch
+        else:
+            self.cache, next_tokens, self.offsets, self._rng = self._decode_fn(
+                self.params, self.cache, self.last_tokens, self.offsets, self._rng,
+                jnp.asarray(temp), jnp.asarray(top_p), jnp.asarray(active),
+            )
+            offsets_np = np.asarray(self.offsets)  # one device fetch per step
         next_np = np.asarray(next_tokens)
         self.last_tokens = next_tokens[:, None]
         self.metrics.record("decode_step", (time.perf_counter() - started) * 1e3)
 
         finished: list[tuple[int, GenerationResult]] = []
         eos = self.tokenizer.eos_id
-        offsets_np = np.asarray(self.offsets)  # one device fetch per step
         for i, slot in enumerate(self.slots):
             if not slot.active:
                 continue
@@ -334,6 +536,21 @@ class BatchedGenerator:
 
     def _finish(self, slot_id: int, *, reason: str) -> GenerationResult:
         slot = self.slots[slot_id]
+        if self.paged and slot.pages:
+            # point the slot's table row at the trash page BEFORE releasing
+            # the grant — the freed pages may be handed to a new sequence
+            # while this slot row still participates in batched decode
+            from ..ops.paged_attention import PagedKVCache
+
+            jnp = self._jnp
+            paged = self.paged_cache
+            self.paged_cache = PagedKVCache(
+                k_pages=paged.k_pages, v_pages=paged.v_pages,
+                page_table=paged.page_table.at[slot_id].set(0),
+                lengths=paged.lengths.at[slot_id].set(0),
+            )
+            self.allocator.release(slot.pages)
+            self._host_offsets[slot_id] = 0
         eos = self.tokenizer.eos_id
         ids = [t for t in slot.generated if t != eos]
         text = self.tokenizer.decode(ids)
@@ -382,9 +599,24 @@ class ServingEngine:
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
         self._pending: dict[int, asyncio.Future] = {}  # slot id -> future
         self._inflight: list = []  # popped from queue, not yet in _pending
+        self._stalled_avail: Optional[int] = None  # pages free at last stall
         self._task: Optional[asyncio.Task] = None
         self._closed = False
         self._error: Optional[BaseException] = None
+
+    def _page_stalled(self, batch: list) -> bool:
+        """True when a backpressured batch has no new pages to retry with —
+        skipping the retry avoids re-tokenising every waiting prompt each
+        loop round while decode slowly frees pages."""
+        if self._stalled_avail is None:
+            return False
+        allocator = getattr(self.generator, "allocator", None)
+        if allocator is None:
+            return False
+        if allocator.available > self._stalled_avail:
+            self._stalled_avail = None
+            return False
+        return True
 
     async def start(self) -> None:
         if self._task is None:
@@ -450,8 +682,9 @@ class ServingEngine:
             # requests live in self._inflight between queue pop and slot
             # admission so cancellation/crash cleanup can always see them
             batch = self._inflight
-            if self.generator.num_active == 0 and self._queue.empty():
-                # fully idle: block until a request arrives
+            if not batch and self.generator.num_active == 0 and self._queue.empty():
+                # fully idle: block until a request arrives (never while
+                # backpressured requests are already waiting in hand)
                 batch.append(await self._queue.get())
             total_free = len(self.generator.free_slots())
             if len(batch) < total_free and (batch or not self._queue.empty()):
@@ -460,9 +693,21 @@ class ServingEngine:
                 await asyncio.sleep(self.admission_wait_s)
                 while len(batch) < total_free and not self._queue.empty():
                     batch.append(self._queue.get_nowait())
-            if batch:
-                await self._admit(batch)
-                self._inflight = []
+            if batch and not self._page_stalled(batch):
+                admitted = await self._admit(batch)
+                # paged backpressure: requests beyond the KV free list stay
+                # in _inflight and retry as decode frees pages
+                self._inflight = batch[admitted:]
+                allocator = getattr(self.generator, "allocator", None)
+                # record a stall only while active sequences hold pages —
+                # their release is the retry trigger; with nothing active
+                # (e.g. after an oversized head was failed) retry freely
+                self._stalled_avail = (
+                    allocator.available
+                    if (self._inflight and allocator is not None
+                        and self.generator.num_active > 0)
+                    else None
+                )
 
             if self.generator.num_active:
                 finished = await asyncio.to_thread(self.generator.step)
@@ -472,11 +717,19 @@ class ServingEngine:
                         future.set_result(result)
             await asyncio.sleep(0)
 
-    async def _admit(self, batch) -> None:
+    async def _admit(self, batch) -> int:
+        """Admit as much of ``batch`` as fits; returns the admitted count."""
         prompts = [prompt for prompt, _, _ in batch]
         params = [p for _, p, _ in batch]
         try:
             slot_ids = await asyncio.to_thread(self.generator.admit, prompts, params)
+        except OversizedRequest as exc:
+            # only the head request is impossible; fail it alone and let
+            # the rest retry next round
+            _, _, future = batch[0]
+            if not future.done():
+                future.set_exception(exc)
+            return 1
         except BaseException as exc:
             # the batch futures are out of the queue but not yet in
             # _pending — fail them here or their callers hang forever
@@ -486,3 +739,4 @@ class ServingEngine:
             raise
         for slot_id, (_, _, future) in zip(slot_ids, batch):
             self._pending[slot_id] = future
+        return len(slot_ids)

@@ -106,6 +106,9 @@ def build_tpu_native_provider(
         tokenizer,
         max_slots=config.max_batch_size,
         max_seq=min(model_config.max_seq_len, 2048),
+        paged=config.kv_cache_mode == "paged",
+        page_size=config.kv_page_size,
+        kv_pages=config.kv_pages or None,
     )
     engine = ServingEngine(generator)
     return TPUNativeProvider(engine, model_id=model_id)

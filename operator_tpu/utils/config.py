@@ -52,6 +52,13 @@ class OperatorConfig:
     model_id: str = "tinyllama-1.1b"
     checkpoint_dir: Optional[str] = None
     max_batch_size: int = 32  # BASELINE config 4: 32 events -> one prefill
+    # paged KV cache (ops/paged_attention.py): allocate HBM by actual
+    # sequence need instead of max_seq per slot — the batch-32-at-8B-scale
+    # memory fix (SURVEY.md §7 hard part c).  kv_pages=0 means worst-case
+    # sizing (no oversubscription).
+    kv_cache_mode: str = "paged"  # "paged" | "contiguous"
+    kv_page_size: int = 64
+    kv_pages: int = 0
 
     @classmethod
     def from_env(cls, env: Optional[dict[str, str]] = None) -> "OperatorConfig":

@@ -1,0 +1,217 @@
+"""Paged-KV serving: allocator, write masking, decode parity, backpressure.
+
+The paged path must produce the same logits as the contiguous path (it is
+the same math over a different memory layout), never let one sequence's
+writes touch another's pages, and backpressure admission when the page
+free list runs dry (SURVEY.md §7 hard part c).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from operator_tpu.models import TINY_TEST, init_params  # noqa: E402
+from operator_tpu.models.llama import (  # noqa: E402
+    KVCache,
+    decode_step,
+    decode_step_paged,
+    forward,
+)
+from operator_tpu.models.tokenizer import ByteTokenizer  # noqa: E402
+from operator_tpu.ops.paged_attention import PagedKVCache, write_tokens  # noqa: E402
+from operator_tpu.serving.engine import (  # noqa: E402
+    BatchedGenerator,
+    OversizedRequest,
+    PageAllocator,
+    SamplingParams,
+    ServingEngine,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+class TestPageAllocator:
+    def test_reserves_trash_page_and_reuses(self):
+        alloc = PageAllocator(5)
+        assert alloc.available == 4
+        grant = alloc.allocate(4)
+        assert 0 not in grant and sorted(grant) == [1, 2, 3, 4]
+        with pytest.raises(MemoryError):
+            alloc.allocate(1)
+        alloc.release(grant[:2])
+        assert sorted(alloc.allocate(2)) == sorted(grant[:2])
+
+
+class TestWriteTokens:
+    def test_valid_len_redirects_padding_to_trash(self):
+        pages = jnp.zeros((4, 2, 1, 4), jnp.float32)  # 4 pages x 2 slots
+        table = jnp.asarray([[1, 2], [3, 0]], jnp.int32)
+        new = jnp.ones((2, 4, 1, 4), jnp.float32)
+        out = write_tokens(pages, table, new, jnp.zeros((2,), jnp.int32),
+                           valid_len=jnp.asarray([4, 2], jnp.int32))
+        # row 0 wrote pages 1,2 fully; row 1 wrote page 3 only
+        assert float(out[1].sum()) == 8.0 and float(out[2].sum()) == 8.0
+        assert float(out[3].sum()) == 8.0
+        # row 1's padded positions 2,3 landed in trash page 0, NOT page 0's
+        # would-be neighbour pages
+        assert float(out[0, 0].sum()) == 4.0  # trash page took the spill
+
+
+class TestPagedDecodeParity:
+    def test_matches_contiguous_decode(self, params):
+        """Prefill both layouts with the same prompt, decode 4 steps, and
+        compare logits step by step."""
+        config = TINY_TEST
+        rng = np.random.RandomState(0)
+        prompt_len, steps, page_size = 13, 4, 8
+        tokens_np = rng.randint(0, config.vocab_size, size=(1, prompt_len)).astype(np.int32)
+        tokens = jnp.asarray(tokens_np)
+        positions = jnp.arange(prompt_len, dtype=jnp.int32)[None]
+
+        # contiguous: prefill then single-token decode
+        cache = KVCache.create(config, 1, 64, dtype=jnp.float32)
+        logits_c, cache = forward(params, config, tokens, positions, cache=cache)
+
+        # paged: same prefill math via forward (mini cache), scatter into pages
+        pages_per_seq = 64 // page_size
+        paged = PagedKVCache.create(
+            config.num_layers, 1 + pages_per_seq, page_size, config.num_kv_heads,
+            config.head_dim, 1, pages_per_seq, dtype=jnp.float32,
+        )
+        mini = KVCache.create(config, 1, prompt_len, dtype=jnp.float32)
+        logits_p, mini = forward(params, config, tokens, positions, cache=mini)
+        table = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+        scatter = jax.vmap(write_tokens, in_axes=(0, None, 0, None, None))
+        zero = jnp.zeros((1,), jnp.int32)
+        k_pages = scatter(paged.k_pages, table, mini.k, zero,
+                          jnp.asarray([prompt_len], jnp.int32))
+        v_pages = scatter(paged.v_pages, table, mini.v, zero,
+                          jnp.asarray([prompt_len], jnp.int32))
+        paged = PagedKVCache(k_pages=k_pages, v_pages=v_pages, page_table=table,
+                             lengths=jnp.asarray([prompt_len], jnp.int32))
+
+        np.testing.assert_allclose(
+            np.asarray(logits_c[:, -1]), np.asarray(logits_p[:, -1]), atol=1e-4
+        )
+
+        token = jnp.argmax(logits_c[:, -1], axis=-1).astype(jnp.int32)[:, None]
+        offset = jnp.asarray([prompt_len], jnp.int32)
+        for step in range(steps):
+            last_c, cache = decode_step(
+                params, config, token, offset[:, None], cache, offset
+            )
+            last_p, paged = decode_step_paged(params, config, token, paged)
+            np.testing.assert_allclose(
+                np.asarray(last_c), np.asarray(last_p), atol=1e-4,
+                err_msg=f"divergence at decode step {step}",
+            )
+            assert int(last_c.argmax()) == int(last_p.argmax())
+            token = jnp.argmax(last_c, axis=-1).astype(jnp.int32)[:, None]
+            offset = offset + 1
+        assert int(paged.lengths[0]) == prompt_len + steps
+
+
+@pytest.fixture()
+def paged_generator():
+    params = init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return BatchedGenerator(
+        params, TINY_TEST, ByteTokenizer(), max_slots=4, max_seq=128,
+        cache_dtype=jnp.float32, paged=True, page_size=16,
+    )
+
+
+class TestPagedGenerator:
+    def test_generate_roundtrip_and_page_recycling(self, paged_generator):
+        total = paged_generator.allocator.available
+        for i in range(3):  # sequential generations must recycle pages
+            result = paged_generator.generate(
+                f"pod {i} failed with exit code 137",
+                SamplingParams(max_tokens=6, temperature=0.0, stop_on_eos=False),
+            )
+            assert result.completion_tokens == 6
+            assert paged_generator.allocator.available == total
+
+    def test_batched_admission_isolated_sequences(self, paged_generator):
+        """Two concurrent sequences with different prompts must not corrupt
+        each other: each matches its own solo greedy run."""
+        prompts = ["error: OOMKilled in container app",
+                   "CrashLoopBackOff restarting failed container"]
+        solo = [
+            paged_generator.generate(
+                p, SamplingParams(max_tokens=5, temperature=0.0, stop_on_eos=False)
+            ).token_ids
+            for p in prompts
+        ]
+        sampling = [SamplingParams(max_tokens=5, temperature=0.0, stop_on_eos=False)] * 2
+        slots = paged_generator.admit(prompts, sampling)
+        assert len(slots) == 2
+        done = {}
+        while len(done) < 2:
+            for slot_id, result in paged_generator.step():
+                done[slot_id] = result.token_ids
+        assert [done[s] for s in slots] == solo
+
+    def test_oversized_request_raises(self):
+        # reachable only with an oversubscribed page budget smaller than
+        # one worst-case sequence (truncation bounds need to max_seq)
+        params = init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+        generator = BatchedGenerator(
+            params, TINY_TEST, ByteTokenizer(), max_slots=4, max_seq=128,
+            cache_dtype=jnp.float32, paged=True, page_size=16,
+            kv_pages=5,  # 4 real pages < the 8 a full sequence needs
+        )
+        with pytest.raises(OversizedRequest):
+            generator.admit(
+                ["x" * 4096],
+                [SamplingParams(max_tokens=128, temperature=0.0)],
+            )
+
+
+class TestPagedBackpressure:
+    def test_all_requests_complete_under_page_pressure(self):
+        """Page budget for exactly 2 worst-case sequences, 6 concurrent
+        requests each demanding the worst case: admission must go partial
+        (observed via the admit spy) and every request still completes."""
+        params = init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+        generator = BatchedGenerator(
+            params, TINY_TEST, ByteTokenizer(), max_slots=4, max_seq=64,
+            cache_dtype=jnp.float32, paged=True, page_size=8,
+            kv_pages=2 * (64 // 8) + 1,  # two worst-case sequences + trash
+        )
+        admissions: list[tuple[int, int]] = []  # (requested, admitted)
+        original = generator.admit
+
+        def spy(prompts, sampling):
+            slots = original(prompts, sampling)
+            admissions.append((len(prompts), len(slots)))
+            return slots
+
+        generator.admit = spy
+        # prompt (~14 tokens) + max_tokens 50 = 64 = all 8 pages per request
+        sampling = SamplingParams(max_tokens=50, temperature=0.0, stop_on_eos=False)
+
+        async def main():
+            engine = ServingEngine(generator, admission_wait_s=0.01)
+            await engine.start()
+            try:
+                return await asyncio.gather(
+                    *(engine.generate(f"pod {i} failed", sampling) for i in range(6))
+                )
+            finally:
+                await engine.close()
+
+        results = asyncio.run(main())
+        assert len(results) == 6
+        assert all(r.completion_tokens == 50 for r in results)
+        # the free list covers 2 sequences: some admit call must have been
+        # cut short (partial or empty) — proof the backpressure path ran
+        assert any(admitted < requested for requested, admitted in admissions), admissions
+        assert max(admitted for _, admitted in admissions) <= 2
+        assert generator.allocator.available == generator.allocator.num_pages - 1
