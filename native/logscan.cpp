@@ -1,0 +1,116 @@
+// Aho-Corasick multi-pattern scanner — the native hot loop of the pattern
+// engine's literal prefilter (operator_tpu/patterns/prefilter.py).
+//
+// Role: one pass over the raw log finds every occurrence of every
+// pattern-library literal, replacing O(patterns x lines) Python regex
+// scans with O(text) native scanning; only the surviving (pattern, line)
+// candidates are re-checked by the full regex.  This is the rebuild's
+// native data-path component (the reference's only native artifact is an
+// AOT build of its whole operator, SURVEY.md SS2) - scanning is the one
+// CPU-bound stage between kube watch and the TPU programs.
+//
+// Plain C ABI for ctypes: build once per pattern-library reload, scan per
+// failure log.  No global state; handles are heap objects.
+
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <vector>
+
+namespace {
+
+struct Automaton {
+    // dense transition table: node * 256 -> node (flat for cache locality)
+    std::vector<int32_t> next;
+    std::vector<int32_t> fail;
+    std::vector<std::vector<int32_t>> out;  // pattern ids ending at node
+    int32_t nodes = 0;
+
+    int32_t alloc_node() {
+        next.resize(next.size() + 256, -1);
+        fail.push_back(0);
+        out.emplace_back();
+        return nodes++;
+    }
+
+    int32_t& trans(int32_t node, uint8_t byte) { return next[node * 256 + byte]; }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Build an automaton over n literals (arbitrary bytes, lens[i] each).
+// Returns an opaque handle (never null; zero patterns is a valid build).
+void* ls_build(const char** patterns, const int32_t* lens, int32_t n) {
+    auto* a = new Automaton();
+    a->alloc_node();  // root
+    for (int32_t pattern_id = 0; pattern_id < n; ++pattern_id) {
+        int32_t node = 0;
+        for (int32_t i = 0; i < lens[pattern_id]; ++i) {
+            uint8_t byte = static_cast<uint8_t>(patterns[pattern_id][i]);
+            int32_t next_node = a->trans(node, byte);
+            if (next_node < 0) {
+                next_node = a->alloc_node();
+                a->trans(node, byte) = next_node;
+            }
+            node = next_node;
+        }
+        if (lens[pattern_id] > 0) a->out[node].push_back(pattern_id);
+    }
+    // BFS failure links; missing root transitions loop to root
+    std::queue<int32_t> queue;
+    for (int32_t byte = 0; byte < 256; ++byte) {
+        int32_t child = a->trans(0, static_cast<uint8_t>(byte));
+        if (child < 0) {
+            a->trans(0, static_cast<uint8_t>(byte)) = 0;
+        } else {
+            a->fail[child] = 0;
+            queue.push(child);
+        }
+    }
+    while (!queue.empty()) {
+        int32_t node = queue.front();
+        queue.pop();
+        for (int32_t byte = 0; byte < 256; ++byte) {
+            int32_t child = a->trans(node, static_cast<uint8_t>(byte));
+            int32_t via_fail = a->trans(a->fail[node], static_cast<uint8_t>(byte));
+            if (child < 0) {
+                a->trans(node, static_cast<uint8_t>(byte)) = via_fail;
+            } else {
+                a->fail[child] = via_fail;
+                // merge output set of the failure target (suffix matches)
+                const auto& suffix_out = a->out[via_fail];
+                a->out[child].insert(a->out[child].end(), suffix_out.begin(),
+                                     suffix_out.end());
+                queue.push(child);
+            }
+        }
+    }
+    return a;
+}
+
+// Scan text; for each literal occurrence write (pattern_id, end_offset)
+// into the out arrays.  Returns the number of hits written (capped at
+// max_hits; further matches are dropped — callers size generously).
+int64_t ls_scan(void* handle, const char* text, int64_t len, int32_t* out_ids,
+                int64_t* out_offsets, int64_t max_hits) {
+    auto* a = static_cast<Automaton*>(handle);
+    int64_t hits = 0;
+    int32_t node = 0;
+    for (int64_t i = 0; i < len; ++i) {
+        node = a->next[node * 256 + static_cast<uint8_t>(text[i])];
+        const auto& out = a->out[node];
+        for (int32_t pattern_id : out) {
+            if (hits >= max_hits) return hits;
+            out_ids[hits] = pattern_id;
+            out_offsets[hits] = i;  // offset of the literal's LAST byte
+            ++hits;
+        }
+    }
+    return hits;
+}
+
+void ls_free(void* handle) { delete static_cast<Automaton*>(handle); }
+
+}  // extern "C"

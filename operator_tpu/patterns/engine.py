@@ -29,6 +29,7 @@ from ..schema.analysis import AnalysisResult, PodFailureData, StageTimings
 from ..schema.kube import Pod
 from .loader import LoadedLibrary, load_builtin_library, load_libraries
 from .matcher import MatcherConfig, collect_events, fold_events
+from .prefilter import LiteralPrefilter
 from .semantic import SemanticMatcher
 from .windows import split_lines
 
@@ -87,6 +88,7 @@ class PatternEngine:
         include_builtin: bool = True,
         config: Optional[MatcherConfig] = None,
         semantic: "SemanticMatcher | bool | None" = None,
+        prefilter: bool = True,
     ) -> None:
         self.cache_dir = cache_dir
         self.enabled_libraries = enabled_libraries
@@ -95,6 +97,8 @@ class PatternEngine:
         if semantic is True:
             semantic = SemanticMatcher()
         self.semantic: Optional[SemanticMatcher] = semantic or None
+        self._use_prefilter = prefilter
+        self.prefilter: Optional[LiteralPrefilter] = None
         self._lock = threading.Lock()
         self._libraries: list[LoadedLibrary] = []
         self.reload()
@@ -112,6 +116,15 @@ class PatternEngine:
                 libraries.append(builtin)
         with self._lock:
             self._libraries = libraries
+        if self._use_prefilter:
+            # rebuild the native literal automaton for the new pattern set
+            all_patterns = [p for lib in libraries for p in lib.patterns]
+            self.prefilter = LiteralPrefilter(all_patterns)
+            log.info(
+                "literal prefilter: %d anchored / %d full-scan (native=%s)",
+                self.prefilter.num_anchored, len(self.prefilter.full_scan_ids),
+                self.prefilter.native,
+            )
         if self.semantic is not None:
             # the embedding-cache build step of the sync reconciler
             # (SURVEY.md §7 stage 3): re-embed anchors after every git pull
@@ -138,7 +151,7 @@ class PatternEngine:
         # collect the UNtruncated regex/keyword hits first so the semantic
         # merge dedupes and summarises over the full set — one fold at the
         # end ranks/truncates exactly once
-        events = collect_events(self.libraries, lines, self.config)
+        events = collect_events(self.libraries, lines, self.config, prefilter=self.prefilter)
         if self.semantic is not None and self.semantic.num_patterns:
             # semantic catches what regex missed; a pattern already hit by
             # its regex keeps the (higher-precision) regex event only

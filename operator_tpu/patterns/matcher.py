@@ -21,7 +21,10 @@ from __future__ import annotations
 
 import uuid
 from dataclasses import dataclass
-from typing import Optional
+from typing import TYPE_CHECKING, Optional
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from .prefilter import LiteralPrefilter
 
 from ..schema.analysis import (
     AnalysisEvent,
@@ -46,21 +49,30 @@ class MatcherConfig:
     max_total_events: int = 50
 
 
-def _primary_hits(pattern: Pattern, lines: list[str]) -> list[int]:
-    """Line numbers where the primary pattern fires."""
+def _primary_hits(
+    pattern: Pattern,
+    lines: list[str],
+    candidate_lines: Optional[list[int]] = None,
+) -> list[int]:
+    """Line numbers where the primary pattern fires.
+
+    ``candidate_lines`` (ascending) restricts the scan to lines the literal
+    prefilter already flagged (prefilter.py) — pure work-skipping; the
+    prefilter guarantees no match exists outside the candidates."""
     primary = pattern.primary_pattern
     if primary is None:
         return []
+    line_numbers = candidate_lines if candidate_lines is not None else range(len(lines))
     hits: list[int] = []
     regex = primary.compiled()
     if regex is not None:
-        for i, line in enumerate(lines):
-            if regex.search(line):
+        for i in line_numbers:
+            if regex.search(lines[i]):
                 hits.append(i)
     elif primary.keywords:
         lowered = [kw.lower() for kw in primary.keywords]
-        for i, line in enumerate(lines):
-            hay = line.lower()
+        for i in line_numbers:
+            hay = lines[i].lower()
             if all(kw in hay for kw in lowered):
                 hits.append(i)
     return hits
@@ -86,11 +98,12 @@ def match_pattern(
     lines: list[str],
     config: Optional[MatcherConfig] = None,
     source: str = "regex",
+    candidate_lines: Optional[list[int]] = None,
 ) -> list[AnalysisEvent]:
     config = config or MatcherConfig()
     if config.max_events_per_pattern <= 0:
         return []
-    hits = _primary_hits(pattern, lines)
+    hits = _primary_hits(pattern, lines, candidate_lines)
     if not hits:
         return []
     # newest hits carry the evidence; cap per pattern
@@ -159,15 +172,28 @@ def collect_events(
     libraries: list[LoadedLibrary],
     lines: list[str],
     config: Optional[MatcherConfig] = None,
+    prefilter: Optional["LiteralPrefilter"] = None,
 ) -> list[AnalysisEvent]:
     """Score every pattern of every library against the log lines; returns
     the UNtruncated event list so callers can merge other sources (e.g. the
-    semantic matcher) before the single fold_events ranking pass."""
+    semantic matcher) before the single fold_events ranking pass.
+
+    With a prefilter, anchored patterns only regex-scan the lines the
+    native literal scan flagged; unanchored ones scan everything."""
     config = config or MatcherConfig()
+    candidates = prefilter.candidate_lines(lines) if prefilter is not None else None
     events: list[AnalysisEvent] = []
     for library in libraries:
         for pattern in library.patterns:
-            events.extend(match_pattern(pattern, lines, config))
+            candidate_lines = None
+            if candidates is not None and pattern.id not in prefilter.full_scan_ids:
+                flagged = candidates.get(pattern.id)
+                if not flagged:
+                    continue  # literal absent -> pattern cannot match
+                candidate_lines = sorted(flagged)
+            events.extend(
+                match_pattern(pattern, lines, config, candidate_lines=candidate_lines)
+            )
     return events
 
 

@@ -1,0 +1,158 @@
+"""Native scanner + literal prefilter: correctness and equivalence.
+
+The invariant that matters: the prefilter NEVER changes analysis results —
+for any pattern set and any log, collect_events with the prefilter equals
+collect_events without it (it only skips lines the literal scan proves
+can't match).
+"""
+
+import random
+import string
+
+import pytest
+
+from operator_tpu.native import MultiPatternScanner, _load, _PyScanner
+from operator_tpu.patterns.engine import PatternEngine
+from operator_tpu.patterns.loader import load_builtin_library
+from operator_tpu.patterns.matcher import MatcherConfig, collect_events
+from operator_tpu.patterns.prefilter import (
+    LiteralPrefilter,
+    literals_for_pattern,
+    required_literals,
+)
+from operator_tpu.schema.analysis import PodFailureData
+from operator_tpu.schema.patterns import Pattern, PrimaryPattern
+
+
+class TestRequiredLiterals:
+    def test_escaped_literals_unescape(self):
+        assert required_literals(r"java\.lang\.OutOfMemoryError") == (
+            ["java.lang.OutOfMemoryError"], False)
+        assert required_literals(r"exit code 137") == (["exit code 137"], False)
+        assert required_literals(r"Traceback \(most recent call last\)") == (
+            ["Traceback (most recent call last)"], False)
+
+    def test_alternation_yields_literal_set(self):
+        literals, ci = required_literals(r"(?i)(OOMKilled|Out of memory: Killed process|oom-kill)")
+        assert ci is True
+        assert literals == ["oomkilled", "out of memory: killed process", "oom-kill"]
+
+    def test_optional_group_keeps_outer_run(self):
+        literals, ci = required_literals(
+            r"java\.lang\.OutOfMemoryError(:\s*(Java heap space|Metaspace))?"
+        )
+        assert literals == ["java.lang.OutOfMemoryError"] and ci is False
+
+    def test_quantified_and_class_segments_close_runs(self):
+        literals, _ = required_literals(r"(?i)port \d+ (is )?already in use")
+        assert literals == ["already in use"]
+        literals, _ = required_literals(r"bind.*address already in use")
+        assert literals == ["address already in use"]
+        # quantifier on the run's last char drops that char
+        literals, _ = required_literals(r"restarts? exceeded limit")
+        assert literals == [" exceeded limit"]
+
+    def test_unanchorable_patterns_bail(self):
+        for unsafe in (
+            r"\d+ errors",                   # runs too short
+            r"[Ee]rror",                      # class only
+            r"(ab|cd)",                       # branches too short
+            r"fail(?=ure)",                   # lookahead
+            r"(a)\1",                         # backreference
+            "trailing\\",                     # dangling escape
+            r"err.{0,5}",                     # nothing long enough
+        ):
+            assert required_literals(unsafe) is None, unsafe
+
+    def test_short_literals_not_anchored(self):
+        pattern = Pattern(id="p", primary_pattern=PrimaryPattern(regex="oom"))
+        assert literals_for_pattern(pattern) is None
+
+    def test_keywords_anchor_on_longest(self):
+        pattern = Pattern(
+            id="p",
+            primary_pattern=PrimaryPattern(keywords=["memory", "killed", "of"]),
+        )
+        assert literals_for_pattern(pattern) == (["memory"], True)
+
+    def test_builtin_library_mostly_anchored(self):
+        library = load_builtin_library()
+        prefilter = LiteralPrefilter(library.patterns)
+        assert prefilter.num_anchored >= len(library.patterns) * 3 // 4, (
+            f"only {prefilter.num_anchored}/{len(library.patterns)} anchored"
+        )
+
+
+class TestScannerParity:
+    """Native automaton and Python fallback must agree exactly."""
+
+    def test_native_library_builds(self):
+        assert _load() is not None, "g++ toolchain present but native build failed"
+
+    def test_known_hits(self):
+        literals = [b"OutOfMemoryError", b"exit code 137", b"Error"]
+        scanner = MultiPatternScanner(literals)
+        text = b"java.lang.OutOfMemoryError: heap\npod exit code 137 (Error)\n"
+        hits = sorted(scanner.scan(text))
+        # literal id 2 ("Error") also fires inside OutOfMemoryError
+        ids = [literal_id for literal_id, _ in hits]
+        assert ids.count(0) == 1 and ids.count(1) == 1 and ids.count(2) == 2
+        for literal_id, end in hits:
+            literal = literals[literal_id]
+            assert text[end - len(literal) + 1 : end + 1] == literal
+
+    def test_fuzz_parity_with_python_fallback(self):
+        rng = random.Random(7)
+        alphabet = string.ascii_lowercase[:6]
+        literals = [
+            "".join(rng.choice(alphabet) for _ in range(rng.randint(1, 5))).encode()
+            for _ in range(20)
+        ]
+        scanner = MultiPatternScanner(literals)
+        fallback = _PyScanner(literals)
+        for _ in range(25):
+            text = "".join(rng.choice(alphabet) for _ in range(400)).encode()
+            assert sorted(scanner.scan(text)) == sorted(fallback.scan(text))
+
+    def test_overlapping_and_nested_literals(self):
+        scanner = MultiPatternScanner([b"abab", b"bab", b"ab"])
+        hits = sorted(scanner.scan(b"xababab"))
+        # x a b a b a b: abab ends at 4,6; bab ends at 4,6; ab ends at 2,4,6
+        assert hits == [(0, 4), (0, 6), (1, 4), (1, 6), (2, 2), (2, 4), (2, 6)]
+
+
+class TestPrefilterEquivalence:
+    def _events_signature(self, events):
+        return sorted(
+            (e.matched_pattern.id, e.context.line_number, e.score) for e in events
+        )
+
+    def test_builtin_library_equivalence_on_fixtures(self):
+        import os
+
+        libraries = [load_builtin_library()]
+        patterns = [p for lib in libraries for p in lib.patterns]
+        prefilter = LiteralPrefilter(patterns)
+        fixture_dir = os.path.join(os.path.dirname(__file__), "fixtures")
+        config = MatcherConfig()
+        for name in os.listdir(fixture_dir):
+            with open(os.path.join(fixture_dir, name)) as f:
+                lines = f.read().splitlines()
+            plain = collect_events(libraries, lines, config)
+            filtered = collect_events(libraries, lines, config, prefilter=prefilter)
+            assert self._events_signature(plain) == self._events_signature(filtered), name
+
+    def test_engine_uses_prefilter_and_matches_unfiltered(self):
+        import os
+
+        fixture = os.path.join(os.path.dirname(__file__), "fixtures", "oom_java.log")
+        with open(fixture) as f:
+            logs = f.read()
+        failure = PodFailureData(logs=logs)
+        with_filter = PatternEngine(prefilter=True).analyze(failure)
+        without = PatternEngine(prefilter=False).analyze(failure)
+        assert [e.matched_pattern.id for e in with_filter.events] == [
+            e.matched_pattern.id for e in without.events
+        ]
+        assert with_filter.summary.total_events == without.summary.total_events
+        assert with_filter.events, "fixture should match at least one pattern"
