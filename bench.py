@@ -54,6 +54,74 @@ def build_requests(n: int) -> list:
     return requests
 
 
+def probe_default_backend() -> bool:
+    """Check the default jax backend is healthy — in a SUBPROCESS.
+
+    A flaky tunneled TPU plugin can either raise UNAVAILABLE *or hang
+    forever* inside make_c_api_client; neither may happen in this process
+    (a hung in-process init can never be interrupted and holds jax's global
+    backend lock, wedging even the cpu backend).  Retries with backoff.
+    """
+    import subprocess
+
+    retries = int(os.environ.get("BENCH_BACKEND_RETRIES", "3"))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "75"))
+    code = "import jax; d = jax.devices(); print(d[0].platform)"
+    for attempt in range(retries):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=probe_timeout,
+            )
+            if out.returncode == 0:
+                log(f"backend probe ok: {out.stdout.strip()}")
+                return True
+            log(f"backend probe failed (attempt {attempt + 1}/{retries}, "
+                f"rc={out.returncode}): {out.stderr.strip().splitlines()[-1] if out.stderr.strip() else '?'}")
+        except subprocess.TimeoutExpired:
+            # a hang won't resolve on retry, and retrying triples the dead
+            # time before the cpu fallback can produce any record at all
+            log(f"backend probe hung >{probe_timeout:.0f}s; not retrying a hang")
+            return False
+        if attempt + 1 < retries:
+            time.sleep(2.0 * 2**attempt)
+    return False
+
+
+def init_devices():
+    """Initialise a jax backend without ever dying on a flaky TPU plugin.
+
+    Order: explicit BENCH_PLATFORM override > default backend (subprocess
+    health probe first, so a hung plugin can't wedge this process) > cpu
+    fallback.  Returns (devices, platform_label).
+    """
+    import jax
+
+    override = os.environ.get("BENCH_PLATFORM", "").strip()
+    if override:
+        try:
+            jax.config.update("jax_platforms", override)
+        except Exception:  # partially initialised jax: explicit request below
+            pass
+        # explicit platform request — never resolves the default backend
+        devices = jax.devices(override)
+        jax.config.update("jax_default_device", devices[0])
+        return devices, override
+
+    if probe_default_backend():
+        devices = jax.devices()
+        return devices, devices[0].platform
+
+    log("default backend unavailable; falling back to cpu")
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    devices = jax.devices("cpu")
+    jax.config.update("jax_default_device", devices[0])
+    return devices, "cpu-fallback"
+
+
 def main() -> None:
     model_name = os.environ.get("BENCH_MODEL", "tinyllama-1.1b")
     n_requests = int(os.environ.get("BENCH_REQUESTS", "32"))
@@ -69,7 +137,19 @@ def main() -> None:
     from operator_tpu.serving.engine import BatchedGenerator, SamplingParams, ServingEngine
     from operator_tpu.serving.prompts import build_prompt
 
-    log(f"devices: {jax.devices()}")
+    devices, platform = init_devices()
+    log(f"devices ({platform}): {devices}")
+
+    if platform == "cpu-fallback" and "BENCH_MODEL" not in os.environ:
+        # insurance path: the TPU tunnel is down and no explicit model was
+        # requested.  A 1.1B model on host CPU would blow the driver timeout,
+        # so shrink the work to still produce a parseable (clearly degraded)
+        # record instead of rc=124.
+        model_name = "tiny-test"
+        n_requests = min(n_requests, 8)
+        max_tokens = min(max_tokens, 16)
+        max_seq = min(max_seq, 512)
+        log("cpu-fallback: degraded run with tiny-test model")
     log(f"model={model_name} requests={n_requests} slots={slots} "
         f"max_tokens={max_tokens} max_seq={max_seq}")
 
@@ -130,19 +210,36 @@ def main() -> None:
 
     log(f"wall={wall:.2f}s  p50={p50:.2f}s  p99={p99:.2f}s  "
         f"decode~{tokens_s:.0f} tok/s  throughput={per_min:.1f} expl/min")
+    degraded = platform == "cpu-fallback"
     print(json.dumps({
         "metric": "explanations_per_min",
         "value": round(per_min, 1),
         "unit": "explanations/min",
-        "vs_baseline": round(per_min / 100.0, 3),
+        # a degraded cpu run is not a measurement against the v5e baseline
+        "vs_baseline": 0.0 if degraded else round(per_min / 100.0, 3),
         "p50_latency_s": round(p50, 3),
         "p99_latency_s": round(p99, 3),
         "decode_tokens_per_s": round(tokens_s, 1),
         "model": model_name,
         "requests": n_requests,
         "max_tokens": max_tokens,
+        "platform": platform,
+        "degraded": degraded,
     }))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # never leave the driver with an unparseable traceback
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({
+            "metric": "explanations_per_min",
+            "value": 0.0,
+            "unit": "explanations/min",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        sys.exit(1)

@@ -119,6 +119,10 @@ class _PyScanner:
                 start = found + 1
         return np.asarray(ids, np.int32), np.asarray(offsets, np.int64)
 
+    def scan(self, text: bytes, max_hits: int = 1 << 20) -> list[tuple[int, int]]:
+        ids, offsets = self.scan_arrays(text, max_hits)
+        return [(int(i), int(o)) for i, o in zip(ids, offsets)]
+
 
 class _NativeScanner:
     def __init__(self, lib: ctypes.CDLL, literals: Sequence[bytes]) -> None:

@@ -28,6 +28,14 @@ MIN_LITERAL_LEN = 4
 
 #: zero-width / class escapes — not literal characters
 _NONLITERAL_ESCAPES = set("dDwWsSbBAZ")
+#: single-char escapes that decode to a real in-line character
+_CHAR_ESCAPES = {"t": "\t", "f": "\f", "v": "\v", "a": "\a"}
+#: escapes for characters that never occur inside a splitlines() line —
+#: a per-line match can't contain them, so they just close the run
+_LINEBREAK_ESCAPES = set("nr")
+#: numeric / named escapes (\xHH, \uHHHH, \UHHHHHHHH, \N{...}) — bail
+#: rather than guess the decoded character
+_OPAQUE_ESCAPES = set("xuUN")
 _QUANTIFIER_START = set("*+?{")
 
 
@@ -139,23 +147,30 @@ def _branch_runs(branch: str) -> Optional[list[str]]:
             if i + 1 >= len(branch):
                 return None
             escaped = branch[i + 1]
-            if escaped.isdigit():  # backreference
+            if escaped.isdigit():  # backreference / octal
+                return None
+            if escaped in _OPAQUE_ESCAPES:  # \xHH, \uHHHH, \N{...}: don't guess
                 return None
             after = i + 2
-            if escaped in _NONLITERAL_ESCAPES:
+            if escaped in _NONLITERAL_ESCAPES or escaped in _LINEBREAK_ESCAPES:
                 close()
                 end = _skip_quantifier(branch, after)
                 if end is None:
                     return None
                 i = end
                 continue
+            literal_char = _CHAR_ESCAPES.get(escaped)
+            if literal_char is None:
+                if escaped.isalnum():  # unrecognized alphanumeric escape
+                    return None
+                literal_char = escaped  # escaped punctuation: \. \( \\ ...
             end = _skip_quantifier(branch, after)
             if end is None:
                 return None
             if end != after:  # quantified literal: can't require it
                 close()
             else:
-                current.append(escaped)
+                current.append(literal_char)
             i = end
             continue
         if ch == "(":
@@ -227,7 +242,7 @@ def _unwrap(regex: str) -> str:
         end = _skip_group(regex, 0)
         if end != len(regex):
             return regex
-        regex = regex[4:-1] if regex.startswith("(?:") else regex[1:-1]
+        regex = regex[3:-1] if regex.startswith("(?:") else regex[1:-1]
     return regex
 
 
@@ -288,6 +303,13 @@ class LiteralPrefilter:
                 self.full_scan_ids.add(pattern.id)
                 continue
             literals, case_insensitive = anchored
+            if case_insensitive and not all(lit.isascii() for lit in literals):
+                # the ci scan lowercases BYTES (ASCII-only) but literals are
+                # lowercased as str (full Unicode); for non-ASCII letters the
+                # two disagree and the literal may silently never be found —
+                # conservative: full scan for the whole pattern
+                self.full_scan_ids.add(pattern.id)
+                continue
             for literal in literals:
                 if case_insensitive:
                     ci_literals.append(literal.encode("utf-8", "surrogateescape"))

@@ -54,15 +54,43 @@ class TestRequiredLiterals:
 
     def test_unanchorable_patterns_bail(self):
         for unsafe in (
-            r"\d+ errors",                   # runs too short
-            r"[Ee]rror",                      # class only
+            r"[Ee]rr",                        # run after class too short
             r"(ab|cd)",                       # branches too short
             r"fail(?=ure)",                   # lookahead
             r"(a)\1",                         # backreference
             "trailing\\",                     # dangling escape
             r"err.{0,5}",                     # nothing long enough
+            r"foo\x41barbaz",                 # opaque numeric escape
+            "foo\\u0041barbaz",               # opaque unicode escape
+            r"warn\N{BULLET}level",           # opaque named escape
         ):
             assert required_literals(unsafe) is None, unsafe
+
+    def test_class_segment_leaves_sound_anchor(self):
+        # "\d+ errors": every match still contains " errors" — sound anchor
+        assert required_literals(r"\d+ errors") == ([" errors"], False)
+        assert required_literals(r"[Ee]rror") == (["rror"], False)
+
+    def test_char_escapes_decode_to_real_characters(self):
+        # \t must decode to TAB, not the letter 't' (a literal that never
+        # appears in matching text would silently drop every match)
+        assert required_literals(r"exit\tcode") == (["exit\tcode"], False)
+        assert required_literals(r"form\ffeed") == (["form\ffeed"], False)
+        # \n can't occur inside a splitlines() line: closes the run
+        literals, _ = required_literals(r"first\nsecondpart")
+        assert literals == ["secondpart"]
+
+    def test_unwrap_noncapturing_group_keeps_first_char(self):
+        # '(?:' is 3 chars; a wrong strip would corrupt the first branch
+        literals, ci = required_literals(r"(?:(xy)longliteral|zz99)")
+        assert literals == ["longliteral", "zz99"] and ci is False
+
+    def test_nonascii_ci_literals_fall_back_to_full_scan(self):
+        pattern = Pattern(
+            id="p", primary_pattern=PrimaryPattern(regex="(?i)ÉCHEC critique")
+        )
+        prefilter = LiteralPrefilter([pattern])
+        assert "p" in prefilter.full_scan_ids
 
     def test_short_literals_not_anchored(self):
         pattern = Pattern(id="p", primary_pattern=PrimaryPattern(regex="oom"))
