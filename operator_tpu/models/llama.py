@@ -317,9 +317,9 @@ def decode_step_paged(
 
     Each sequence appends at its own ``lengths[b]`` position (the page
     table maps it to a page/slot) and attends over exactly its own pages —
-    the ragged-batch decode of SURVEY.md §7 hard part (c).  Full attention
-    only: the paged path does not implement sliding windows (serving
-    max_seq is far below Mistral's 4096 window, so nothing is lost).
+    the ragged-batch decode of SURVEY.md §7 hard part (c).  Sliding-window
+    configs (Mistral) mask to the last ``sliding_window`` tokens, matching
+    the contiguous path's make_causal_mask semantics.
 
     Returns (last-token logits [B, vocab] float32, cache with lengths+1).
     """
@@ -345,6 +345,7 @@ def decode_step_paged(
         attn = paged_attention(
             q[:, 0].astype(k_pages.dtype), k_pages, v_pages,
             paged.page_table, new_lengths,
+            sliding_window=config.sliding_window,
         )  # [B, QH, D]
         x = x + attn.astype(x.dtype).reshape(b, 1, -1) @ weights["wo"]
         mlp_in = rms_norm(x, weights["ln_mlp"], config.rms_norm_eps)

@@ -195,9 +195,20 @@ def load_kubeconfig(path: Optional[str] = None) -> ClusterConfig:
 class HttpKubeApi(KubeApi):
     """KubeApi over HTTP(S) to a real apiserver."""
 
-    def __init__(self, config: ClusterConfig, *, request_timeout_s: float = 30.0) -> None:
+    #: slack past the server-side watch timeout before declaring the
+    #: socket half-open (server close should always arrive first)
+    _WATCH_SOCKET_MARGIN_S = 30.0
+
+    def __init__(
+        self,
+        config: ClusterConfig,
+        *,
+        request_timeout_s: float = 30.0,
+        watch_timeout_s: float = 300.0,
+    ) -> None:
         self.config = config
         self.request_timeout_s = request_timeout_s
+        self.watch_timeout_s = watch_timeout_s
         self._ssl = config.ssl_context()
 
     # -- construction ---------------------------------------------------
@@ -370,22 +381,38 @@ class HttpKubeApi(KubeApi):
         :class:`WatchClosed` so the caller's restart-after-5s loop engages
         (reference PodFailureWatcher.java:562-583).
         """
+        # the apiserver ends the watch after timeoutSeconds (clean close ->
+        # reconnect); the socket timeout is the backstop for HALF-OPEN
+        # connections (node reboot, LB idle drop without FIN) which would
+        # otherwise block readline in its worker thread forever and
+        # silently stop failure detection — the fabric8 client the
+        # reference relies on keeps watches live the same two ways
         path = self._path(kind, namespace) + "?" + urllib.parse.urlencode(
-            {"watch": "true", "allowWatchBookmarks": "false"}
+            {
+                "watch": "true",
+                "allowWatchBookmarks": "false",
+                "timeoutSeconds": str(int(self.watch_timeout_s)),
+            }
         )
-        conn = self._connect(timeout=None)  # long-lived stream
+        conn = self._connect(timeout=self.watch_timeout_s + self._WATCH_SOCKET_MARGIN_S)
 
         def open_stream() -> Any:
             conn.request("GET", path, headers=self._headers())
             return conn.getresponse()
 
         try:
-            response = await asyncio.to_thread(open_stream)
+            try:
+                response = await asyncio.to_thread(open_stream)
+            except (TimeoutError, OSError) as exc:
+                raise WatchClosed(f"watch open for {kind} failed: {exc}") from exc
             if response.status >= 400:
                 payload = await asyncio.to_thread(response.read)
                 _raise_for_status(response.status, payload, f"WATCH {path}")
             while True:
-                line = await asyncio.to_thread(response.readline)
+                try:
+                    line = await asyncio.to_thread(response.readline)
+                except (TimeoutError, OSError) as exc:  # dead-peer socket timeout
+                    raise WatchClosed(f"watch stream for {kind} timed out: {exc}") from exc
                 if not line:
                     raise WatchClosed(f"watch stream for {kind} closed by server")
                 line = line.strip()

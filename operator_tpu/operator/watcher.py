@@ -79,12 +79,23 @@ class PodmortemCache:
         self.resync_delay_s = resync_delay_s
         self._items: dict[tuple[str, str], Podmortem] = {}
         self._primed = False
+        self._ready = asyncio.Event()
 
     async def prime(self) -> None:
         for raw in await self.api.list("Podmortem"):
             pm = Podmortem.parse(raw)
             self._items[(pm.metadata.namespace, pm.metadata.name)] = pm
         self._primed = True
+        self._ready.set()
+
+    async def wait_ready(self, timeout_s: float) -> bool:
+        """Best-effort wait for the first successful prime — the pod sweep
+        is useless against an empty CR cache (nothing would match)."""
+        try:
+            await asyncio.wait_for(self._ready.wait(), timeout_s)
+            return True
+        except asyncio.TimeoutError:
+            return False
 
     async def run(self, stop: asyncio.Event) -> None:
         """Maintain the cache until ``stop`` is set; resyncs on watch close."""
@@ -173,11 +184,14 @@ class PodFailureWatcher:
         if not has_pod_failed(pod):
             return 0
         failure_time = get_failure_time(pod) or "unknown"
-        if self._seen_recently(pod, failure_time):
-            return 0
+        # match BEFORE marking seen: a failure observed while the CR cache is
+        # still priming must stay eligible for the next observation (sweep,
+        # repeat event, or reconciler) instead of being suppressed forever
         matching = self.cache.matching(pod)
         if not matching:
             log.debug("failed pod %s matches no Podmortem CR", pod.qualified_name())
+            return 0
+        if self._seen_recently(pod, failure_time):
             return 0
         log.info("pod failure %s at %s -> %d podmortem(s)",
                  pod.qualified_name(), failure_time, len(matching))
@@ -194,6 +208,8 @@ class PodFailureWatcher:
         Survives any exception, not just clean watch closes — a dead watch
         loop with a live process would be invisible to health probes."""
         cache_task = asyncio.create_task(self.cache.run(stop))
+        if not await self.cache.wait_ready(10.0):
+            log.warning("podmortem cache not primed after 10s; watching anyway")
         try:
             while not stop.is_set():
                 try:
@@ -237,6 +253,19 @@ class PodFailureWatcher:
             await asyncio.gather(*tasks, return_exceptions=True)
 
     async def _watch_one(self, namespace: Optional[str], stop: asyncio.Event) -> None:
+        # close the blind window between watch sessions: the stream recycles
+        # every watch_timeout_s (and on any network failure), and a pod that
+        # failed during the gap will never emit another event — sweep current
+        # pods first; dedupe makes re-observation free (reference covers this
+        # with its poll-path reconciler, we cover it at both layers)
+        try:
+            for raw in await self.api.list("Pod", namespace):
+                try:
+                    await self.handle_pod_event("MODIFIED", Pod.parse(raw))
+                except Exception:  # noqa: BLE001 - one bad pod shouldn't kill the sweep
+                    log.exception("pre-watch sweep failed for one pod; skipping")
+        except Exception:  # noqa: BLE001 - sweep is best-effort; watch still runs
+            log.warning("pre-watch pod sweep failed; relying on reconciler", exc_info=True)
         async for event in self.api.watch("Pod", namespace):
             try:
                 pod = Pod.parse(event.object)

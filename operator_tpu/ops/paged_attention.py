@@ -119,6 +119,7 @@ def paged_attention_reference(
     v_pages: jax.Array,
     page_table: jax.Array,  # [B, pages_per_seq]
     lengths: jax.Array,  # [B] number of valid tokens (incl. current)
+    sliding_window: Optional[int] = None,
 ) -> jax.Array:
     """Gather-then-attend oracle.  Returns [B, QH, D] in q.dtype."""
     b, qh, d = q.shape
@@ -135,7 +136,13 @@ def paged_attention_reference(
     scores = jnp.einsum(
         "bkgd,bskd->bkgs", q_grouped, k, preferred_element_type=jnp.float32
     ) * (d**-0.5)
-    valid = jnp.arange(max_seq, dtype=jnp.int32)[None, :] < lengths[:, None]
+    positions = jnp.arange(max_seq, dtype=jnp.int32)[None, :]
+    valid = positions < lengths[:, None]
+    if sliding_window is not None:
+        # the decoding token (position lengths-1) attends to the last
+        # `window` tokens: positions >= lengths - window (same semantics
+        # as make_causal_mask's `recent` term in models/llama.py)
+        valid = valid & (positions >= lengths[:, None] - sliding_window)
     scores = jnp.where(valid[:, None, None, :], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     out = jnp.einsum("bkgs,bskd->bkgd", probs, v)
@@ -165,6 +172,7 @@ def _paged_attn_kernel(
     q_per_kv: int,
     page_size: int,
     scale: float,
+    window: Optional[int] = None,
 ):
     from jax.experimental import pallas as pl
 
@@ -180,8 +188,14 @@ def _paged_attn_kernel(
 
     seq_len = len_ref[b]
 
-    # only touch pages that hold live tokens
-    @pl.when(j * page_size < seq_len)
+    # only touch pages that hold live tokens — and, with a sliding window,
+    # only pages overlapping [seq_len - window, seq_len)
+    live = j * page_size < seq_len
+    if window is not None:
+        window_lo = jnp.maximum(seq_len - window, 0)
+        live = jnp.logical_and(live, (j + 1) * page_size > window_lo)
+
+    @pl.when(live)
     def _compute():
         q = q_ref[0].astype(jnp.float32)  # [QH, D]
         k = k_ref[0]  # [page, KH, D]
@@ -202,6 +216,8 @@ def _paged_attn_kernel(
 
         pos = j * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(pos < seq_len, s, _NEG_INF)
+        if window is not None:
+            s = jnp.where(pos >= window_lo, s, _NEG_INF)
 
         m_prev = m_scratch[...]  # [QH, LANE]
         l_prev = l_scratch[...]
@@ -235,7 +251,7 @@ def _paged_attn_kernel(
         out_ref[0] = (acc_scratch[...] / denom).astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "sliding_window"))
 def _paged_attention_pallas(
     q: jax.Array,
     k_pages: jax.Array,
@@ -244,6 +260,7 @@ def _paged_attention_pallas(
     lengths: jax.Array,
     *,
     interpret: bool = False,
+    sliding_window: Optional[int] = None,
 ) -> jax.Array:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -259,6 +276,7 @@ def _paged_attention_pallas(
         q_per_kv=qh // kh,
         page_size=page_size,
         scale=scale,
+        window=sliding_window,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -294,10 +312,15 @@ def paged_attention(
     v_pages: jax.Array,
     page_table: jax.Array,
     lengths: jax.Array,
+    sliding_window: Optional[int] = None,
 ) -> jax.Array:
     """Dispatch: Pallas kernel on TPU, dense reference elsewhere."""
     from ._dispatch import on_tpu
 
     if on_tpu(q, k_pages):
-        return _paged_attention_pallas(q, k_pages, v_pages, page_table, lengths)
-    return paged_attention_reference(q, k_pages, v_pages, page_table, lengths)
+        return _paged_attention_pallas(
+            q, k_pages, v_pages, page_table, lengths, sliding_window=sliding_window
+        )
+    return paged_attention_reference(
+        q, k_pages, v_pages, page_table, lengths, sliding_window=sliding_window
+    )

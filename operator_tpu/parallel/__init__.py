@@ -10,6 +10,7 @@ from .mesh import (
     logits_spec,
     make_mesh,
     mesh_summary,
+    paged_cache_specs,
     param_shardings,
     param_specs,
     plan_for,

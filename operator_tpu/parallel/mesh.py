@@ -53,12 +53,29 @@ class MeshPlan:
         return self.dp * self.fsdp * self.tp
 
 
+def _hbm_budget(devices: Optional[list]) -> float:
+    """Usable HBM per chip: measured when the runtime exposes it, with the
+    v5e constant as fallback (16 GB chip, ~12.5% headroom for XLA scratch)."""
+    fallback = 14e9
+    if not devices:
+        return fallback
+    try:
+        stats = devices[0].memory_stats()
+        limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        if limit:
+            return float(limit) * 0.875
+    except Exception:  # backend without memory_stats (cpu, older plugins)
+        pass
+    return fallback
+
+
 def plan_for(
     n_devices: int,
     *,
     tp: Optional[int] = None,
     fsdp: int = 1,
     config: Optional[ModelConfig] = None,
+    devices: Optional[list] = None,
 ) -> MeshPlan:
     """Choose a mesh factorisation for ``n_devices``.
 
@@ -78,7 +95,7 @@ def plan_for(
                    + 3 * config.hidden_size * config.intermediate_size)
             )
             bytes_needed = approx_params * 2  # bf16
-            hbm_per_chip = 14e9  # leave headroom on a 16 GB v5e chip
+            hbm_per_chip = _hbm_budget(devices)
             while tp < n_devices and (bytes_needed / tp) > hbm_per_chip:
                 tp *= 2
             while tp > 1 and config.num_kv_heads % tp != 0:
@@ -94,7 +111,7 @@ def plan_for(
 
 def make_mesh(plan: Optional[MeshPlan] = None, devices: Optional[list] = None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
-    plan = plan or plan_for(len(devices))
+    plan = plan or plan_for(len(devices), devices=devices)
     used = devices[: plan.total]
     array = np.asarray(used).reshape(plan.dp, plan.fsdp, plan.tp)
     return Mesh(array, AXES)
@@ -165,6 +182,25 @@ def batch_spec() -> P:
 def kv_cache_spec() -> P:
     """[layers, B, S, kv_heads, head_dim]: batch over dp(+fsdp), heads over tp."""
     return P(None, ("dp", "fsdp"), None, "tp", None)
+
+
+def paged_cache_specs() -> Any:
+    """PartitionSpecs mirroring the ``PagedKVCache`` pytree.
+
+    The page pool is shared by every sequence (any slot may hold any page),
+    so the page axis can NOT shard over dp — pages shard over **tp on the
+    KV-head axis** only, and dp parallelism comes from the batch-sharded
+    queries/tokens.  The per-step token writes a dp shard contributes are
+    [B/dp, 1, KH/tp, D] — kilobytes over ICI — so replicating the pool
+    across dp costs bandwidth only at that scatter, not attention reads.
+    Tables/lengths are tiny and replicated.
+    """
+    from ..ops.paged_attention import PagedKVCache
+
+    pages = P(None, None, None, "tp", None)  # [L, pages, page_size, KH, D]
+    return PagedKVCache(
+        k_pages=pages, v_pages=pages, page_table=P(None, None), lengths=P(None)
+    )
 
 
 def logits_spec() -> P:

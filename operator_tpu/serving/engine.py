@@ -138,19 +138,32 @@ class BatchedGenerator:
         paged: bool = False,
         page_size: int = 64,
         kv_pages: Optional[int] = None,
+        mesh: Any = None,
     ) -> None:
         import jax
         import jax.numpy as jnp
 
         self._jax = jax
         self._jnp = jnp
-        self.params = params
         self.config = config
         self.tokenizer = tokenizer
         self.max_slots = max_slots
         self.max_seq = min(max_seq or config.max_seq_len, config.max_seq_len)
         self.metrics = metrics or METRICS
         cache_dtype = cache_dtype or jnp.bfloat16
+
+        # ---- sharded serving (BASELINE configs 3/5): params TP on heads /
+        # MLP columns, slots DP over the batch axis; one jitted program per
+        # mesh — XLA inserts the tp psums and dp scatter collectives
+        self.mesh = mesh
+        if mesh is not None:
+            self._init_shardings(mesh)
+            params = self._jax.tree_util.tree_map(
+                jax.device_put, params, self._param_shardings
+            )
+        else:
+            self._shardings = None
+        self.params = params
 
         self.paged = paged
         self.page_size = page_size
@@ -169,16 +182,76 @@ class BatchedGenerator:
             )
             self.cache = None
             self._host_offsets = np.zeros((max_slots,), np.int64)
-            self._decode_fn = jax.jit(self._decode_step_paged)
+            if mesh is not None:
+                s = self._shardings
+                self.paged_cache = jax.device_put(self.paged_cache, s["paged"])
+                self._decode_fn = jax.jit(
+                    self._decode_step_paged,
+                    in_shardings=(
+                        self._param_shardings, s["paged"], s["tokens"],
+                        s["repl"], s["batch"], s["batch"], s["batch"],
+                    ),
+                    out_shardings=(s["paged"], s["batch"], s["repl"]),
+                )
+            else:
+                self._decode_fn = jax.jit(self._decode_step_paged)
         else:
             self.cache = KVCache.create(config, max_slots, self.max_seq, dtype=cache_dtype)
-            self._decode_fn = jax.jit(self._decode_step)
+            if mesh is not None:
+                s = self._shardings
+                self.cache = jax.device_put(self.cache, s["cache"])
+                self._decode_fn = jax.jit(
+                    self._decode_step,
+                    in_shardings=(
+                        self._param_shardings, s["cache"], s["tokens"],
+                        s["batch"], s["repl"], s["batch"], s["batch"], s["batch"],
+                    ),
+                    out_shardings=(s["cache"], s["batch"], s["batch"], s["repl"]),
+                )
+            else:
+                self._decode_fn = jax.jit(self._decode_step)
         self.offsets = jnp.zeros((max_slots,), jnp.int32)  # tokens held per slot
         self.last_tokens = jnp.zeros((max_slots, 1), jnp.int32)
         self.slots: list[_Slot] = [_Slot() for _ in range(max_slots)]
         self._rng = jax.random.PRNGKey(seed)
 
         self._prefill_fns: dict[tuple[int, int], Any] = {}
+
+    def _init_shardings(self, mesh: Any) -> None:
+        """Validate the mesh against the model and build the sharding table."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import kv_cache_spec, paged_cache_specs, param_shardings
+
+        jax = self._jax
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        tp = sizes.get("tp", 1)
+        dp_total = sizes.get("dp", 1) * sizes.get("fsdp", 1)
+        if self.config.num_kv_heads % tp or self.config.num_heads % tp:
+            raise ValueError(
+                f"tp={tp} must divide kv_heads={self.config.num_kv_heads} "
+                f"and heads={self.config.num_heads}"
+            )
+        if self.max_slots % dp_total:
+            raise ValueError(
+                f"max_slots={self.max_slots} must be a multiple of "
+                f"dp*fsdp={dp_total} (slots shard over the data axes)"
+            )
+        self._dp_total = dp_total
+
+        def ns(spec):
+            return NamedSharding(mesh, spec)
+
+        self._param_shardings = param_shardings(mesh, self.config)
+        self._shardings = {
+            "repl": ns(P()),
+            "batch": ns(P(("dp", "fsdp"))),          # [B] per-slot vectors
+            "tokens": ns(P(("dp", "fsdp"), None)),   # [B, 1] decode tokens
+            "cache": KVCache(k=ns(kv_cache_spec()), v=ns(kv_cache_spec())),
+            "paged": jax.tree_util.tree_map(
+                ns, paged_cache_specs(), is_leaf=lambda x: isinstance(x, P)
+            ),
+        }
 
     # ------------------------------------------------------------------
     # jitted bodies
@@ -232,12 +305,24 @@ class BatchedGenerator:
         picked = jnp.where(temp <= 0.0, greedy, sampled.astype(jnp.int32))
         return picked, rng
 
+    def _prefill_shardings(self, n_pad: int):
+        """(row, vec) shardings for a prefill bucket: rows shard over the
+        data axes when the bucket divides evenly, else replicate (dp shards
+        then duplicate the prefill flops — correct, just not parallel)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if n_pad % self._dp_total == 0:
+            return (
+                NamedSharding(self.mesh, P(("dp", "fsdp"), None)),
+                NamedSharding(self.mesh, P(("dp", "fsdp"))),
+            )
+        return self._shardings["repl"], self._shardings["repl"]
+
     def _make_prefill(self, n_pad: int, t_pad: int):
         """Compile a prefill program for the (n_pad, t_pad) bucket."""
         jax, jnp = self._jax, self._jnp
         config = self.config
 
-        @jax.jit
         def prefill_fn(params, cache, token_ids, lengths, slot_ids, rng, temp, top_p):
             # fresh contiguous mini-cache for the prompt tokens
             mini = KVCache.create(config, n_pad, t_pad, dtype=cache.k.dtype)
@@ -264,7 +349,18 @@ class BatchedGenerator:
             first_tokens, rng = self._sample(last, rng, temp, top_p)
             return KVCache(k=k, v=v), first_tokens, rng
 
-        return prefill_fn
+        if self.mesh is None:
+            return jax.jit(prefill_fn)
+        s = self._shardings
+        rows, vec = self._prefill_shardings(n_pad)
+        return jax.jit(
+            prefill_fn,
+            in_shardings=(
+                self._param_shardings, s["cache"], rows, vec, vec,
+                s["repl"], vec, vec,
+            ),
+            out_shardings=(s["cache"], vec, s["repl"]),
+        )
 
     def _make_prefill_paged(self, n_pad: int, t_pad: int):
         """Prefill for the paged cache: same mini-cache forward, then the
@@ -273,7 +369,6 @@ class BatchedGenerator:
         jax, jnp = self._jax, self._jnp
         config = self.config
 
-        @jax.jit
         def prefill_fn(params, paged, token_ids, lengths, row_tables, rng, temp, top_p):
             from ..models.llama import make_causal_mask
             from ..ops.paged_attention import PagedKVCache, write_tokens
@@ -304,7 +399,18 @@ class BatchedGenerator:
             )
             return new_paged, first_tokens, rng
 
-        return prefill_fn
+        if self.mesh is None:
+            return jax.jit(prefill_fn)
+        s = self._shardings
+        rows, vec = self._prefill_shardings(n_pad)
+        return jax.jit(
+            prefill_fn,
+            in_shardings=(
+                self._param_shardings, s["paged"], rows, vec, rows,
+                s["repl"], vec, vec,
+            ),
+            out_shardings=(s["paged"], vec, s["repl"]),
+        )
 
     # ------------------------------------------------------------------
     # host-side API

@@ -14,10 +14,12 @@ each request carries its own SamplingParams into the shared batch
 
 Model selection: ``modelId`` in the CR (must name a registered config);
 weights from ``OperatorConfig.checkpoint_dir`` (HF safetensors). Without a
-checkpoint the engine still runs — randomly-initialised weights — which
-keeps every pipeline, test, and benchmark runnable in an air-gapped
-environment; quality then comes from the template fallback the pipeline
-layers on top.
+checkpoint the factory REFUSES to build (:class:`MissingCheckpoint`) so the
+pipeline degrades to the pattern-only/template path — the reference emits a
+degradation event rather than storing garbage (PodFailureWatcher.java:385-420),
+and random-weight text is garbage.  Benches/tests that genuinely want a
+random-init engine set ``allow_random_weights`` (they construct prompts
+whose THROUGHPUT is weight-independent, so the measurement is honest).
 """
 
 from __future__ import annotations
@@ -33,6 +35,33 @@ from .engine import BatchedGenerator, SamplingParams, ServingEngine
 from .prompts import build_prompt
 
 log = logging.getLogger(__name__)
+
+
+class MissingCheckpoint(RuntimeError):
+    """tpu-native is configured but no model weights are mounted."""
+
+
+def _parse_mesh_plan(spec: str, devices: list, model_config):
+    """'auto' or 'dp=2,tp=4[,fsdp=1]' -> MeshPlan."""
+    from ..parallel.mesh import MeshPlan, plan_for
+
+    if spec == "auto":
+        # pass devices so tp sizing uses measured HBM, not the v5e constant
+        return plan_for(len(devices), config=model_config, devices=devices)
+    sizes = {"dp": 1, "fsdp": 1, "tp": 1}
+    for part in spec.split(","):
+        axis, _, value = part.strip().partition("=")
+        if axis not in sizes or not value.isdigit():
+            raise ValueError(
+                f"bad serving_mesh {spec!r}: expected 'auto' or 'dp=N,tp=N[,fsdp=N]'"
+            )
+        sizes[axis] = int(value)
+    plan = MeshPlan(**sizes)
+    if plan.total > len(devices):
+        raise ValueError(
+            f"serving_mesh {spec!r} needs {plan.total} devices, found {len(devices)}"
+        )
+    return plan
 
 
 class TPUNativeProvider:
@@ -92,13 +121,32 @@ def build_tpu_native_provider(
     if checkpoint_dir and os.path.isdir(checkpoint_dir):
         log.info("loading %s weights from %s", model_id, checkpoint_dir)
         params = load_params(checkpoint_dir, model_config, dtype=jnp.bfloat16)
-    else:
+    elif config.allow_random_weights:
         log.warning(
             "no checkpoint for %s (checkpoint_dir=%r); using random init — "
-            "explanations will be non-linguistic until weights are mounted",
+            "explanations will be non-linguistic (allow_random_weights set)",
             model_id, checkpoint_dir,
         )
         params = init_params(model_config, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    else:
+        # refusing keeps random-weight noise out of pod annotations: the
+        # pipeline catches the ProviderError and stores the pattern-only
+        # result + degradation event instead (reference behaviour for a
+        # missing AI backend, PodFailureWatcher.java:385-420)
+        raise MissingCheckpoint(
+            f"providerId tpu-native needs weights for {model_id!r} but "
+            f"checkpoint_dir={checkpoint_dir!r} does not exist; mount a "
+            f"checkpoint or set ALLOW_RANDOM_WEIGHTS=true (testing only)"
+        )
+
+    mesh = None
+    if config.serving_mesh:
+        from ..parallel.mesh import make_mesh, mesh_summary
+
+        devices = jax.devices()
+        plan = _parse_mesh_plan(config.serving_mesh, devices, model_config)
+        mesh = make_mesh(plan, devices)
+        log.info("sharded serving: %s", mesh_summary(mesh))
 
     generator = BatchedGenerator(
         params,
@@ -109,6 +157,7 @@ def build_tpu_native_provider(
         paged=config.kv_cache_mode == "paged",
         page_size=config.kv_page_size,
         kv_pages=config.kv_pages or None,
+        mesh=mesh,
     )
     engine = ServingEngine(generator)
     return TPUNativeProvider(engine, model_id=model_id)

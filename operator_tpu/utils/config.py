@@ -59,6 +59,13 @@ class OperatorConfig:
     kv_cache_mode: str = "paged"  # "paged" | "contiguous"
     kv_page_size: int = 64
     kv_pages: int = 0
+    # multi-chip serving (BASELINE configs 3/5): "" = single device,
+    # "auto" = plan_for(all local devices), or explicit "dp=2,tp=4[,fsdp=1]"
+    serving_mesh: str = ""
+    # production safety: without a checkpoint the engine would generate
+    # noise from random weights; the provider factory refuses unless this
+    # is set (tests/benches opt in explicitly)
+    allow_random_weights: bool = False
 
     @classmethod
     def from_env(cls, env: Optional[dict[str, str]] = None) -> "OperatorConfig":
@@ -77,6 +84,8 @@ class OperatorConfig:
                 cfg.__setattr__(f.name, float(raw))
             elif f.type in ("int", int):
                 cfg.__setattr__(f.name, int(raw))
+            elif f.type in ("bool", bool):
+                cfg.__setattr__(f.name, raw.strip().lower() in ("1", "true", "yes", "on"))
             else:
                 cfg.__setattr__(f.name, raw)
         return cfg

