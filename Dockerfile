@@ -13,9 +13,12 @@ WORKDIR /app
 
 # jax[tpu] pulls libtpu via the google releases index; pinned for
 # reproducible serving behaviour
+# safetensors: model + encoder checkpoint loading; transformers: WordPiece/
+# BPE tokenizers for mounted checkpoints (both load local files only — the
+# runtime makes no hub calls)
 RUN pip install --no-cache-dir "jax[tpu]==0.9.0" \
       -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
-    && pip install --no-cache-dir pyyaml
+    && pip install --no-cache-dir pyyaml safetensors transformers
 
 COPY operator_tpu/ operator_tpu/
 COPY pyproject.toml README.md ./

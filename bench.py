@@ -201,7 +201,13 @@ def main() -> None:
         await serving.close()
         return wall, latencies
 
-    wall, latencies = asyncio.run(run())
+    profile_dir = os.environ.get("BENCH_PROFILE", "").strip()
+    if profile_dir:
+        log(f"profiling timed region -> {profile_dir}")
+        with generator.trace(profile_dir):
+            wall, latencies = asyncio.run(run())
+    else:
+        wall, latencies = asyncio.run(run())
     latencies.sort()
     p50 = latencies[len(latencies) // 2]
     p99 = latencies[min(len(latencies) - 1, int(len(latencies) * 0.99))]

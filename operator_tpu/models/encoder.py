@@ -253,3 +253,47 @@ def convert_hf_bert_state_dict(
     if missing_top:
         raise ValueError(f"encoder checkpoint missing {missing_top}")
     return {**top, "layers": layers}
+
+
+def encoder_config_from_hf_json(checkpoint_dir: str) -> EncoderConfig:
+    """Build an :class:`EncoderConfig` from a HF ``config.json`` (the
+    all-MiniLM-L6-v2 layout); falls back to MINILM_L6 when absent."""
+    import json
+    import os
+
+    path = os.path.join(checkpoint_dir, "config.json")
+    if not os.path.exists(path):
+        return MINILM_L6
+    with open(path) as f:
+        raw = json.load(f)
+    return EncoderConfig(
+        name=raw.get("_name_or_path") or os.path.basename(checkpoint_dir) or "hf-encoder",
+        vocab_size=int(raw.get("vocab_size", MINILM_L6.vocab_size)),
+        hidden_size=int(raw.get("hidden_size", MINILM_L6.hidden_size)),
+        intermediate_size=int(raw.get("intermediate_size", MINILM_L6.intermediate_size)),
+        num_layers=int(raw.get("num_hidden_layers", MINILM_L6.num_layers)),
+        num_heads=int(raw.get("num_attention_heads", MINILM_L6.num_heads)),
+        max_positions=int(raw.get("max_position_embeddings", MINILM_L6.max_positions)),
+        type_vocab_size=int(raw.get("type_vocab_size", MINILM_L6.type_vocab_size)),
+        layer_norm_eps=float(raw.get("layer_norm_eps", MINILM_L6.layer_norm_eps)),
+    )
+
+
+def load_encoder_params(
+    checkpoint_dir: str,
+    config: Optional[EncoderConfig] = None,
+    dtype: jnp.dtype = jnp.float32,
+) -> tuple[Params, EncoderConfig]:
+    """Load a MiniLM-class safetensors checkpoint directory.
+
+    Completes the subsumed log-parser's semantic path (reference contract
+    LogParserRestClient.java:37-39): with this, NeuralEmbedder runs on real
+    sentence-transformer weights instead of random init.  Returns
+    ``(params, config)`` with the config read from the directory's
+    ``config.json`` unless one is passed.
+    """
+    from .loader import iter_safetensors
+
+    config = config or encoder_config_from_hf_json(checkpoint_dir)
+    params = convert_hf_bert_state_dict(iter_safetensors(checkpoint_dir), config, dtype)
+    return params, config

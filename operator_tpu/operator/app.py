@@ -49,7 +49,10 @@ class Operator:
         self.metrics = metrics or METRICS
         self.providers = providers or default_registry()
         self._register_tpu_provider()
-        self.engine = PatternEngine(cache_dir=self.config.pattern_cache_directory)
+        self.engine = PatternEngine(
+            cache_dir=self.config.pattern_cache_directory,
+            semantic=self._build_semantic(),
+        )
         self.events = EventService(api, self.config)
         self.storage = AnalysisStorageService(api, self.config)
         self.pipeline = AnalysisPipeline(
@@ -99,6 +102,27 @@ class Operator:
             return build_tpu_native_provider(self.config)
 
         self.providers.register_factory("tpu-native", factory)
+
+    def _build_semantic(self):
+        """Neural semantic matcher when an encoder checkpoint is mounted;
+        None otherwise (lexical regex/keyword matching still runs).  A bad
+        checkpoint degrades with a warning — pattern matching must never be
+        taken down by the optional neural scorer."""
+        directory = self.config.encoder_checkpoint_dir
+        if not directory:
+            return None
+        try:
+            from ..patterns.semantic import NeuralEmbedder, SemanticMatcher
+
+            embedder = NeuralEmbedder.from_checkpoint(directory)
+            log.info("semantic matching: MiniLM encoder from %s", directory)
+            return SemanticMatcher(embedder=embedder)
+        except Exception:  # noqa: BLE001 - degrade to lexical-only
+            log.warning(
+                "encoder checkpoint %s unusable; semantic matching disabled",
+                directory, exc_info=True,
+            )
+            return None
 
     # ------------------------------------------------------------------
     async def start(self) -> None:

@@ -7,11 +7,12 @@ asyncio HTTP server exposes:
 
 - ``GET /healthz/live``  — liveness (event loop answers)
 - ``GET /healthz/ready`` — readiness (pattern cache gating, health.py)
-- ``GET /metrics``       — JSON snapshot of the per-stage latency registry
-  (detect→collect→parse→prefill→decode→store), the observability the
-  p50<2s SLO needs
+- ``GET /metrics``       — Prometheus text exposition of the per-stage
+  latency registry (detect→collect→parse→prefill→decode→store), scrapeable
+  by any standard collector — the observability the p50<2s SLO needs
+- ``GET /metrics.json``  — the same data as a JSON snapshot
 
-Responses are JSON; probe failures return 503 so the kubelet treats the
+Probe responses are JSON; failures return 503 so the kubelet treats the
 pod exactly as it treats the reference's native binary.
 """
 
@@ -83,13 +84,18 @@ class HealthServer:
                 return
             method, path = parts[0], parts[1].split("?")[0]
             status, body = await self._route(method, path)
-            payload = json.dumps(body).encode()
+            if isinstance(body, bytes):  # pre-rendered (Prometheus text)
+                payload = body
+                content_type = b"text/plain; version=0.0.4; charset=utf-8"
+            else:
+                payload = json.dumps(body).encode()
+                content_type = b"application/json"
             writer.write(
                 b"HTTP/1.1 %d %s\r\n"
-                b"Content-Type: application/json\r\n"
+                b"Content-Type: %s\r\n"
                 b"Content-Length: %d\r\n"
                 b"Connection: close\r\n\r\n"
-                % (status, b"OK" if status == 200 else b"ERR", len(payload))
+                % (status, b"OK" if status == 200 else b"ERR", content_type, len(payload))
             )
             if method != "HEAD":  # HEAD: headers only, no body
                 writer.write(payload)
@@ -103,7 +109,7 @@ class HealthServer:
             except (ConnectionError, OSError):
                 pass
 
-    async def _route(self, method: str, path: str) -> tuple[int, dict]:
+    async def _route(self, method: str, path: str) -> "tuple[int, dict | bytes]":
         if method not in ("GET", "HEAD"):
             return 405, {"error": "method not allowed"}
         if path in ("/healthz/live", "/livez"):
@@ -119,5 +125,7 @@ class HealthServer:
                 "reason": status.reason,
             }
         if path == "/metrics":
+            return 200, self.metrics.prometheus().encode()
+        if path == "/metrics.json":
             return 200, self.metrics.snapshot()
         return 404, {"error": f"no route {path}"}

@@ -114,6 +114,15 @@ class AnalysisPipeline:
         key = FailureDedupe.key(pod, failure_time)
         if not self.dedupe.try_claim(key):
             return []
+        # durable dedupe: the in-memory map dies with the process, but the
+        # analyzed-failure annotation is in etcd — a restarted operator (or
+        # the pre-watch sweep) must not re-analyze an annotated failure
+        from .storage import ANNOTATION_ANALYZED_FAILURE
+
+        if pod.metadata.annotations.get(ANNOTATION_ANALYZED_FAILURE) == failure_time:
+            self.dedupe.mark_done(key)
+            self.metrics.incr("dedupe_durable_hits")
+            return []
         try:
             results = []
             for podmortem in podmortems:

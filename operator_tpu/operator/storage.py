@@ -36,6 +36,13 @@ log = logging.getLogger(__name__)
 ANNOTATION_ANALYSIS = "podmortem.io/analysis"
 ANNOTATION_SEVERITY = "podmortem.io/severity"
 ANNOTATION_ANALYZED_AT = "podmortem.io/analyzed-at"
+#: which failure (finishedAt) the stored analysis covers — the DURABLE
+#: dedupe marker: an operator restart loses the in-memory FailureDedupe map,
+#: and this annotation is what stops the restarted watcher/reconciler from
+#: re-analyzing a failure already annotated in etcd (the reference accepts
+#: re-analysis after restart by design, AnalysisStorageService.java:42-46;
+#: we do one better)
+ANNOTATION_ANALYZED_FAILURE = "podmortem.io/analyzed-failure"
 ANNOTATION_MONITOR = "podmortem.io/monitor"
 
 #: keep pod annotations within etcd sanity; full text still goes to CR status
@@ -60,7 +67,15 @@ class AnalysisStorageService:
         """Store to both places; failures in one must not block the other
         (reference stores annotations first, then status :60-68)."""
         explanation = self._explanation_text(result, ai_response)
-        await self.store_to_pod_annotations(pod, result, explanation)
+        # the durable marker is only earned by a FINAL result: AI succeeded,
+        # or AI was never requested (pattern-only is the intended outcome).
+        # A degraded store (AI errored / provider refused) must stay
+        # re-analyzable — e.g. the checkpoint gets mounted and the operator
+        # restarts; stamping the marker then would suppress the retry forever
+        final = ai_response is None or bool(ai_response.explanation)
+        await self.store_to_pod_annotations(
+            pod, result, explanation, failure_time=failure_time if final else None
+        )
         await self.store_to_podmortem_status(
             podmortem, pod, result, ai_response, explanation, failure_time=failure_time
         )
@@ -73,13 +88,20 @@ class AnalysisStorageService:
 
     # ------------------------------------------------------------------
     async def store_to_pod_annotations(
-        self, pod: Pod, result: AnalysisResult, explanation: str
+        self,
+        pod: Pod,
+        result: AnalysisResult,
+        explanation: str,
+        *,
+        failure_time: Optional[str] = None,
     ) -> bool:
         annotations = {
             ANNOTATION_ANALYSIS: explanation[:MAX_ANNOTATION_CHARS],
             ANNOTATION_SEVERITY: (result.summary.highest_severity or "NONE"),
             ANNOTATION_ANALYZED_AT: now_iso(),
         }
+        if failure_time:
+            annotations[ANNOTATION_ANALYZED_FAILURE] = failure_time
 
         async def attempt() -> bool:
             latest = await self.api.get("Pod", pod.metadata.name, pod.metadata.namespace)

@@ -166,6 +166,36 @@ class NeuralEmbedder:
         self.dim = config.hidden_size
         self._encode = jax.jit(lambda ids, mask: encode(params, config, ids, mask))
 
+    @classmethod
+    def from_checkpoint(
+        cls,
+        checkpoint_dir: str,
+        *,
+        max_tokens: int = 256,
+        batch_size: int = 32,
+    ) -> "NeuralEmbedder":
+        """Build from a local sentence-transformers/BERT checkpoint dir
+        (safetensors weights + config.json + WordPiece tokenizer files).
+
+        Tokenisation includes the [CLS]/[SEP] specials — the
+        sentence-transformers mean-pooling convention counts them, and
+        matching it is what makes cosine scores comparable to the public
+        MiniLM embeddings.
+        """
+        from transformers import AutoTokenizer
+
+        from ..models.encoder import load_encoder_params
+
+        params, config = load_encoder_params(checkpoint_dir)
+        tok = AutoTokenizer.from_pretrained(checkpoint_dir, local_files_only=True)
+
+        def tokenize(text: str) -> list[int]:
+            return tok.encode(text, add_special_tokens=True)
+
+        return cls(
+            params, config, tokenize, max_tokens=max_tokens, batch_size=batch_size
+        )
+
     def embed(self, texts: Sequence[str]) -> np.ndarray:
         import numpy as np
 

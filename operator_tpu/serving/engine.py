@@ -181,7 +181,6 @@ class BatchedGenerator:
                 config.head_dim, max_slots, self.pages_per_seq, dtype=cache_dtype,
             )
             self.cache = None
-            self._host_offsets = np.zeros((max_slots,), np.int64)
             if mesh is not None:
                 s = self._shardings
                 self.paged_cache = jax.device_put(self.paged_cache, s["paged"])
@@ -214,6 +213,13 @@ class BatchedGenerator:
         self.last_tokens = jnp.zeros((max_slots, 1), jnp.int32)
         self.slots: list[_Slot] = [_Slot() for _ in range(max_slots)]
         self._rng = jax.random.PRNGKey(seed)
+        # host shadow of per-slot token counts (BOTH cache layouts): the
+        # decode loop must never fetch offsets from the device — at the 8B
+        # target the per-step host budget is ~10ms and a blocking read eats it
+        self._host_offsets = np.zeros((max_slots,), np.int64)
+        # per-slot sampling tensors change only at admit/finish; cache the
+        # device copies so steady-state decode transfers nothing but tokens
+        self._sampling_cache: Optional[tuple] = None
 
         self._prefill_fns: dict[tuple[int, int], Any] = {}
 
@@ -576,41 +582,53 @@ class BatchedGenerator:
             slot.prefill_ms = prefill_ms
             slot.pages = page_grants[row] if self.paged else []
             last[slot_id, 0] = int(first_np[row])
-            if self.paged:
-                self._host_offsets[slot_id] = int(lengths[row])
-            else:
+            self._host_offsets[slot_id] = int(lengths[row])
+            if not self.paged:
                 offsets[slot_id] = int(lengths[row])
         if not self.paged:
             self.offsets = jnp.asarray(offsets)
         self.last_tokens = jnp.asarray(last)
+        self._sampling_cache = None  # slot set changed
         return list(taken)
+
+    def _sampling_tensors(self):
+        """(active_np, temp_dev, top_p_dev, active_dev), rebuilt only when
+        the slot set changes (admit/finish) — not every decode step."""
+        if self._sampling_cache is None:
+            jnp = self._jnp
+            active = np.array([s.active for s in self.slots])
+            temp = np.array(
+                [s.params.temperature if s.active else 0.0 for s in self.slots],
+                np.float32,
+            )
+            top_p = np.array(
+                [s.params.top_p if s.active else 1.0 for s in self.slots], np.float32
+            )
+            if self.mesh is not None:
+                put = lambda a: self._jax.device_put(a, self._shardings["batch"])  # noqa: E731
+            else:
+                put = jnp.asarray
+            self._sampling_cache = (active, put(temp), put(top_p), put(active))
+        return self._sampling_cache
 
     def step(self) -> list[tuple[int, GenerationResult]]:
         """One batched decode step; returns finished (slot, result) pairs."""
-        jnp = self._jnp
         if self.num_active == 0:
             return []
         started = time.perf_counter()
-        active = np.array([s.active for s in self.slots])
-        temp = np.array(
-            [s.params.temperature if s.active else 0.0 for s in self.slots], np.float32
-        )
-        top_p = np.array(
-            [s.params.top_p if s.active else 1.0 for s in self.slots], np.float32
-        )
+        active, temp_dev, top_p_dev, active_dev = self._sampling_tensors()
         if self.paged:
             self.paged_cache, next_tokens, self._rng = self._decode_fn(
                 self.params, self.paged_cache, self.last_tokens, self._rng,
-                jnp.asarray(temp), jnp.asarray(top_p), jnp.asarray(active),
+                temp_dev, top_p_dev, active_dev,
             )
-            self._host_offsets[active] += 1
-            offsets_np = self._host_offsets  # host shadow: no device fetch
         else:
             self.cache, next_tokens, self.offsets, self._rng = self._decode_fn(
                 self.params, self.cache, self.last_tokens, self.offsets, self._rng,
-                jnp.asarray(temp), jnp.asarray(top_p), jnp.asarray(active),
+                temp_dev, top_p_dev, active_dev,
             )
-            offsets_np = np.asarray(self.offsets)  # one device fetch per step
+        self._host_offsets[active] += 1
+        offsets_np = self._host_offsets  # host shadow: no device fetch
         next_np = np.asarray(next_tokens)
         self.last_tokens = next_tokens[:, None]
         self.metrics.record("decode_step", (time.perf_counter() - started) * 1e3)
@@ -656,7 +674,8 @@ class BatchedGenerator:
                 lengths=paged.lengths.at[slot_id].set(0),
             )
             self.allocator.release(slot.pages)
-            self._host_offsets[slot_id] = 0
+        self._host_offsets[slot_id] = 0
+        self._sampling_cache = None  # slot set changed
         eos = self.tokenizer.eos_id
         ids = [t for t in slot.generated if t != eos]
         text = self.tokenizer.decode(ids)
@@ -673,6 +692,14 @@ class BatchedGenerator:
         )
         self.slots[slot_id] = _Slot()
         return result
+
+    # profiling ---------------------------------------------------------
+    def trace(self, log_dir: str):
+        """``jax.profiler.trace`` context around a serving span: writes an
+        xplane protobuf under ``log_dir`` for tensorboard/xprof (SURVEY.md
+        §5 tracing — the reference has none; the TPU side needs it to
+        attribute the p50 budget between prefill, decode and host work)."""
+        return self._jax.profiler.trace(log_dir)
 
     # convenience for tests / bench -------------------------------------
     def generate(self, prompt: str, params: Optional[SamplingParams] = None) -> GenerationResult:
@@ -700,8 +727,16 @@ class ServingEngine:
         admission_wait_s: float = 0.004,
         max_queue: int = 1024,
     ) -> None:
+        import concurrent.futures
+
         self.generator = generator
         self.admission_wait_s = admission_wait_s
+        # one persistent worker: no per-step thread handoff through the
+        # shared default executor (contextvars copy + pool contention), and
+        # all jax dispatch happens from a single consistent thread
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tpu-decode"
+        )
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
         self._pending: dict[int, asyncio.Future] = {}  # slot id -> future
         self._inflight: list = []  # popped from queue, not yet in _pending
@@ -738,6 +773,7 @@ class ServingEngine:
                 pass
             self._task = None
         self._fail_outstanding(asyncio.CancelledError("serving engine closed"))
+        self._executor.shutdown(wait=False)
 
     def _fail_outstanding(self, exc: BaseException) -> None:
         """Resolve every in-flight and queued future so callers never hang."""
@@ -784,22 +820,32 @@ class ServingEngine:
             self._fail_outstanding(exc)
 
     async def _serve(self) -> None:
+        loop = asyncio.get_running_loop()
         while not self._closed:
             # requests live in self._inflight between queue pop and slot
             # admission so cancellation/crash cleanup can always see them
             batch = self._inflight
+            leftover = bool(batch)  # backpressured from an earlier round
             if not batch and self.generator.num_active == 0 and self._queue.empty():
                 # fully idle: block until a request arrives (never while
                 # backpressured requests are already waiting in hand)
                 batch.append(await self._queue.get())
             total_free = len(self.generator.free_slots())
-            if len(batch) < total_free and (batch or not self._queue.empty()):
+            stalled = self._page_stalled(batch)
+            if (
+                len(batch) < total_free
+                and not stalled
+                and (not self._queue.empty() or (batch and not leftover))
+            ):
                 # tiny window lets concurrent arrivals share one prefill
-                # (32 events -> one prefill, BASELINE config 4)
+                # (32 events -> one prefill, BASELINE config 4).  Skipped
+                # when the batch is page-stalled leftovers with no fresh
+                # arrivals: sleeping then would throttle decode for every
+                # active sequence exactly when the engine is most loaded
                 await asyncio.sleep(self.admission_wait_s)
                 while len(batch) < total_free and not self._queue.empty():
                     batch.append(self._queue.get_nowait())
-            if batch and not self._page_stalled(batch):
+            if batch and not stalled:
                 admitted = await self._admit(batch)
                 # paged backpressure: requests beyond the KV free list stay
                 # in _inflight and retry as decode frees pages
@@ -816,7 +862,9 @@ class ServingEngine:
                 )
 
             if self.generator.num_active:
-                finished = await asyncio.to_thread(self.generator.step)
+                finished = await loop.run_in_executor(
+                    self._executor, self.generator.step
+                )
                 for slot_id, result in finished:
                     future = self._pending.pop(slot_id, None)
                     if future is not None and not future.done():
@@ -828,7 +876,9 @@ class ServingEngine:
         prompts = [prompt for prompt, _, _ in batch]
         params = [p for _, p, _ in batch]
         try:
-            slot_ids = await asyncio.to_thread(self.generator.admit, prompts, params)
+            slot_ids = await asyncio.get_running_loop().run_in_executor(
+                self._executor, lambda: self.generator.admit(prompts, params)
+            )
         except OversizedRequest as exc:
             # only the head request is impossible; fail it alone and let
             # the rest retry next round

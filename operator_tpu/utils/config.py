@@ -51,6 +51,9 @@ class OperatorConfig:
     # --- serving ----------------------------------------------------------
     model_id: str = "tinyllama-1.1b"
     checkpoint_dir: Optional[str] = None
+    # MiniLM-class sentence encoder for semantic pattern matching (the
+    # subsumed log-parser's neural scorer); unset = lexical HashingEmbedder
+    encoder_checkpoint_dir: Optional[str] = None
     max_batch_size: int = 32  # BASELINE config 4: 32 events -> one prefill
     # paged KV cache (ops/paged_attention.py): allocate HBM by actual
     # sequence need instead of max_seq per slot — the batch-32-at-8B-scale

@@ -111,6 +111,32 @@ class MetricsRegistry:
                 "counters": dict(self._counters),
             }
 
+    def prometheus(self) -> str:
+        """Prometheus text exposition (version 0.0.4) of the same data, so
+        any standard scraper can consume the operator's metrics; stage
+        latencies render as summaries with p50/p99 quantiles."""
+
+        def sane(name: str) -> str:
+            return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+        lines: list[str] = []
+        with self._lock:
+            if self._stages:
+                metric = "podmortem_stage_duration_milliseconds"
+                lines.append(f"# HELP {metric} Per-stage latency (detect->store pipeline).")
+                lines.append(f"# TYPE {metric} summary")
+                for name, s in sorted(self._stages.items()):
+                    stage = sane(name)
+                    lines.append(f'{metric}{{stage="{stage}",quantile="0.5"}} {s.p50_ms:.3f}')
+                    lines.append(f'{metric}{{stage="{stage}",quantile="0.99"}} {s.p99_ms:.3f}')
+                    lines.append(f'{metric}_sum{{stage="{stage}"}} {s.total_ms:.3f}')
+                    lines.append(f'{metric}_count{{stage="{stage}"}} {s.count}')
+            for name, value in sorted(self._counters.items()):
+                metric = f"podmortem_{sane(name)}_total"
+                lines.append(f"# TYPE {metric} counter")
+                lines.append(f"{metric} {value}")
+        return "\n".join(lines) + "\n"
+
 
 #: process-wide default registry (dependency-inject a fresh one in tests)
 METRICS = MetricsRegistry()

@@ -90,7 +90,7 @@ def test_ready_down_is_503(server_factory):
 def test_metrics_snapshot(server_factory):
     async def main():
         server = await server_factory()
-        status, body = await _get(server.bound_port, "/metrics")
+        status, body = await _get(server.bound_port, "/metrics.json")
         await server.stop()
         return status, body
 
@@ -98,6 +98,40 @@ def test_metrics_snapshot(server_factory):
     assert status == 200
     assert body["stages"]["parse"]["count"] == 1
     assert body["counters"]["failures_detected"] == 1
+
+
+async def _get_raw(port, path):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    await writer.wait_closed()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    return int(head.split()[1]), head.decode("latin-1"), body.decode()
+
+
+def test_metrics_prometheus_exposition(server_factory):
+    """/metrics must be scrapeable by a standard Prometheus collector:
+    text exposition content type, summary quantiles, counter totals."""
+
+    async def main():
+        server = await server_factory()
+        status, head, text = await _get_raw(server.bound_port, "/metrics")
+        await server.stop()
+        return status, head, text
+
+    status, head, text = asyncio.run(main())
+    assert status == 200
+    assert "text/plain; version=0.0.4" in head
+    assert "# TYPE podmortem_stage_duration_milliseconds summary" in text
+    assert 'podmortem_stage_duration_milliseconds{stage="parse",quantile="0.5"} 12.500' in text
+    assert 'podmortem_stage_duration_milliseconds_count{stage="parse"} 1' in text
+    assert "# TYPE podmortem_failures_detected_total counter" in text
+    assert "podmortem_failures_detected_total 1" in text
+    # every line parses as comment or `name{labels} value`
+    for line in text.strip().splitlines():
+        assert line.startswith("#") or len(line.rsplit(" ", 1)) == 2
 
 
 def test_unknown_route_404_and_post_405(server_factory):

@@ -131,6 +131,24 @@ class TestBatchedGenerator:
         result = generator.generate("x", SamplingParams(max_tokens=3, temperature=0.0))
         assert result.completion_tokens <= 3
 
+    def test_profiler_trace_produces_xplane(self, generator, tmp_path):
+        """generator.trace() must leave an xplane protobuf for xprof."""
+        import os
+
+        _reset(generator)
+        with generator.trace(str(tmp_path)):
+            generator.generate(
+                "trace me", SamplingParams(max_tokens=2, temperature=0.0)
+            )
+        found = [
+            os.path.join(root, f)
+            for root, _, files in os.walk(tmp_path)
+            for f in files
+            if f.endswith(".xplane.pb")
+        ]
+        assert found, f"no xplane trace under {tmp_path}"
+        assert os.path.getsize(found[0]) > 0
+
     def test_prompt_truncated_to_fit(self, generator):
         _reset(generator)
         long_prompt = "log line\n" * 500  # way beyond max_seq=128

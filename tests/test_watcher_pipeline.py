@@ -212,6 +212,57 @@ def test_watcher_sweep_catches_failure_during_blind_window():
     run(body())
 
 
+def test_restart_does_not_reanalyze_annotated_failure():
+    """The analyzed-failure annotation survives an operator restart and
+    must suppress re-analysis of the same failure (the in-memory dedupe
+    map does not survive; the reference re-analyzes by design — we don't)."""
+
+    async def body():
+        api, pipeline, watcher, _ = await make_stack()
+        pm = Podmortem(metadata=ObjectMeta(name="pm", namespace="ns"),
+                       spec=PodmortemSpec(pod_selector=LabelSelector(match_labels={"app": "web"})))
+        await api.create("Podmortem", pm.to_dict())
+        pod = failed_pod()
+        await api.create("Pod", pod.to_dict())
+        api.set_pod_log("prod", "web-1", "java.lang.OutOfMemoryError: Java heap space")
+        results = await pipeline.process_failure_group(
+            pod, [Podmortem.parse(await api.get("Podmortem", "pm", "ns"))],
+            failure_time="2026-07-28T09:00:00Z",
+        )
+        assert results and results[0] is not None
+        stored = await api.get("Pod", "web-1", "prod")
+        assert stored["metadata"]["annotations"]["podmortem.io/analyzed-failure"] == (
+            "2026-07-28T09:00:00Z"
+        )
+
+        # "restart": fresh pipeline, fresh dedupe map, same cluster state
+        from operator_tpu.schema import Pod as PodSchema
+
+        api2_pipeline = (await make_stack())[1]
+        api2_pipeline.api = api  # same cluster
+        api2_pipeline.storage.api = api
+        api2_pipeline.events.api = api
+        again = await api2_pipeline.process_failure_group(
+            PodSchema.parse(stored),
+            [Podmortem.parse(await api.get("Podmortem", "pm", "ns"))],
+            failure_time="2026-07-28T09:00:00Z",
+        )
+        assert again == [], "restart re-analyzed an annotated failure"
+        assert api2_pipeline.metrics.counter("dedupe_durable_hits") == 1
+        status = (await api.get("Podmortem", "pm", "ns"))["status"]
+        assert len(status["recentFailures"]) == 1
+
+        # a NEW failure on the same pod still analyzes
+        newer = await api2_pipeline.process_failure_group(
+            PodSchema.parse(stored),
+            [Podmortem.parse(await api.get("Podmortem", "pm", "ns"))],
+            failure_time="2026-07-28T10:30:00Z",
+        )
+        assert newer and newer[0] is not None
+
+    run(body())
+
+
 def test_cold_cr_cache_does_not_suppress_failure():
     """Observing a failed pod before any Podmortem CR matches must NOT mark
     it seen — once a CR appears, a later observation must still analyze."""
@@ -316,6 +367,9 @@ def test_weightless_tpu_native_never_stores_noise():
         # the pod annotation carries the pattern summary, no generated text
         stored = (await api.get("Pod", "web-1", "prod"))["metadata"]["annotations"]
         assert "OutOfMemory" in stored.get("podmortem.io/analysis", "")
+        # degraded result: NO durable marker, so mounting a checkpoint and
+        # restarting can still get this failure a real explanation
+        assert "podmortem.io/analyzed-failure" not in stored
         assert metrics.counter("provider_errors") == 1
         events = await api.list("Event")
         assert any(
