@@ -159,12 +159,21 @@ def main() -> None:
     # programs, which is pathologically slow over a tunneled TPU backend
     init = jax.jit(lambda key: init_params(config, key, dtype=jnp.bfloat16))
     params = jax.block_until_ready(init(jax.random.PRNGKey(0)))
-    log(f"params initialised in {time.perf_counter() - t0:.1f}s")
+    quant = os.environ.get("BENCH_QUANT", "0") == "1"
+    if quant:
+        from operator_tpu.models.quant import quantize_params
+
+        params = jax.block_until_ready(
+            jax.jit(lambda p: quantize_params(p, config))(params)
+        )
+    log(f"params initialised in {time.perf_counter() - t0:.1f}s (int8={quant})")
 
     paged = os.environ.get("BENCH_PAGED", "1") == "1"
+    decode_block = int(os.environ.get("BENCH_DECODE_BLOCK", "8"))
     generator = BatchedGenerator(
         params, config, load_tokenizer(None), max_slots=slots, max_seq=max_seq,
         paged=paged, page_size=int(os.environ.get("BENCH_PAGE_SIZE", "64")),
+        decode_block=decode_block,
     )
     prompts = [build_prompt(r) for r in build_requests(n_requests)]
     sampling = SamplingParams(max_tokens=max_tokens, temperature=0.3, stop_on_eos=False)
@@ -214,6 +223,15 @@ def main() -> None:
     per_min = n_requests / wall * 60.0
     tokens_s = n_requests * max_tokens / wall
 
+    # decode MFU: ~2 FLOPs per weight per generated token (matmul-dominated,
+    # attention FLOPs negligible at these sequence lengths) against the
+    # chip's peak bf16 throughput (v5e: 197 TFLOP/s; override for other gens)
+    from operator_tpu.models.llama import param_count
+
+    n_params = param_count(params)
+    peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
+    mfu = tokens_s * 2.0 * n_params / (peak_tflops * 1e12)
+
     log(f"wall={wall:.2f}s  p50={p50:.2f}s  p99={p99:.2f}s  "
         f"decode~{tokens_s:.0f} tok/s  throughput={per_min:.1f} expl/min")
     degraded = platform == "cpu-fallback"
@@ -226,9 +244,16 @@ def main() -> None:
         "p50_latency_s": round(p50, 3),
         "p99_latency_s": round(p99, 3),
         "decode_tokens_per_s": round(tokens_s, 1),
+        # end-to-end MFU incl. host/queueing time — a decode-only step MFU
+        # would be higher; this is the honest number for the whole pipeline
+        "decode_mfu": round(mfu, 4),
+        "params_b": round(n_params / 1e9, 3),
+        "peak_tflops_assumed": peak_tflops,
         "model": model_name,
         "requests": n_requests,
         "max_tokens": max_tokens,
+        "decode_block": decode_block,
+        "weight_dtype": "int8" if quant else "bf16",
         "platform": platform,
         "degraded": degraded,
     }))

@@ -13,6 +13,18 @@ from typing import Optional
 
 
 @dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3.1-style ("llama3") NTK-by-parts RoPE scaling: low-frequency
+    bands are slowed by ``factor``, high-frequency bands kept, and the bands
+    between interpolated — how 3.1/3.2 stretch an 8k-trained RoPE to 128k."""
+
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_positions: int = 8192
+
+
+@dataclass(frozen=True)
 class ModelConfig:
     name: str
     vocab_size: int
@@ -27,6 +39,7 @@ class ModelConfig:
     max_seq_len: int = 2048
     sliding_window: Optional[int] = None  # Mistral-style local attention
     tie_embeddings: bool = False
+    rope_scaling: Optional[RopeScaling] = None  # Llama-3.1+ long context
 
     @property
     def q_per_kv(self) -> int:
@@ -62,6 +75,52 @@ LLAMA_3_8B = ModelConfig(
     max_seq_len=8192,
 )
 
+LLAMA_3_1_8B = ModelConfig(
+    name="llama-3.1-8b",
+    vocab_size=128256,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=128,
+    rope_theta=500_000.0,
+    max_seq_len=16384,  # serving cap; the model supports 128k
+    rope_scaling=RopeScaling(factor=8.0),
+)
+
+# small modern targets: a 1B that outclasses TinyLlama at the same latency
+# budget, and a 3B midpoint — both tie embeddings and use llama3 scaling
+LLAMA_3_2_1B = ModelConfig(
+    name="llama-3.2-1b",
+    vocab_size=128256,
+    hidden_size=2048,
+    intermediate_size=8192,
+    num_layers=16,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=64,
+    rope_theta=500_000.0,
+    max_seq_len=16384,
+    tie_embeddings=True,
+    rope_scaling=RopeScaling(factor=32.0),
+)
+
+LLAMA_3_2_3B = ModelConfig(
+    name="llama-3.2-3b",
+    vocab_size=128256,
+    hidden_size=3072,
+    intermediate_size=8192,
+    num_layers=28,
+    num_heads=24,
+    num_kv_heads=8,
+    head_dim=128,
+    rope_theta=500_000.0,
+    max_seq_len=16384,
+    tie_embeddings=True,
+    rope_scaling=RopeScaling(factor=32.0),
+)
+
 MISTRAL_7B = ModelConfig(
     name="mistral-7b",
     vocab_size=32000,
@@ -92,7 +151,16 @@ TINY_TEST = ModelConfig(
 )
 
 _REGISTRY = {
-    cfg.name: cfg for cfg in (TINYLLAMA_1_1B, LLAMA_3_8B, MISTRAL_7B, TINY_TEST)
+    cfg.name: cfg
+    for cfg in (
+        TINYLLAMA_1_1B,
+        LLAMA_3_8B,
+        LLAMA_3_1_8B,
+        LLAMA_3_2_1B,
+        LLAMA_3_2_3B,
+        MISTRAL_7B,
+        TINY_TEST,
+    )
 }
 
 

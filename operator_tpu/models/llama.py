@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from .configs import ModelConfig
+from .quant import mm
 
 Params = dict[str, Any]
 
@@ -93,10 +94,28 @@ def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
 
 
 def rope_frequencies(config: ModelConfig) -> jax.Array:
-    """Inverse frequencies [head_dim // 2] (HF half-rotation convention)."""
+    """Inverse frequencies [head_dim // 2] (HF half-rotation convention),
+    with Llama-3.1-style NTK-by-parts scaling when configured: wavelengths
+    beyond the original training context are slowed by ``factor``, short
+    wavelengths kept, the band between linearly interpolated (matches HF
+    ``rope_type: llama3``)."""
     d = config.head_dim
     exponents = jnp.arange(0, d, 2, dtype=jnp.float32) / d
-    return 1.0 / (config.rope_theta**exponents)
+    inv_freq = 1.0 / (config.rope_theta**exponents)
+    scaling = config.rope_scaling
+    if scaling is None:
+        return inv_freq
+    wavelen = 2.0 * jnp.pi / inv_freq
+    low_freq_wavelen = scaling.original_max_positions / scaling.low_freq_factor
+    high_freq_wavelen = scaling.original_max_positions / scaling.high_freq_factor
+    scaled = inv_freq / scaling.factor
+    smooth = (scaling.original_max_positions / wavelen - scaling.low_freq_factor) / (
+        scaling.high_freq_factor - scaling.low_freq_factor
+    )
+    smoothed = (1.0 - smooth) * scaled + smooth * inv_freq
+    out = jnp.where(wavelen > low_freq_wavelen, scaled, inv_freq)
+    mid = (wavelen <= low_freq_wavelen) & (wavelen >= high_freq_wavelen)
+    return jnp.where(mid, smoothed, out)
 
 
 def apply_rope(x: jax.Array, positions: jax.Array, inv_freq: jax.Array) -> jax.Array:
@@ -249,9 +268,9 @@ def forward(
         weights, layer_cache = scanned["w"], scanned.get("cache")
         # -- attention ---------------------------------------------------
         attn_in = rms_norm(x, weights["ln_attn"], config.rms_norm_eps)
-        q = (attn_in @ weights["wq"]).reshape(b, t, config.num_heads, config.head_dim)
-        k = (attn_in @ weights["wk"]).reshape(b, t, config.num_kv_heads, config.head_dim)
-        v = (attn_in @ weights["wv"]).reshape(b, t, config.num_kv_heads, config.head_dim)
+        q = mm(attn_in, weights["wq"]).reshape(b, t, config.num_heads, config.head_dim)
+        k = mm(attn_in, weights["wk"]).reshape(b, t, config.num_kv_heads, config.head_dim)
+        v = mm(attn_in, weights["wv"]).reshape(b, t, config.num_kv_heads, config.head_dim)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         if layer_cache is not None:
@@ -268,12 +287,12 @@ def forward(
             k_all, v_all = k, v
             new_cache = None
         attn = _attention(q, k_all.astype(q.dtype), v_all.astype(q.dtype), attn_mask, config)
-        x = x + attn @ weights["wo"]
+        x = x + mm(attn, weights["wo"])
         # -- mlp ----------------------------------------------------------
         mlp_in = rms_norm(x, weights["ln_mlp"], config.rms_norm_eps)
-        gate = jax.nn.silu(mlp_in @ weights["w_gate"])
-        up = mlp_in @ weights["w_up"]
-        x = x + (gate * up) @ weights["w_down"]
+        gate = jax.nn.silu(mm(mlp_in, weights["w_gate"]))
+        up = mm(mlp_in, weights["w_up"])
+        x = x + mm(gate * up, weights["w_down"])
         return x, new_cache
 
     if use_cache:
@@ -335,9 +354,9 @@ def decode_step_paged(
         x = carry
         weights = scanned["w"]
         attn_in = rms_norm(x, weights["ln_attn"], config.rms_norm_eps)
-        q = (attn_in @ weights["wq"]).reshape(b, 1, config.num_heads, config.head_dim)
-        k = (attn_in @ weights["wk"]).reshape(b, 1, config.num_kv_heads, config.head_dim)
-        v = (attn_in @ weights["wv"]).reshape(b, 1, config.num_kv_heads, config.head_dim)
+        q = mm(attn_in, weights["wq"]).reshape(b, 1, config.num_heads, config.head_dim)
+        k = mm(attn_in, weights["wk"]).reshape(b, 1, config.num_kv_heads, config.head_dim)
+        v = mm(attn_in, weights["wv"]).reshape(b, 1, config.num_kv_heads, config.head_dim)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         k_pages = write_tokens(scanned["k"], paged.page_table, k, paged.lengths)
@@ -347,11 +366,11 @@ def decode_step_paged(
             paged.page_table, new_lengths,
             sliding_window=config.sliding_window,
         )  # [B, QH, D]
-        x = x + attn.astype(x.dtype).reshape(b, 1, -1) @ weights["wo"]
+        x = x + mm(attn.astype(x.dtype).reshape(b, 1, -1), weights["wo"])
         mlp_in = rms_norm(x, weights["ln_mlp"], config.rms_norm_eps)
-        gate = jax.nn.silu(mlp_in @ weights["w_gate"])
-        up = mlp_in @ weights["w_up"]
-        x = x + (gate * up) @ weights["w_down"]
+        gate = jax.nn.silu(mm(mlp_in, weights["w_gate"]))
+        up = mm(mlp_in, weights["w_up"])
+        x = x + mm(gate * up, weights["w_down"])
         return x, {"k": k_pages, "v": v_pages}
 
     scanned_in = {"w": params["layers"], "k": paged.k_pages, "v": paged.v_pages}

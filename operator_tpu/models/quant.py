@@ -1,0 +1,81 @@
+"""Int8 weight-only quantization for serving.
+
+Decode at batch sizes this system runs (8-32 slots) is HBM-bandwidth bound:
+every step streams the full weight set through the MXU once, so halving
+weight bytes is close to halving step time — and it is what fits
+Mistral-7B-per-chip DP on a 16 GB v5e (BASELINE config 5) with KV headroom.
+
+Scheme: symmetric per-output-channel absmax.  For a stored ``[in, out]``
+matrix ``W``::
+
+    s   = absmax(W, axis=in) / 127          # [out]
+    q   = round(W / s)  as int8             # [in, out]
+    x @ W  ≈  (x @ q) * s                   # scale folds in AFTER the matmul
+
+Per-output-channel scales commute with the contraction, so the dequant is
+one fused multiply on the [B, T, out] activation — XLA fuses it into the
+matmul epilogue; the int8->bf16 cast happens in-register.  The seven layer
+matrices (wq/wk/wv/wo/w_gate/w_up/w_down — the overwhelming parameter mass)
+are quantized; embeddings, lm_head and norms stay in the float dtype
+(embedding quality is vocab-critical and the tied-embedding transpose would
+need per-row scales on the head side).
+
+TP sharding composes cleanly: scales are per-output-channel, so they shard
+exactly like the matrix's output axis (parallel/mesh.py mirrors the
+{q, s} tree).
+
+The reference has no quantization (or any ML) — this is pure tpu-native
+performance work against the north-star throughput target (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .configs import ModelConfig
+
+Params = dict[str, Any]
+
+#: layer matrices that get quantized (stored [n_layers, in, out])
+QUANTIZED_LAYER_MATRICES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def is_quantized(params: Params) -> bool:
+    wq = params.get("layers", {}).get("wq")
+    return isinstance(wq, dict) and "q" in wq
+
+
+def quantize_matrix(w: jax.Array) -> dict[str, jax.Array]:
+    """[..., in, out] float -> {q: int8 [..., in, out], s: [..., out]}."""
+    w32 = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(w32), axis=-2)  # [..., out]
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w32 / scale[..., None, :]), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": scale}
+
+
+def quantize_params(params: Params, config: ModelConfig) -> Params:
+    """Quantize the layer matrices of a loaded/initialised param tree."""
+    layers = dict(params["layers"])
+    for name in QUANTIZED_LAYER_MATRICES:
+        layers[name] = quantize_matrix(layers[name])
+    return {**params, "layers": layers}
+
+
+def mm(x: jax.Array, w: "jax.Array | dict[str, jax.Array]") -> jax.Array:
+    """``x @ W`` for plain or quantized weights.
+
+    The int8 matrix is cast to the activation dtype going INTO the matmul
+    (the MXU has no int8xbf16 path; the cast is free relative to the HBM
+    read we saved) and the per-channel scale folds into the epilogue.
+    """
+    if isinstance(w, dict):
+        return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
+    return x @ w
+
+
+def quantized_bytes(params: Params) -> int:
+    return sum(int(p.size * p.dtype.itemsize) for p in jax.tree_util.tree_leaves(params))

@@ -136,16 +136,22 @@ def initialize_distributed(**kwargs: Any) -> None:
 # --------------------------------------------------------------------------
 
 
-def param_specs(config: ModelConfig, *, shard_fsdp: bool = True) -> Params:
+def param_specs(
+    config: ModelConfig, *, shard_fsdp: bool = True, quantized: bool = False
+) -> Params:
     """PartitionSpecs mirroring the param pytree of ``llama.init_params``.
 
     Megatron-style TP: column-parallel in-projections (heads / MLP columns
     on ``tp``), row-parallel out-projections (XLA auto-inserts the psum on
     the residual add).  fsdp shards the *other* matrix axis so tp x fsdp
     tiles every large matrix fully.
+
+    ``quantized`` mirrors the int8 tree (models/quant.py): each layer matrix
+    becomes ``{q: <matrix spec>, s: <out-axis spec>}`` — per-output-channel
+    scales shard exactly like the matrix's output axis.
     """
     f = "fsdp" if shard_fsdp else None
-    layer_specs = {
+    layer_specs: dict[str, Any] = {
         "wq": P(None, f, "tp"),
         "wk": P(None, f, "tp"),
         "wv": P(None, f, "tp"),
@@ -156,6 +162,12 @@ def param_specs(config: ModelConfig, *, shard_fsdp: bool = True) -> Params:
         "ln_attn": P(None, None),
         "ln_mlp": P(None, None),
     }
+    if quantized:
+        from ..models.quant import QUANTIZED_LAYER_MATRICES
+
+        for name in QUANTIZED_LAYER_MATRICES:
+            spec = layer_specs[name]
+            layer_specs[name] = {"q": spec, "s": P(None, spec[2])}  # out axis
     specs: dict[str, Any] = {
         "embed": P(f, None),   # vocab-sharded over fsdp, hidden replicated
         "layers": layer_specs,

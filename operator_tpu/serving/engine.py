@@ -139,6 +139,7 @@ class BatchedGenerator:
         page_size: int = 64,
         kv_pages: Optional[int] = None,
         mesh: Any = None,
+        decode_block: int = 1,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -151,13 +152,24 @@ class BatchedGenerator:
         self.max_seq = min(max_seq or config.max_seq_len, config.max_seq_len)
         self.metrics = metrics or METRICS
         cache_dtype = cache_dtype or jnp.bfloat16
+        # decode in blocks of K steps per host round-trip (lax.scan): one
+        # dispatch + one token fetch per K tokens hides host latency for
+        # K-1 of every K steps.  Finished slots may decode up to K-1 junk
+        # tokens into their OWN cache rows/pages before the host notices —
+        # harmless by the same argument that lets inactive slots keep
+        # decoding garbage.  Trade-off: admissions join at block boundaries
+        # (adds up to K-1 steps of queueing to p50, microseconds-to-ms).
+        assert decode_block >= 1
+        self.decode_block = decode_block
 
         # ---- sharded serving (BASELINE configs 3/5): params TP on heads /
         # MLP columns, slots DP over the batch axis; one jitted program per
         # mesh — XLA inserts the tp psums and dp scatter collectives
         self.mesh = mesh
         if mesh is not None:
-            self._init_shardings(mesh)
+            from ..models.quant import is_quantized
+
+            self._init_shardings(mesh, quantized=is_quantized(params))
             params = self._jax.tree_util.tree_map(
                 jax.device_put, params, self._param_shardings
             )
@@ -184,31 +196,39 @@ class BatchedGenerator:
             if mesh is not None:
                 s = self._shardings
                 self.paged_cache = jax.device_put(self.paged_cache, s["paged"])
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                block_tokens = NamedSharding(mesh, P(None, ("dp", "fsdp")))
                 self._decode_fn = jax.jit(
-                    self._decode_step_paged,
+                    self._decode_block_paged,
                     in_shardings=(
                         self._param_shardings, s["paged"], s["tokens"],
                         s["repl"], s["batch"], s["batch"], s["batch"],
                     ),
-                    out_shardings=(s["paged"], s["batch"], s["repl"]),
+                    out_shardings=(s["paged"], block_tokens, s["tokens"], s["repl"]),
                 )
             else:
-                self._decode_fn = jax.jit(self._decode_step_paged)
+                self._decode_fn = jax.jit(self._decode_block_paged)
         else:
             self.cache = KVCache.create(config, max_slots, self.max_seq, dtype=cache_dtype)
             if mesh is not None:
                 s = self._shardings
                 self.cache = jax.device_put(self.cache, s["cache"])
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                block_tokens = NamedSharding(mesh, P(None, ("dp", "fsdp")))
                 self._decode_fn = jax.jit(
-                    self._decode_step,
+                    self._decode_block,
                     in_shardings=(
                         self._param_shardings, s["cache"], s["tokens"],
                         s["batch"], s["repl"], s["batch"], s["batch"], s["batch"],
                     ),
-                    out_shardings=(s["cache"], s["batch"], s["batch"], s["repl"]),
+                    out_shardings=(
+                        s["cache"], block_tokens, s["tokens"], s["batch"], s["repl"]
+                    ),
                 )
             else:
-                self._decode_fn = jax.jit(self._decode_step)
+                self._decode_fn = jax.jit(self._decode_block)
         self.offsets = jnp.zeros((max_slots,), jnp.int32)  # tokens held per slot
         self.last_tokens = jnp.zeros((max_slots, 1), jnp.int32)
         self.slots: list[_Slot] = [_Slot() for _ in range(max_slots)]
@@ -223,7 +243,7 @@ class BatchedGenerator:
 
         self._prefill_fns: dict[tuple[int, int], Any] = {}
 
-    def _init_shardings(self, mesh: Any) -> None:
+    def _init_shardings(self, mesh: Any, *, quantized: bool = False) -> None:
         """Validate the mesh against the model and build the sharding table."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -248,7 +268,7 @@ class BatchedGenerator:
         def ns(spec):
             return NamedSharding(mesh, spec)
 
-        self._param_shardings = param_shardings(mesh, self.config)
+        self._param_shardings = param_shardings(mesh, self.config, quantized=quantized)
         self._shardings = {
             "repl": ns(P()),
             "batch": ns(P(("dp", "fsdp"))),          # [B] per-slot vectors
@@ -291,6 +311,38 @@ class BatchedGenerator:
             page_table=new_paged.page_table, lengths=lengths,
         )
         return new_paged, next_tokens, rng
+
+    def _decode_block(self, params, cache, tokens, offsets, rng, temp, top_p, active):
+        """K chained decode steps in one program (lax.scan); returns the
+        [K, B] token matrix plus final carry state."""
+        jax = self._jax
+
+        def body(carry, _):
+            cache, tokens, offsets, rng = carry
+            cache, next_tokens, offsets, rng = self._decode_step(
+                params, cache, tokens, offsets, rng, temp, top_p, active
+            )
+            return (cache, next_tokens[:, None], offsets, rng), next_tokens
+
+        (cache, last, offsets, rng), toks = jax.lax.scan(
+            body, (cache, tokens, offsets, rng), None, length=self.decode_block
+        )
+        return cache, toks, last, offsets, rng
+
+    def _decode_block_paged(self, params, paged, tokens, rng, temp, top_p, active):
+        jax = self._jax
+
+        def body(carry, _):
+            paged, tokens, rng = carry
+            paged, next_tokens, rng = self._decode_step_paged(
+                params, paged, tokens, rng, temp, top_p, active
+            )
+            return (paged, next_tokens[:, None], rng), next_tokens
+
+        (paged, last, rng), toks = jax.lax.scan(
+            body, (paged, tokens, rng), None, length=self.decode_block
+        )
+        return paged, toks, last, rng
 
     def _sample(self, logits, rng, temp, top_p):
         """Temperature + nucleus sampling; temp<=0 means greedy.  [B, V]."""
@@ -612,50 +664,60 @@ class BatchedGenerator:
         return self._sampling_cache
 
     def step(self) -> list[tuple[int, GenerationResult]]:
-        """One batched decode step; returns finished (slot, result) pairs."""
+        """One decode block (K chained steps, K=decode_block); returns
+        finished (slot, result) pairs."""
         if self.num_active == 0:
             return []
         started = time.perf_counter()
+        block = self.decode_block
         active, temp_dev, top_p_dev, active_dev = self._sampling_tensors()
         if self.paged:
-            self.paged_cache, next_tokens, self._rng = self._decode_fn(
+            self.paged_cache, toks, last, self._rng = self._decode_fn(
                 self.params, self.paged_cache, self.last_tokens, self._rng,
                 temp_dev, top_p_dev, active_dev,
             )
         else:
-            self.cache, next_tokens, self.offsets, self._rng = self._decode_fn(
+            self.cache, toks, last, self.offsets, self._rng = self._decode_fn(
                 self.params, self.cache, self.last_tokens, self.offsets, self._rng,
                 temp_dev, top_p_dev, active_dev,
             )
-        self._host_offsets[active] += 1
-        offsets_np = self._host_offsets  # host shadow: no device fetch
-        next_np = np.asarray(next_tokens)
-        self.last_tokens = next_tokens[:, None]
-        self.metrics.record("decode_step", (time.perf_counter() - started) * 1e3)
+        self._host_offsets[active] += block
+        toks_np = np.asarray(toks)  # [K, B] — the ONE host sync per block
+        self.last_tokens = last
+        elapsed_ms = (time.perf_counter() - started) * 1e3
+        self.metrics.record("decode_step", elapsed_ms / block)  # per-token-step
+        if block > 1:
+            self.metrics.record("decode_block", elapsed_ms)
 
         finished: list[tuple[int, GenerationResult]] = []
         eos = self.tokenizer.eos_id
         for i, slot in enumerate(self.slots):
             if not slot.active:
                 continue
-            token = int(next_np[i])
-            previous = slot.generated[-1] if slot.generated else None
-            # the PREVIOUS sampled token ended generation?
-            if slot.params.stop_on_eos and eos is not None and previous == eos:
-                finished.append((i, self._finish(i, reason="stop")))
-                continue
-            if len(slot.generated) >= slot.params.max_tokens:
-                # budget already consumed (the prefill-sampled token counts);
-                # discard this step's token so max_tokens is exact
-                finished.append((i, self._finish(i, reason="length")))
-                continue
-            slot.generated.append(token)
-            total = int(offsets_np[i])
-            if (
-                len(slot.generated) >= slot.params.max_tokens
-                or total >= self.max_seq - 1
-            ):
-                finished.append((i, self._finish(i, reason="length")))
+            before = int(self._host_offsets[i]) - block  # tokens held pre-block
+            for k in range(block):
+                token = int(toks_np[k, i])
+                previous = slot.generated[-1] if slot.generated else None
+                # the PREVIOUS sampled token ended generation?
+                if slot.params.stop_on_eos and eos is not None and previous == eos:
+                    finished.append((i, self._finish(i, reason="stop")))
+                    break
+                if len(slot.generated) >= slot.params.max_tokens:
+                    # budget already consumed (the prefill-sampled token
+                    # counts); discard this token so max_tokens is exact
+                    finished.append((i, self._finish(i, reason="length")))
+                    break
+                slot.generated.append(token)
+                total = before + k + 1
+                # stop one BLOCK short of max_seq: the device decodes the
+                # whole next block before the host can stop it, and those
+                # writes must stay inside the slot's cache row / pages
+                if (
+                    len(slot.generated) >= slot.params.max_tokens
+                    or total >= self.max_seq - block
+                ):
+                    finished.append((i, self._finish(i, reason="length")))
+                    break
         return finished
 
     def _finish(self, slot_id: int, *, reason: str) -> GenerationResult:

@@ -139,6 +139,14 @@ def build_tpu_native_provider(
             f"checkpoint or set ALLOW_RANDOM_WEIGHTS=true (testing only)"
         )
 
+    if config.weight_dtype == "int8":
+        from ..models.quant import quantize_params
+
+        log.info("quantizing %s weights to int8 (per-output-channel)", model_id)
+        params = quantize_params(params, model_config)
+    elif config.weight_dtype not in ("", "bf16", "bfloat16"):
+        raise ValueError(f"unknown weight_dtype {config.weight_dtype!r}")
+
     mesh = None
     if config.serving_mesh:
         from ..parallel.mesh import make_mesh, mesh_summary
@@ -158,6 +166,7 @@ def build_tpu_native_provider(
         page_size=config.kv_page_size,
         kv_pages=config.kv_pages or None,
         mesh=mesh,
+        decode_block=config.decode_block,
     )
     engine = ServingEngine(generator)
     return TPUNativeProvider(engine, model_id=model_id)

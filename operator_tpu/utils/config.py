@@ -62,6 +62,13 @@ class OperatorConfig:
     kv_cache_mode: str = "paged"  # "paged" | "contiguous"
     kv_page_size: int = 64
     kv_pages: int = 0
+    # decode steps fused per host round-trip (serving/engine.py): hides host
+    # latency on K-1 of K tokens; admissions join at block boundaries
+    decode_block: int = 4
+    # "bf16" or "int8" (weight-only per-channel quant, models/quant.py):
+    # int8 halves HBM weight traffic — decode at serving batch sizes is
+    # bandwidth-bound, and it fits Mistral-7B per chip on v5e (config 5)
+    weight_dtype: str = "bf16"
     # multi-chip serving (BASELINE configs 3/5): "" = single device,
     # "auto" = plan_for(all local devices), or explicit "dp=2,tp=4[,fsdp=1]"
     serving_mesh: str = ""

@@ -194,6 +194,78 @@ def test_logit_parity_with_transformers(hf_tiny_model):
     assert (ours.argmax(-1) == hf_logits.argmax(-1)).mean() == 1.0
 
 
+def test_logit_parity_rope_scaled_tied(tmp_path):
+    """Llama-3.1/3.2 features — llama3 NTK-by-parts RoPE scaling and tied
+    embeddings — must match HF exactly (the configs that use them:
+    llama-3.1-8b, llama-3.2-1b/3b)."""
+    import dataclasses
+
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from operator_tpu.models.configs import RopeScaling
+
+    config = dataclasses.replace(
+        TINY_TEST,
+        name="tiny-3.2",
+        tie_embeddings=True,
+        rope_theta=500_000.0,
+        rope_scaling=RopeScaling(
+            factor=32.0, low_freq_factor=1.0, high_freq_factor=4.0,
+            original_max_positions=64,  # tiny so the test hits ALL 3 bands
+        ),
+    )
+    hf_config = LlamaConfig(
+        vocab_size=config.vocab_size,
+        hidden_size=config.hidden_size,
+        intermediate_size=config.intermediate_size,
+        num_hidden_layers=config.num_layers,
+        num_attention_heads=config.num_heads,
+        num_key_value_heads=config.num_kv_heads,
+        head_dim=config.head_dim,
+        rope_theta=config.rope_theta,
+        rms_norm_eps=config.rms_norm_eps,
+        max_position_embeddings=config.max_seq_len,
+        tie_word_embeddings=True,
+        attn_implementation="eager",
+        rope_scaling={
+            "rope_type": "llama3",
+            "factor": 32.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 64,
+        },
+    )
+    torch.manual_seed(11)
+    model = LlamaForCausalLM(hf_config).eval()
+
+    params = convert_hf_state_dict(model.state_dict(), config, dtype=jnp.float32)
+    assert "lm_head" not in params  # tied: head reuses the embedding
+    rng = np.random.RandomState(3)
+    tokens_np = rng.randint(0, config.vocab_size, size=(2, 48)).astype(np.int64)
+    with torch.no_grad():
+        hf_logits = model(torch.from_numpy(tokens_np)).logits.numpy()
+    tokens = jnp.asarray(tokens_np, jnp.int32)
+    ours, _ = forward(params, config, tokens, positions_for(tokens))
+    ours = np.asarray(ours)
+    np.testing.assert_allclose(ours, hf_logits, rtol=1e-2, atol=1e-2)
+    assert (ours.argmax(-1) == hf_logits.argmax(-1)).mean() == 1.0
+    # the scaling actually changed the frequencies (guards a silent no-op)
+    from operator_tpu.models.llama import rope_frequencies
+
+    unscaled = rope_frequencies(dataclasses.replace(config, rope_scaling=None))
+    scaled = rope_frequencies(config)
+    assert not np.allclose(np.asarray(unscaled), np.asarray(scaled))
+
+
+def test_new_model_configs_registered():
+    for name in ("llama-3.1-8b", "llama-3.2-1b", "llama-3.2-3b"):
+        config = get_config(name)
+        assert config.rope_scaling is not None
+        assert config.num_heads % config.num_kv_heads == 0
+    assert get_config("llama-3.2-1b").tie_embeddings
+
+
 def test_logit_parity_float64_strict(hf_tiny_model, tmp_path):
     """Exactness check: in float64 both implementations agree to ~1e-6
     (residual = HF's float32 RoPE tables).  x64 is a process-global jax flag,

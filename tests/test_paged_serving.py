@@ -118,6 +118,66 @@ class TestPagedDecodeParity:
         assert int(paged.lengths[0]) == prompt_len + steps
 
 
+class TestDecodeBlockParity:
+    """K-step fused decode must emit exactly the tokens single-step decode
+    emits — for both cache layouts, including budgets that do not divide K
+    and the max_tokens=1 prefill-token edge case."""
+
+    @pytest.mark.parametrize("paged", [False, True], ids=["contiguous", "paged"])
+    def test_block_matches_single_step(self, paged):
+        params = init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+        prompts = ["pod failed exit code 137", "OOMKilled in payments"]
+        outs = {}
+        for block in (1, 4):
+            generator = BatchedGenerator(
+                params, TINY_TEST, ByteTokenizer(), max_slots=2, max_seq=128,
+                cache_dtype=jnp.float32, paged=paged, page_size=16,
+                decode_block=block,
+            )
+            # max_tokens=7: not a multiple of the block size
+            sampling = SamplingParams(max_tokens=7, temperature=0.0, stop_on_eos=False)
+            ids = generator.admit(prompts, [sampling] * 2)
+            collected = {}
+            while generator.num_active:
+                for slot_id, result in generator.step():
+                    collected[slot_id] = result
+            outs[block] = [collected[i] for i in ids]
+        for one, blocked in zip(outs[1], outs[4]):
+            assert one.token_ids == blocked.token_ids
+            assert blocked.completion_tokens == 7
+            assert blocked.finish_reason == "length"
+
+    def test_block_max_tokens_one_exact(self):
+        params = init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+        generator = BatchedGenerator(
+            params, TINY_TEST, ByteTokenizer(), max_slots=2, max_seq=128,
+            cache_dtype=jnp.float32, paged=True, page_size=16, decode_block=4,
+        )
+        result = generator.generate(
+            "boom", SamplingParams(max_tokens=1, temperature=0.0, stop_on_eos=False)
+        )
+        assert result.completion_tokens == 1
+
+    def test_block_continuous_admission(self):
+        """Slots finishing mid-block free up and a new wave admits cleanly."""
+        params = init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+        generator = BatchedGenerator(
+            params, TINY_TEST, ByteTokenizer(), max_slots=2, max_seq=128,
+            cache_dtype=jnp.float32, paged=True, page_size=16, decode_block=4,
+        )
+        short = SamplingParams(max_tokens=2, temperature=0.0, stop_on_eos=False)
+        long = SamplingParams(max_tokens=10, temperature=0.0, stop_on_eos=False)
+        generator.admit(["short prompt", "a much longer prompt here"], [short, long])
+        done = 0
+        admitted_second = False
+        while generator.num_active:
+            done += len(generator.step())
+            if done >= 1 and not admitted_second and generator.free_slots():
+                generator.admit(["second wave"], [short])
+                admitted_second = True
+        assert admitted_second and done >= 2
+
+
 class TestSlidingWindowParity:
     def test_paged_matches_contiguous_with_window(self):
         """Mistral-style sliding window: paged and contiguous generators
