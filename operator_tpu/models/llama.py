@@ -24,6 +24,7 @@ run time; the HF checkpoint loader transposes once at load).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Any, Optional
 
@@ -219,6 +220,64 @@ def _attention(
     return out.reshape(b, t, qh * d)
 
 
+#: f32 score-tensor budget for one prefill attention: above this the query
+#: axis is chunked (lax.scan) so the [B, KH, G, T, S] tensor never
+#: materialises.  256 MB keeps an 8B prefill bucket (n=8, t=4096) well
+#: inside a 16 GB v5e while staying coarse enough that XLA sees big matmuls.
+_SCORE_BUDGET_BYTES = int(
+    float(os.environ.get("OPERATOR_TPU_SCORE_BUDGET_MB", "256")) * 2**20
+)
+
+
+def _pick_q_chunk(b: int, t: int, s: int, qh: int, shards: int = 1) -> Optional[int]:
+    """Largest divisor-of-t query chunk whose f32 scores fit the budget;
+    None means no chunking (the dense tensor already fits).  ``shards``
+    divides the effective batch: under a dp-sharded prefill each device
+    holds b/shards of the score tensor, so the global shape overstates
+    per-device memory by that factor."""
+    rows = max(1, b // max(1, shards))
+    row_bytes = rows * qh * s * 4  # score bytes per query position
+    if row_bytes * t <= _SCORE_BUDGET_BYTES:
+        return None
+    target = max(1, _SCORE_BUDGET_BYTES // row_bytes)
+    for chunk in range(min(t - 1, target), 0, -1):
+        if t % chunk == 0:
+            return chunk
+    return 1
+
+
+def _attention_chunked(
+    q: jax.Array,  # [B, T, QH, D]
+    k: jax.Array,  # [B, S, KH, D]
+    v: jax.Array,
+    q_positions: jax.Array,  # [B, T]
+    kv_positions: jax.Array,  # [B, S]
+    kv_valid: jax.Array,  # [B, S] bool
+    config: ModelConfig,
+    q_chunk: int,
+) -> jax.Array:
+    """Long-context prefill attention: scan over query chunks, building each
+    chunk's causal/window mask on the fly — peak memory is ONE chunk's f32
+    scores instead of the whole [T, S] plane (SURVEY.md §7 hard part b; the
+    reference ships entire pod logs as one string, application.properties:10,
+    so the rebuild's prefill must not be quadratic in HBM)."""
+    b, t, qh, d = q.shape
+    assert t % q_chunk == 0, (t, q_chunk)
+    n_chunks = t // q_chunk
+    qs = jnp.moveaxis(q.reshape(b, n_chunks, q_chunk, qh, d), 1, 0)
+    qps = jnp.moveaxis(q_positions.reshape(b, n_chunks, q_chunk), 1, 0)
+
+    def body(_, xs):
+        q_c, qp_c = xs
+        mask = make_causal_mask(
+            qp_c, kv_positions, kv_valid, sliding_window=config.sliding_window
+        )
+        return None, _attention(q_c, k, v, mask, config)
+
+    _, outs = jax.lax.scan(body, None, (qs, qps))  # [n_chunks, B, q_chunk, QH*D]
+    return jnp.moveaxis(outs, 0, 1).reshape(b, t, qh * d)
+
+
 def forward(
     params: Params,
     config: ModelConfig,
@@ -226,7 +285,10 @@ def forward(
     positions: jax.Array,  # [B, T] int32 absolute positions
     cache: Optional[KVCache] = None,
     cache_offset: int | jax.Array = 0,
-    attn_mask: Optional[jax.Array] = None,  # [B, T, S]; default causal
+    attn_mask: Optional[jax.Array] = None,  # [B, T, S]; forces the dense path
+    kv_valid: Optional[jax.Array] = None,  # [B, S] validity override
+    q_chunk: Optional[int] = None,  # explicit prefill chunk (tests)
+    score_shards: int = 1,  # devices the batch axis is sharded over
 ) -> tuple[jax.Array, Optional[KVCache]]:
     """One decoder pass.
 
@@ -236,6 +298,12 @@ def forward(
     decode writes one — same code path).  ``cache_offset`` may be a scalar
     or a per-sequence ``[B]`` vector — the continuous-batching engine
     decodes slots at ragged positions (serving/engine.py).
+
+    Long prefills chunk the query axis automatically (`_pick_q_chunk`) so
+    the f32 score tensor never exceeds a fixed budget — an 8B-config
+    t=4096 prefill fits a 16 GB chip.  ``kv_valid`` masks cache slots that
+    hold no real token (right-padded batched prefill); passing a full
+    ``attn_mask`` instead forces the dense path (legacy/test hook).
 
     Returns (logits [B, T, vocab] float32, updated cache or None).
     """
@@ -247,19 +315,28 @@ def forward(
     offsets = jnp.broadcast_to(jnp.asarray(cache_offset, jnp.int32), (b,))
     if use_cache:
         max_seq = cache.k.shape[2]
-        kv_positions = jnp.broadcast_to(jnp.arange(max_seq, dtype=jnp.int32)[None], (b, max_seq))
-        if attn_mask is None:
-            limit = offsets[:, None] + t
-            kv_valid = kv_positions < limit
+        kv_positions = jnp.broadcast_to(
+            jnp.arange(max_seq, dtype=jnp.int32)[None], (b, max_seq)
+        )
+        if kv_valid is None:
+            kv_valid = kv_positions < offsets[:, None] + t
+    else:
+        max_seq = t
+        kv_positions = positions
+        if kv_valid is None:
+            kv_valid = jnp.ones((b, t), bool)
+
+    if attn_mask is None:
+        q_chunk = q_chunk or _pick_q_chunk(
+            b, t, max_seq, config.num_heads, shards=score_shards
+        )
+        if q_chunk is None:
             attn_mask = make_causal_mask(
-                positions, kv_positions, kv_valid, sliding_window=config.sliding_window
+                positions, kv_positions, kv_valid,
+                sliding_window=config.sliding_window,
             )
     else:
-        if attn_mask is None:
-            kv_valid = jnp.ones((b, t), bool)
-            attn_mask = make_causal_mask(
-                positions, positions, kv_valid, sliding_window=config.sliding_window
-            )
+        q_chunk = None  # explicit mask: dense semantics the mask encodes
 
     layers = params["layers"]
 
@@ -286,7 +363,14 @@ def forward(
         else:
             k_all, v_all = k, v
             new_cache = None
-        attn = _attention(q, k_all.astype(q.dtype), v_all.astype(q.dtype), attn_mask, config)
+        k_att = k_all.astype(q.dtype)
+        v_att = v_all.astype(q.dtype)
+        if q_chunk is not None:
+            attn = _attention_chunked(
+                q, k_att, v_att, positions, kv_positions, kv_valid, config, q_chunk
+            )
+        else:
+            attn = _attention(q, k_att, v_att, attn_mask, config)
         x = x + mm(attn, weights["wo"])
         # -- mlp ----------------------------------------------------------
         mlp_in = rms_norm(x, weights["ln_mlp"], config.rms_norm_eps)

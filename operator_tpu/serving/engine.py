@@ -376,10 +376,18 @@ class BatchedGenerator:
             )
         return self._shardings["repl"], self._shardings["repl"]
 
+    def _prefill_score_shards(self, n_pad: int) -> int:
+        """Devices the prefill batch axis is actually sharded over — the
+        chunked-attention budget is per-device (models/llama.py)."""
+        if self.mesh is not None and n_pad % self._dp_total == 0:
+            return self._dp_total
+        return 1
+
     def _make_prefill(self, n_pad: int, t_pad: int):
         """Compile a prefill program for the (n_pad, t_pad) bucket."""
         jax, jnp = self._jax, self._jnp
         config = self.config
+        score_shards = self._prefill_score_shards(n_pad)
 
         def prefill_fn(params, cache, token_ids, lengths, slot_ids, rng, temp, top_p):
             # fresh contiguous mini-cache for the prompt tokens
@@ -388,14 +396,11 @@ class BatchedGenerator:
                 jnp.arange(t_pad, dtype=jnp.int32)[None], (n_pad, t_pad)
             )
             kv_valid = positions < lengths[:, None]
-            from ..models.llama import make_causal_mask
-
-            mask = make_causal_mask(
-                positions, positions, kv_valid, sliding_window=config.sliding_window
-            )
+            # kv_valid (not a materialised mask) so long buckets take the
+            # chunked-prefill path in models/llama.py — no [T, S] f32 scores
             logits, mini = forward(
                 params, config, token_ids, positions, cache=mini,
-                cache_offset=0, attn_mask=mask,
+                cache_offset=0, kv_valid=kv_valid, score_shards=score_shards,
             )
             # scatter the prompt KV into the big cache rows for these slots
             # (slot axis is axis 1 of [L, B, S, KH, D])
@@ -426,9 +431,9 @@ class BatchedGenerator:
         valid_len so padded rows land in the trash page)."""
         jax, jnp = self._jax, self._jnp
         config = self.config
+        score_shards = self._prefill_score_shards(n_pad)
 
         def prefill_fn(params, paged, token_ids, lengths, row_tables, rng, temp, top_p):
-            from ..models.llama import make_causal_mask
             from ..ops.paged_attention import PagedKVCache, write_tokens
 
             mini = KVCache.create(config, n_pad, t_pad, dtype=paged.k_pages.dtype)
@@ -436,12 +441,9 @@ class BatchedGenerator:
                 jnp.arange(t_pad, dtype=jnp.int32)[None], (n_pad, t_pad)
             )
             kv_valid = positions < lengths[:, None]
-            mask = make_causal_mask(
-                positions, positions, kv_valid, sliding_window=config.sliding_window
-            )
             logits, mini = forward(
                 params, config, token_ids, positions, cache=mini,
-                cache_offset=0, attn_mask=mask,
+                cache_offset=0, kv_valid=kv_valid, score_shards=score_shards,
             )
             zero = jnp.zeros((n_pad,), jnp.int32)
             scatter = jax.vmap(write_tokens, in_axes=(0, None, 0, None, None))
