@@ -15,6 +15,7 @@ from .mesh import (
     param_specs,
     plan_for,
     shard_params,
+    validate_param_shardings,
 )
 from .train import TrainState, make_optimizer, make_train_step, next_token_loss
 

@@ -225,6 +225,36 @@ def shard_params(params: Params, mesh: Mesh, config: ModelConfig, **kw: Any) -> 
     return jax.tree_util.tree_map(jax.device_put, params, shardings)
 
 
+def validate_param_shardings(
+    mesh: Mesh, config: ModelConfig, *, quantized: bool = False
+) -> int:
+    """Prove every parameter leaf divides evenly over the mesh — WITHOUT
+    allocating the model (``jax.eval_shape``).  Returns the leaf count.
+
+    This is how the llama-3-8b factorisation (kv_heads=8 @ tp=4, vocab
+    128256 over fsdp, quantized {q, s} trees) is checked on a virtual mesh
+    before any real multi-chip run: ``NamedSharding.shard_shape`` raises on
+    any axis a mesh dimension does not divide.
+    """
+    from ..models.llama import init_params
+
+    def build(key):
+        params = init_params(config, key)
+        if quantized:
+            from ..models.quant import quantize_params
+
+            params = quantize_params(params, config)
+        return params
+
+    shapes = jax.eval_shape(build, jax.ShapeDtypeStruct((2,), np.uint32))
+    shardings = param_shardings(mesh, config, quantized=quantized)
+    leaves, treedef = jax.tree_util.tree_flatten(shapes)
+    sharding_leaves = treedef.flatten_up_to(shardings)
+    for leaf, sharding in zip(leaves, sharding_leaves):
+        sharding.shard_shape(leaf.shape)  # raises on non-divisible axes
+    return len(leaves)
+
+
 def mesh_summary(mesh: Mesh) -> str:
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     return f"mesh {sizes} over {mesh.devices.size} {mesh.devices.flat[0].platform} device(s)"

