@@ -15,8 +15,19 @@ generation speed is weight-value independent, so throughput/latency numbers
 are honest.  EOS stopping is disabled so every request generates exactly
 BENCH_MAX_TOKENS tokens — deterministic work per request.
 
+Two phases:
+
+1. **closed batch** — BENCH_REQUESTS submitted at t=0 and drained: peak
+   batched throughput (the headline expl/min metric).
+2. **open loop** — Poisson arrivals at BENCH_RATE/min for
+   BENCH_OPEN_SECONDS: the honest p50/p99 arrival->completion latency under
+   sustained load (SURVEY.md §7 stage 6; the closed batch's p50 ~= wall
+   time is a queueing artifact, VERDICT r2 weak #2).  Set BENCH_OPEN=0 to
+   skip, BENCH_SWEEP="60,100,150" for a rate sweep.
+
 Knobs (env): BENCH_MODEL (tinyllama-1.1b), BENCH_REQUESTS (32),
-BENCH_SLOTS (16), BENCH_MAX_TOKENS (96), BENCH_MAX_SEQ (1024).
+BENCH_SLOTS (16), BENCH_MAX_TOKENS (96), BENCH_MAX_SEQ (1024),
+BENCH_RATE (100), BENCH_OPEN_SECONDS (60), BENCH_TOKENIZER (builtin-bpe).
 """
 
 from __future__ import annotations
@@ -24,6 +35,7 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import random
 import sys
 import time
 
@@ -52,6 +64,52 @@ def build_requests(n: int) -> list:
         result = engine.analyze(failure)
         requests.append(AnalysisRequest(analysis_result=result, failure_data=failure))
     return requests
+
+
+async def run_open_loop(
+    serving,
+    prompts: list,
+    sampling,
+    *,
+    rate_per_min: float,
+    duration_s: float,
+    seed: int = 0,
+) -> dict:
+    """Poisson arrivals at ``rate_per_min`` for ``duration_s``; every
+    arrival is awaited to completion (arrivals stop, the queue drains).
+    Returns {rate_per_min, offered, completed, p50_s, p99_s, drain_s}."""
+    rng = random.Random(seed)
+    latencies: list[float] = []
+    tasks: list[asyncio.Task] = []
+
+    async def one(prompt: str) -> None:
+        started = time.perf_counter()
+        await serving.generate(prompt, sampling)
+        latencies.append(time.perf_counter() - started)
+
+    start = time.perf_counter()
+    i = 0
+    next_at = 0.0
+    while next_at < duration_s:
+        delay = start + next_at - time.perf_counter()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        tasks.append(asyncio.ensure_future(one(prompts[i % len(prompts)])))
+        i += 1
+        next_at += rng.expovariate(rate_per_min / 60.0)
+    arrivals_done = time.perf_counter()
+    await asyncio.gather(*tasks)
+    drain = time.perf_counter() - arrivals_done
+    latencies.sort()
+    n = len(latencies)
+    return {
+        "rate_per_min": rate_per_min,
+        "offered": i,
+        "completed": n,
+        "p50_s": round(latencies[n // 2], 3) if n else None,
+        "p99_s": round(latencies[min(n - 1, int(n * 0.99))], 3) if n else None,
+        "drain_s": round(drain, 2),
+    }
 
 
 def probe_default_backend() -> bool:
@@ -170,8 +228,19 @@ def main() -> None:
 
     paged = os.environ.get("BENCH_PAGED", "1") == "1"
     decode_block = int(os.environ.get("BENCH_DECODE_BLOCK", "8"))
+    # real subword tokenizer by default (VERDICT r2 weak #7: byte-level token
+    # counts inflate prompts ~4x vs production BPE); BENCH_TOKENIZER may name
+    # a local HF tokenizer dir, "builtin-bpe", or "byte"
+    tok_spec = os.environ.get("BENCH_TOKENIZER", "builtin-bpe")
+    tokenizer = load_tokenizer(tok_spec)
+    if tokenizer.vocab_size > config.vocab_size:
+        log(f"tokenizer vocab {tokenizer.vocab_size} exceeds model vocab "
+            f"{config.vocab_size}; falling back to byte tokenizer")
+        tok_spec = "byte"
+        tokenizer = load_tokenizer(tok_spec)
+    log(f"tokenizer: {tok_spec} (vocab {tokenizer.vocab_size})")
     generator = BatchedGenerator(
-        params, config, load_tokenizer(None), max_slots=slots, max_seq=max_seq,
+        params, config, tokenizer, max_slots=slots, max_seq=max_seq,
         paged=paged, page_size=int(os.environ.get("BENCH_PAGE_SIZE", "64")),
         decode_block=decode_block,
     )
@@ -192,7 +261,15 @@ def main() -> None:
             generator.step()
     log(f"warmup (compile) {time.perf_counter() - t0:.1f}s")
 
-    async def run() -> tuple[float, list[float]]:
+    open_enabled = os.environ.get("BENCH_OPEN", "1") == "1" and platform != "cpu-fallback"
+    open_seconds = float(os.environ.get("BENCH_OPEN_SECONDS", "60"))
+    rates = [
+        float(r) for r in os.environ.get(
+            "BENCH_SWEEP", os.environ.get("BENCH_RATE", "100")
+        ).split(",")
+    ]
+
+    async def run() -> tuple[float, list[float], list[dict]]:
         # generous admission window -> full waves, so only warmed prefill
         # buckets are hit (any stray compile is logged by the engine)
         serving = ServingEngine(generator, admission_wait_s=0.05)
@@ -207,16 +284,28 @@ def main() -> None:
         wall_start = time.perf_counter()
         await asyncio.gather(*(one(p) for p in prompts))
         wall = time.perf_counter() - wall_start
+
+        open_results: list[dict] = []
+        if open_enabled:
+            for rate in rates:
+                log(f"open-loop: {rate:.0f} arrivals/min for {open_seconds:.0f}s")
+                result = await run_open_loop(
+                    serving, prompts, sampling,
+                    rate_per_min=rate, duration_s=open_seconds, seed=1,
+                )
+                log(f"open-loop @{rate:.0f}/min: p50={result['p50_s']}s "
+                    f"p99={result['p99_s']}s completed={result['completed']}")
+                open_results.append(result)
         await serving.close()
-        return wall, latencies
+        return wall, latencies, open_results
 
     profile_dir = os.environ.get("BENCH_PROFILE", "").strip()
     if profile_dir:
         log(f"profiling timed region -> {profile_dir}")
         with generator.trace(profile_dir):
-            wall, latencies = asyncio.run(run())
+            wall, latencies, open_results = asyncio.run(run())
     else:
-        wall, latencies = asyncio.run(run())
+        wall, latencies, open_results = asyncio.run(run())
     latencies.sort()
     p50 = latencies[len(latencies) // 2]
     p99 = latencies[min(len(latencies) - 1, int(len(latencies) * 0.99))]
@@ -235,6 +324,13 @@ def main() -> None:
     log(f"wall={wall:.2f}s  p50={p50:.2f}s  p99={p99:.2f}s  "
         f"decode~{tokens_s:.0f} tok/s  throughput={per_min:.1f} expl/min")
     degraded = platform == "cpu-fallback"
+    # SLO verdict from the OPEN-loop phase (the honest p50 under sustained
+    # arrivals); closed-batch p50 is a queueing artifact kept for continuity
+    slo = None
+    for result in open_results:
+        if result["rate_per_min"] >= 100 and result["p50_s"] is not None:
+            slo = bool(result["p50_s"] < 2.0)
+            break  # the run at (closest above) 100/min, not the last sweep rate
     print(json.dumps({
         "metric": "explanations_per_min",
         "value": round(per_min, 1),
@@ -243,6 +339,8 @@ def main() -> None:
         "vs_baseline": 0.0 if degraded else round(per_min / 100.0, 3),
         "p50_latency_s": round(p50, 3),
         "p99_latency_s": round(p99, 3),
+        "open_loop": open_results,
+        "open_loop_p50_under_2s_at_100pm": slo,
         "decode_tokens_per_s": round(tokens_s, 1),
         # end-to-end MFU incl. host/queueing time — a decode-only step MFU
         # would be higher; this is the honest number for the whole pipeline
@@ -253,6 +351,7 @@ def main() -> None:
         "requests": n_requests,
         "max_tokens": max_tokens,
         "decode_block": decode_block,
+        "tokenizer": tok_spec,
         "weight_dtype": "int8" if quant else "bf16",
         "platform": platform,
         "degraded": degraded,

@@ -75,7 +75,28 @@ class HFTokenizer:
 
 
 def load_tokenizer(path: Optional[str]) -> Tokenizer:
-    """Local tokenizer dir if given and loadable, else the byte fallback."""
+    """Resolve a tokenizer spec:
+
+    - ``"builtin-bpe"`` — the shipped log-trained byte-level BPE
+      (models/bpe.py, vocab 4096; no egress needed);
+    - a directory path — local transformers tokenizer (production
+      checkpoints on the PVC);
+    - ``None``/``"byte"``/load failure — the byte fallback.
+    """
+    if path == "byte":
+        return ByteTokenizer()
+    if path == "builtin-bpe":
+        from .bpe import load_builtin_bpe
+
+        bpe = load_builtin_bpe()
+        if bpe is not None:
+            return bpe
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "builtin BPE vocab missing; using byte fallback"
+        )
+        return ByteTokenizer()
     if path:
         try:
             return HFTokenizer(path)

@@ -394,7 +394,7 @@ class BatchedGenerator:
             NamedSharding(self.mesh, P(("dp", "fsdp"))),
         )
 
-    def _prefill_score_shards(self, n_pad: int) -> int:
+    def _prefill_score_shards(self) -> int:
         """Devices the prefill batch axis is sharded over — the
         chunked-attention budget is per-device (models/llama.py)."""
         return self._dp_total if self.mesh is not None else 1
@@ -403,7 +403,7 @@ class BatchedGenerator:
         """Compile a prefill program for the (n_pad, t_pad) bucket."""
         jax, jnp = self._jax, self._jnp
         config = self.config
-        score_shards = self._prefill_score_shards(n_pad)
+        score_shards = self._prefill_score_shards()
 
         def prefill_fn(params, cache, token_ids, lengths, slot_ids, rng, temp, top_p):
             # fresh contiguous mini-cache for the prompt tokens
@@ -447,7 +447,7 @@ class BatchedGenerator:
         valid_len so padded rows land in the trash page)."""
         jax, jnp = self._jax, self._jnp
         config = self.config
-        score_shards = self._prefill_score_shards(n_pad)
+        score_shards = self._prefill_score_shards()
 
         def prefill_fn(params, paged, token_ids, lengths, row_tables, rng, temp, top_p):
             from ..ops.paged_attention import PagedKVCache, write_tokens

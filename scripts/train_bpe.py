@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Train the shipped log-BPE vocab (operator_tpu/models/bpe_vocab/).
+
+Corpus: recorded failure fixtures, the builtin pattern library text, repo
+prose (README/SURVEY), and the serving prompt template rendered over every
+fixture — the text the production tokenizer actually sees.  Re-run after
+growing the corpus:  python scripts/train_bpe.py [vocab_size]
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from operator_tpu.models.bpe import BPETokenizer, BUILTIN_VOCAB, train_bpe
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def corpus() -> list[str]:
+    texts: list[str] = []
+    for pattern in ("tests/fixtures/*.log", "*.md", "operator_tpu/patterns/builtin/*.yaml"):
+        for path in sorted(glob.glob(os.path.join(REPO, pattern))):
+            with open(path, errors="replace") as f:
+                texts.append(f.read())
+    # the prompt template rendered over the real fixtures — the exact text
+    # the serving engine tokenizes
+    from operator_tpu.patterns.engine import PatternEngine
+    from operator_tpu.schema.analysis import AnalysisRequest, PodFailureData
+    from operator_tpu.serving.prompts import build_prompt
+
+    engine = PatternEngine()
+    for path in sorted(glob.glob(os.path.join(REPO, "tests/fixtures/*.log"))):
+        with open(path) as f:
+            failure = PodFailureData(logs=f.read())
+        result = engine.analyze(failure)
+        texts.append(build_prompt(AnalysisRequest(analysis_result=result,
+                                                  failure_data=failure)))
+    return texts
+
+
+def main() -> None:
+    vocab_size = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    texts = corpus()
+    total = sum(len(t) for t in texts)
+    print(f"corpus: {len(texts)} documents, {total/1e3:.0f} kB")
+    merges = train_bpe(texts, vocab_size)
+    tok = BPETokenizer(merges)
+    tok.save(BUILTIN_VOCAB)
+    held_out = texts[0]
+    ids = tok.encode(held_out)
+    print(f"trained {len(merges)} merges -> vocab {tok.vocab_size}")
+    print(f"compression on corpus[0]: {len(held_out)/max(1,len(ids)):.2f} chars/token")
+    print(f"wrote {BUILTIN_VOCAB} ({os.path.getsize(BUILTIN_VOCAB)/1e3:.0f} kB)")
+
+
+if __name__ == "__main__":
+    main()

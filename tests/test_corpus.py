@@ -1,0 +1,94 @@
+"""Failure-corpus precision matrix (VERDICT r2 missing #4 / next #7).
+
+Every recorded failure log must (a) rank its own failure class first,
+(b) never fire patterns from unrelated classes, and (c) report the right
+severity — the pattern-matching half of the product exercised across the
+failure modes the reference's pattern libraries target
+(reference PatternSyncService.java:94-107 distributes per-class YAML;
+AnalysisStorageService.java:308-325 surfaces matched name/severity/score).
+"""
+
+import os
+
+import pytest
+
+from operator_tpu.patterns.engine import PatternEngine
+from operator_tpu.schema.analysis import PodFailureData
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+# fixture -> (expected top pattern id OR tuple of acceptable top ids,
+#             allowed co-firing ids, expected highest severity)
+MATRIX = {
+    "crashloop_quarkus.log": (
+        "port-conflict", {"crashloop-backoff", "java-class-not-found"}, "HIGH"),
+    "oom_java.log": (
+        "java-heap-oom", {"oom-killed", "crashloop-backoff"}, "CRITICAL"),
+    "image_pull_backoff.log": ("image-pull-failure", set(), "HIGH"),
+    "liveness_probe.log": ("liveness-probe-failure", set(), "MEDIUM"),
+    "eviction.log": ("pod-evicted", set(), "HIGH"),
+    "init_container_config.log": (
+        ("init-container-failure", "crashloop-backoff"),
+        {"init-container-failure", "crashloop-backoff", "config-missing"},
+        "HIGH"),
+    "dns_failure.log": ("dns-failure", set(), "HIGH"),
+    "python_module.log": (
+        "python-module-missing", {"python-traceback"}, "HIGH"),
+    "disk_full.log": ("disk-full", set(), "CRITICAL"),
+    "db_connection_refused.log": ("db-connection-refused", set(), "HIGH"),
+    "tls_cert.log": ("tls-certificate", set(), "MEDIUM"),
+    "go_panic.log": ("segfault", set(), "CRITICAL"),
+}
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return PatternEngine()
+
+
+def test_matrix_covers_every_fixture():
+    logs = {f for f in os.listdir(FIXTURES) if f.endswith(".log")}
+    assert logs == set(MATRIX), "fixture/matrix drift"
+    # >= 8 distinct failure classes (VERDICT done-criterion)
+    tops = {t if isinstance(t, str) else t[0] for t, _, _ in MATRIX.values()}
+    assert len(tops) >= 10
+
+
+@pytest.mark.parametrize("fixture", sorted(MATRIX))
+def test_fixture_precision(engine, fixture):
+    expected_top, allowed, severity = MATRIX[fixture]
+    with open(os.path.join(FIXTURES, fixture)) as f:
+        result = engine.analyze(PodFailureData(logs=f.read()))
+    assert result.events, f"{fixture}: no patterns matched at all"
+    tops = (expected_top,) if isinstance(expected_top, str) else expected_top
+    got_top = result.events[0].matched_pattern.id
+    assert got_top in tops, (
+        f"{fixture}: top match {got_top!r}, expected {tops}; "
+        f"all: {[(e.matched_pattern.id, round(e.score, 2)) for e in result.events]}"
+    )
+    fired = {e.matched_pattern.id for e in result.events}
+    stray = fired - set(tops) - allowed
+    assert not stray, f"{fixture}: cross-fired unrelated patterns {stray}"
+    assert result.summary.highest_severity == severity
+    # the expected class must be discoverable by name for event text
+    # (EventService truncation keeps pattern name — schema contract)
+    assert result.events[0].matched_pattern.name
+
+
+def test_expected_class_fires_somewhere(engine):
+    """Recall over the corpus: each of the named failure classes fires in at
+    least one fixture (guards against a pattern regex rotting silently)."""
+    fired_anywhere = set()
+    for fixture in MATRIX:
+        with open(os.path.join(FIXTURES, fixture)) as f:
+            result = engine.analyze(PodFailureData(logs=f.read()))
+        fired_anywhere |= {e.matched_pattern.id for e in result.events}
+    required = {
+        "oom-killed", "java-heap-oom", "port-conflict", "crashloop-backoff",
+        "image-pull-failure", "liveness-probe-failure", "config-missing",
+        "db-connection-refused", "dns-failure", "pod-evicted",
+        "init-container-failure", "python-module-missing", "python-traceback",
+        "disk-full", "tls-certificate", "segfault",
+    }
+    missing = required - fired_anywhere
+    assert not missing, f"classes never firing in the corpus: {missing}"

@@ -174,21 +174,26 @@ class Test8BFactorisation:
             validate_param_shardings(mesh, get_config(name), quantized=True)
 
     def test_width_true_8b_wave_tp4_dp2(self):
-        """One sharded engine wave at the 8B width: kv_heads=8, head_dim=128,
-        vocab 128256, hidden 4096 — only the depth is reduced (2 layers) so
-        the CPU mesh can hold it.  Every per-layer sharded matmul shape and
-        the tp=4 attention head split are the real config-3 factorisation."""
+        """One sharded engine wave at the 8B attention/vocab width:
+        kv_heads=8, head_dim=128, heads=32, vocab 128256 — the dimensions
+        config-3's tp=4 factorisation actually splits.  Depth and the
+        tp-orthogonal hidden/intermediate sizes are reduced so the CPU mesh
+        compiles it in test time; every sharded axis (heads over tp, vocab
+        over fsdp, intermediate over tp) keeps its real divisibility."""
         from dataclasses import replace
 
         from operator_tpu.models import get_config
 
         config = replace(get_config("llama-3-8b"), num_layers=2,
-                         max_seq_len=256, name="llama-3-8b-depth2")
+                         hidden_size=1024, intermediate_size=3584,
+                         max_seq_len=256, name="llama-3-8b-attnwidth")
         mesh = make_mesh(MeshPlan(dp=2, tp=4), devices=cpu_devices(8))
-        params = init_params(config, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+        # f32: the CPU backend emulates bf16 matmuls an order of magnitude
+        # slower; the sharding factorisation under test is dtype-independent
+        params = init_params(config, jax.random.PRNGKey(0), dtype=jnp.float32)
         generator = BatchedGenerator(
             params, config, load_tokenizer(None), max_slots=2, max_seq=128,
-            paged=True, page_size=16, mesh=mesh, cache_dtype=jnp.bfloat16,
+            paged=True, page_size=16, mesh=mesh, cache_dtype=jnp.float32,
         )
         sampling = SamplingParams(max_tokens=3, temperature=0.0, stop_on_eos=False)
         slot_ids = generator.admit(["pod oomkilled", "probe failed"], [sampling] * 2)
