@@ -239,10 +239,14 @@ def main() -> None:
         tok_spec = "byte"
         tokenizer = load_tokenizer(tok_spec)
     log(f"tokenizer: {tok_spec} (vocab {tokenizer.vocab_size})")
+    # decode-ahead depth 2: one block stays in flight while the host
+    # processes the previous block's tokens — hides the host<->device round
+    # trip, which dominates block time over a tunneled TPU backend
+    pipeline_depth = int(os.environ.get("BENCH_PIPELINE", "2"))
     generator = BatchedGenerator(
         params, config, tokenizer, max_slots=slots, max_seq=max_seq,
         paged=paged, page_size=int(os.environ.get("BENCH_PAGE_SIZE", "64")),
-        decode_block=decode_block,
+        decode_block=decode_block, pipeline_depth=pipeline_depth,
     )
     prompts = [build_prompt(r) for r in build_requests(n_requests)]
     sampling = SamplingParams(max_tokens=max_tokens, temperature=0.3, stop_on_eos=False)
@@ -327,10 +331,10 @@ def main() -> None:
     # SLO verdict from the OPEN-loop phase (the honest p50 under sustained
     # arrivals); closed-batch p50 is a queueing artifact kept for continuity
     slo = None
-    for result in open_results:
+    for result in sorted(open_results, key=lambda r: r["rate_per_min"]):
         if result["rate_per_min"] >= 100 and result["p50_s"] is not None:
             slo = bool(result["p50_s"] < 2.0)
-            break  # the run at (closest above) 100/min, not the last sweep rate
+            break  # the lowest swept rate >= 100/min, regardless of input order
     print(json.dumps({
         "metric": "explanations_per_min",
         "value": round(per_min, 1),
@@ -351,6 +355,7 @@ def main() -> None:
         "requests": n_requests,
         "max_tokens": max_tokens,
         "decode_block": decode_block,
+        "pipeline_depth": pipeline_depth,
         "tokenizer": tok_spec,
         "weight_dtype": "int8" if quant else "bf16",
         "platform": platform,

@@ -141,6 +141,7 @@ class BatchedGenerator:
         mesh: Any = None,
         decode_block: int = 1,
         sample_top_k: Optional[int] = None,
+        pipeline_depth: int = 1,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -163,6 +164,11 @@ class BatchedGenerator:
         assert decode_block >= 1
         self.decode_block = decode_block
         self.sample_top_k = sample_top_k or self.SAMPLE_TOP_K
+        # decode-ahead: blocks in flight before the host fetches tokens
+        # (see step()); 1 = synchronous, 2 = one block of lookahead
+        assert pipeline_depth >= 1
+        self.pipeline_depth = pipeline_depth
+        self._inflight_blocks: list[tuple[Any, dict]] = []
 
         # ---- sharded serving (BASELINE configs 3/5): params TP on heads /
         # MLP columns, slots DP over the batch axis; one jitted program per
@@ -234,6 +240,10 @@ class BatchedGenerator:
         self.offsets = jnp.zeros((max_slots,), jnp.int32)  # tokens held per slot
         self.last_tokens = jnp.zeros((max_slots, 1), jnp.int32)
         self.slots: list[_Slot] = [_Slot() for _ in range(max_slots)]
+        # per-slot generation counter: an in-flight decode block carries the
+        # epoch it was dispatched under, so tokens from a block dispatched
+        # before a slot was recycled are never credited to the new sequence
+        self._slot_epoch = [0] * max_slots
         self._rng = jax.random.PRNGKey(seed)
         # host shadow of per-slot token counts (BOTH cache layouts): the
         # decode loop must never fetch offsets from the device — at the 8B
@@ -651,6 +661,7 @@ class BatchedGenerator:
         last = np.array(self.last_tokens)  # mutable host copy
         for row, slot_id in enumerate(taken):
             slot = self.slots[slot_id]
+            self._slot_epoch[slot_id] += 1  # new generation begins
             slot.active = True
             slot.prompt_len = int(lengths[row])
             slot.generated = [int(first_np[row])]
@@ -689,11 +700,41 @@ class BatchedGenerator:
         return self._sampling_cache
 
     def step(self) -> list[tuple[int, GenerationResult]]:
-        """One decode block (K chained steps, K=decode_block); returns
-        finished (slot, result) pairs."""
-        if self.num_active == 0:
+        """One decode round: dispatch a block, then process the oldest
+        fetched block's tokens; returns finished (slot, result) pairs.
+
+        With ``pipeline_depth=1`` the block just dispatched is fetched and
+        processed immediately (classic synchronous decode).  With depth D>1,
+        up to D-1 blocks stay IN FLIGHT while the host processes older
+        tokens — the host<->device round trip (which dominates a tunneled
+        TPU's block time) overlaps the next block's compute.  Slots may
+        decode up to (D-1) extra junk blocks past their stop condition into
+        their OWN rows/pages (the max_seq guard margin accounts for it);
+        per-slot epochs keep a reused slot from ever consuming a stale
+        block's tokens.
+        """
+        if self.num_active == 0 and not self._inflight_blocks:
             return []
         started = time.perf_counter()
+        block = self.decode_block
+        if self.num_active:
+            self._dispatch_block()
+        finished: list[tuple[int, GenerationResult]] = []
+        # keep at most depth-1 blocks in flight; once nothing is active the
+        # leftovers are flushed (their tokens belong to finished epochs)
+        while self._inflight_blocks and (
+            len(self._inflight_blocks) >= self.pipeline_depth
+            or self.num_active == 0
+        ):
+            finished.extend(self._process_block(*self._inflight_blocks.pop(0)))
+        elapsed_ms = (time.perf_counter() - started) * 1e3
+        self.metrics.record("decode_step", elapsed_ms / block)  # per-token-step
+        if block > 1:
+            self.metrics.record("decode_block", elapsed_ms)
+        return finished
+
+    def _dispatch_block(self) -> None:
+        """Launch one decode block; tokens stay on device until processed."""
         block = self.decode_block
         active, temp_dev, top_p_dev, active_dev = self._sampling_tensors()
         if self.paged:
@@ -706,20 +747,28 @@ class BatchedGenerator:
                 self.params, self.cache, self.last_tokens, self.offsets, self._rng,
                 temp_dev, top_p_dev, active_dev,
             )
-        self._host_offsets[active] += block
-        toks_np = np.asarray(toks)  # [K, B] — the ONE host sync per block
         self.last_tokens = last
-        elapsed_ms = (time.perf_counter() - started) * 1e3
-        self.metrics.record("decode_step", elapsed_ms / block)  # per-token-step
-        if block > 1:
-            self.metrics.record("decode_block", elapsed_ms)
+        # snapshot which generation of each slot this block belongs to and
+        # how many tokens it held pre-block, BEFORE advancing the shadow
+        snapshot = {
+            i: (self._slot_epoch[i], int(self._host_offsets[i]))
+            for i, slot in enumerate(self.slots)
+            if slot.active
+        }
+        self._host_offsets[active] += block
+        self._inflight_blocks.append((toks, snapshot))
 
+    def _process_block(self, toks, snapshot) -> list[tuple[int, GenerationResult]]:
+        block = self.decode_block
+        toks_np = np.asarray(toks)  # [K, B] — the ONE host sync per block
         finished: list[tuple[int, GenerationResult]] = []
         eos = self.tokenizer.eos_id
-        for i, slot in enumerate(self.slots):
-            if not slot.active:
+        for i, (epoch, before) in snapshot.items():
+            slot = self.slots[i]
+            # the slot moved on (finished, possibly re-admitted) after this
+            # block was dispatched: its lanes hold junk for the new epoch
+            if not slot.active or self._slot_epoch[i] != epoch:
                 continue
-            before = int(self._host_offsets[i]) - block  # tokens held pre-block
             for k in range(block):
                 token = int(toks_np[k, i])
                 previous = slot.generated[-1] if slot.generated else None
@@ -734,12 +783,13 @@ class BatchedGenerator:
                     break
                 slot.generated.append(token)
                 total = before + k + 1
-                # stop one BLOCK short of max_seq: the device decodes the
-                # whole next block before the host can stop it, and those
-                # writes must stay inside the slot's cache row / pages
+                # stop pipeline_depth BLOCKS short of max_seq: the device
+                # decodes that many further blocks before the host can stop
+                # it, and those writes must stay inside the slot's cache
+                # row / pages
                 if (
                     len(slot.generated) >= slot.params.max_tokens
-                    or total >= self.max_seq - block
+                    or total >= self.max_seq - self.pipeline_depth * block
                 ):
                     finished.append((i, self._finish(i, reason="length")))
                     break
@@ -761,6 +811,7 @@ class BatchedGenerator:
                 lengths=paged.lengths.at[slot_id].set(0),
             )
             self.allocator.release(slot.pages)
+        self._slot_epoch[slot_id] += 1  # stale in-flight tokens now orphaned
         self._host_offsets[slot_id] = 0
         self._sampling_cache = None  # slot set changed
         eos = self.tokenizer.eos_id
