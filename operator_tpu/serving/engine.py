@@ -167,6 +167,12 @@ class BatchedGenerator:
         # decode-ahead: blocks in flight before the host fetches tokens
         # (see step()); 1 = synchronous, 2 = one block of lookahead
         assert pipeline_depth >= 1
+        if pipeline_depth * decode_block * 2 > self.max_seq:
+            raise ValueError(
+                f"pipeline_depth*decode_block={pipeline_depth * decode_block} "
+                f"reserves more than half of max_seq={self.max_seq} as the "
+                f"stop margin — generations would truncate immediately"
+            )
         self.pipeline_depth = pipeline_depth
         self._inflight_blocks: list[tuple[Any, dict]] = []
 
@@ -214,9 +220,10 @@ class BatchedGenerator:
                         s["repl"], s["batch"], s["batch"], s["batch"],
                     ),
                     out_shardings=(s["paged"], block_tokens, s["tokens"], s["repl"]),
+                    donate_argnums=(1,),  # page pool: update in place, no copy
                 )
             else:
-                self._decode_fn = jax.jit(self._decode_block_paged)
+                self._decode_fn = jax.jit(self._decode_block_paged, donate_argnums=(1,))
         else:
             self.cache = KVCache.create(config, max_slots, self.max_seq, dtype=cache_dtype)
             if mesh is not None:
@@ -234,9 +241,10 @@ class BatchedGenerator:
                     out_shardings=(
                         s["cache"], block_tokens, s["tokens"], s["batch"], s["repl"]
                     ),
+                    donate_argnums=(1,),  # KV cache: update in place, no copy
                 )
             else:
-                self._decode_fn = jax.jit(self._decode_block)
+                self._decode_fn = jax.jit(self._decode_block, donate_argnums=(1,))
         self.offsets = jnp.zeros((max_slots,), jnp.int32)  # tokens held per slot
         self.last_tokens = jnp.zeros((max_slots, 1), jnp.int32)
         self.slots: list[_Slot] = [_Slot() for _ in range(max_slots)]
@@ -722,15 +730,18 @@ class BatchedGenerator:
         finished: list[tuple[int, GenerationResult]] = []
         # keep at most depth-1 blocks in flight; once nothing is active the
         # leftovers are flushed (their tokens belong to finished epochs)
+        processed = 0
         while self._inflight_blocks and (
             len(self._inflight_blocks) >= self.pipeline_depth
             or self.num_active == 0
         ):
             finished.extend(self._process_block(*self._inflight_blocks.pop(0)))
-        elapsed_ms = (time.perf_counter() - started) * 1e3
-        self.metrics.record("decode_step", elapsed_ms / block)  # per-token-step
-        if block > 1:
-            self.metrics.record("decode_block", elapsed_ms)
+            processed += 1
+        if processed:  # dispatch-only warmup steps would skew the histograms
+            elapsed_ms = (time.perf_counter() - started) * 1e3
+            self.metrics.record("decode_step", elapsed_ms / (processed * block))
+            if block > 1:
+                self.metrics.record("decode_block", elapsed_ms / processed)
         return finished
 
     def _dispatch_block(self) -> None:

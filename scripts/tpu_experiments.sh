@@ -1,0 +1,36 @@
+#!/bin/bash
+# Round-3 TPU experiment series (run on the TPU-attached host).
+# Produces /tmp/r3_experiments/: hardware floors, decode attribution,
+# bench variants (pipeline, page size, quant, config-4 slots=32, 8B int8),
+# and an xplane profile. Each step is individually timeboxed so one hang
+# doesn't kill the series.
+set -u
+OUT=${1:-/tmp/r3_experiments}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  echo "=== $name ($(date +%H:%M:%S)) ===" | tee -a "$OUT/series.log"
+  timeout "$tmo" "$@" > "$OUT/$name.log" 2>&1
+  echo "rc=$? $name" | tee -a "$OUT/series.log"
+}
+
+run floor        600 python scripts/profile_floor.py
+run decode_attr  900 python scripts/profile_decode.py
+# headline: TinyLlama bf16, paged, pipeline 2, open loop at 100/min
+run bench_main   1500 env BENCH_OPEN_SECONDS=60 python bench.py
+# decode-ahead off (attribution of the pipelining win)
+run bench_nopipe 900 env BENCH_OPEN=0 BENCH_PIPELINE=1 python bench.py
+# bigger pages: 4x fewer grid steps in the paged kernel
+run bench_page256 900 env BENCH_OPEN=0 BENCH_PAGE_SIZE=256 python bench.py
+# int8 weights: the bandwidth-halving claim, measured
+run bench_quant  900 env BENCH_OPEN=0 BENCH_QUANT=1 python bench.py
+# literal BASELINE config 4: 32 slots, 32 concurrent arrivals -> one prefill
+run bench_slots32 900 env BENCH_OPEN=0 BENCH_SLOTS=32 python bench.py
+# north-star model class: llama-3-8b int8 (~8.2 GB) on the 16 GB chip
+run bench_8b     2400 env BENCH_OPEN=0 BENCH_MODEL=llama-3-8b BENCH_QUANT=1 \
+    BENCH_SLOTS=8 BENCH_REQUESTS=16 BENCH_MAX_SEQ=2048 python bench.py
+# xplane trace of the timed region for the remaining-gap attribution
+run bench_profile 900 env BENCH_OPEN=0 BENCH_PROFILE=$OUT/xplane python bench.py
+echo "series done $(date +%H:%M:%S)" | tee -a "$OUT/series.log"
