@@ -24,6 +24,7 @@ softmax attention — the oracle for parity tests and the CPU path.
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass
 from typing import Optional
 
@@ -251,6 +252,183 @@ def _paged_attn_kernel(
         out_ref[0] = (acc_scratch[...] / denom).astype(out_ref.dtype)
 
 
+def _paged_attn_kernel_v2(
+    # scalar prefetch
+    pt_ref,  # [B, pages_per_seq] int32 (SMEM)
+    len_ref,  # [B] int32 (SMEM)
+    # blocks
+    q_ref,  # [1, QH, D] (VMEM)
+    k_hbm,  # [num_pages, page_size, KH, D] (stays in HBM)
+    v_hbm,
+    out_ref,  # [1, QH, D] f32
+    # scratch
+    k_buf,  # [2, page_size, KH, D] VMEM double buffer
+    v_buf,
+    sem,  # DMA semaphores [2, 2]
+    m_scratch,  # [QH, LANE] f32
+    l_scratch,
+    acc_scratch,  # [QH, D] f32
+    *,
+    kv_heads: int,
+    q_per_kv: int,
+    page_size: int,
+    scale: float,
+    window: Optional[int] = None,
+):
+    """Decode paged attention, one grid step per sequence.
+
+    The v1 kernel's grid was (B, pages_per_seq): every page slot cost a
+    grid step and a BlockSpec DMA whether or not it held live tokens
+    (the index map always fetches).  Here the page walk happens INSIDE the
+    kernel with manual double-buffered DMAs steered by the scalar-prefetched
+    page table, so exactly ceil(len/page) pages move from HBM — a sequence
+    at length 100 with a 4096-token table reads 2 pages, not 64 — and page
+    i+1's DMA overlaps page i's flash-attention update.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b = pl.program_id(0)
+    seq_len = len_ref[b]
+    num_live = pl.cdiv(seq_len, page_size)
+    first = 0
+    if window is not None:
+        window_lo = jnp.maximum(seq_len - window, 0)
+        first = window_lo // page_size
+
+    m_scratch[...] = jnp.full_like(m_scratch, _NEG_INF)
+    l_scratch[...] = jnp.zeros_like(l_scratch)
+    acc_scratch[...] = jnp.zeros_like(acc_scratch)
+
+    def dma(slot, j):
+        return (
+            pltpu.make_async_copy(k_hbm.at[pt_ref[b, j]], k_buf.at[slot], sem.at[slot, 0]),
+            pltpu.make_async_copy(v_hbm.at[pt_ref[b, j]], v_buf.at[slot], sem.at[slot, 1]),
+        )
+
+    @pl.when(num_live > first)
+    def _prologue():
+        for copy in dma(first % 2, first):
+            copy.start()
+
+    def body(j, _):
+        slot = j % 2
+
+        @pl.when(j + 1 < num_live)
+        def _prefetch_next():
+            for copy in dma((j + 1) % 2, j + 1):
+                copy.start()
+
+        for copy in dma(slot, j):
+            copy.wait()
+
+        q = q_ref[0].astype(jnp.float32)  # [QH, D]
+        k = k_buf[slot]  # [page, KH, D]
+        v = v_buf[slot]
+
+        parts = []
+        for h in range(kv_heads):
+            q_h = q[h * q_per_kv : (h + 1) * q_per_kv]  # [G, D]
+            k_h = k[:, h, :].astype(jnp.float32)  # [page, D]
+            parts.append(
+                jax.lax.dot_general(
+                    q_h, k_h, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+        s = jnp.concatenate(parts, axis=0) * scale  # [QH, page]
+
+        pos = j * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < seq_len, s, _NEG_INF)
+        if window is not None:
+            s = jnp.where(pos >= window_lo, s, _NEG_INF)
+
+        m_prev = m_scratch[...]
+        l_prev = l_scratch[...]
+        block_max = jnp.max(s, axis=1, keepdims=True)  # [QH, 1]
+        m_new = jnp.maximum(m_prev, jax.lax.broadcast_in_dim(
+            block_max, m_prev.shape, (0, 1)
+        ))
+        alpha = jnp.exp(m_prev[:, :1] - m_new[:, :1])  # [QH, 1]
+        p = jnp.exp(s - m_new[:, :1])  # [QH, page]
+        l_scratch[...] = jax.lax.broadcast_in_dim(
+            alpha * l_prev[:, :1] + jnp.sum(p, axis=1, keepdims=True),
+            l_prev.shape, (0, 1),
+        )
+        m_scratch[...] = m_new
+
+        parts_o = []
+        for h in range(kv_heads):
+            p_h = p[h * q_per_kv : (h + 1) * q_per_kv]  # [G, page]
+            v_h = v[:, h, :].astype(jnp.float32)  # [page, D]
+            parts_o.append(
+                jax.lax.dot_general(
+                    p_h, v_h, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+        acc_scratch[...] = acc_scratch[...] * alpha + jnp.concatenate(parts_o, axis=0)
+        return 0
+
+    jax.lax.fori_loop(first, num_live, body, 0)
+    denom = jnp.maximum(l_scratch[:, :1], 1e-30)
+    out_ref[0] = (acc_scratch[...] / denom).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "sliding_window"))
+def _paged_attention_pallas_v2(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    page_table: jax.Array,
+    lengths: jax.Array,
+    *,
+    interpret: bool = False,
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, qh, d = q.shape
+    _, page_size, kh, _ = k_pages.shape
+    scale = d**-0.5
+
+    kernel = functools.partial(
+        _paged_attn_kernel_v2,
+        kv_heads=kh,
+        q_per_kv=qh // kh,
+        page_size=page_size,
+        scale=scale,
+        window=sliding_window,
+    )
+    any_space = pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, qh, d), lambda b, pt, ln: (b, 0, 0)),
+            any_space,
+            any_space,
+        ],
+        out_specs=pl.BlockSpec((1, qh, d), lambda b, pt, ln: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, page_size, kh, d), k_pages.dtype),
+            pltpu.VMEM((2, page_size, kh, d), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.VMEM((qh, _LANE), jnp.float32),
+            pltpu.VMEM((qh, _LANE), jnp.float32),
+            pltpu.VMEM((qh, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, qh, d), jnp.float32),
+        interpret=interpret,
+    )(page_table, lengths, q, k_pages, v_pages)
+    return out.astype(q.dtype)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret", "sliding_window"))
 def _paged_attention_pallas(
     q: jax.Array,
@@ -306,6 +484,20 @@ def _paged_attention_pallas(
     return out.astype(q.dtype)
 
 
+def _kernel_version() -> str:
+    """Which Pallas kernel serves decode on TPU: "v1" (BlockSpec page grid,
+    every page slot DMA'd) or "v2" (in-kernel double-buffered DMA of live
+    pages only).  v1 stays default until v2 is validated on hardware.  Read
+    at call time so long-lived processes honour the env; unknown values
+    raise rather than silently benching the wrong kernel."""
+    version = os.environ.get("OPERATOR_TPU_PAGED_KERNEL", "v1").strip().lower()
+    if version not in ("v1", "v2"):
+        raise ValueError(
+            f"OPERATOR_TPU_PAGED_KERNEL={version!r}: expected 'v1' or 'v2'"
+        )
+    return version
+
+
 def paged_attention(
     q: jax.Array,
     k_pages: jax.Array,
@@ -318,7 +510,12 @@ def paged_attention(
     from ._dispatch import on_tpu
 
     if on_tpu(q, k_pages):
-        return _paged_attention_pallas(
+        impl = (
+            _paged_attention_pallas_v2
+            if _kernel_version() == "v2"
+            else _paged_attention_pallas
+        )
+        return impl(
             q, k_pages, v_pages, page_table, lengths, sliding_window=sliding_window
         )
     return paged_attention_reference(

@@ -5,7 +5,7 @@
 # and an xplane profile. Each step is individually timeboxed so one hang
 # doesn't kill the series.
 set -u
-OUT=${1:-/tmp/r3_experiments}
+OUT=$(realpath -m "${1:-/tmp/r3_experiments}")  # absolute BEFORE the cd below
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 
@@ -26,6 +26,8 @@ run bench_nopipe 900 env BENCH_OPEN=0 BENCH_PIPELINE=1 python bench.py
 run bench_page256 900 env BENCH_OPEN=0 BENCH_PAGE_SIZE=256 python bench.py
 # int8 weights: the bandwidth-halving claim, measured
 run bench_quant  900 env BENCH_OPEN=0 BENCH_QUANT=1 python bench.py
+# v2 paged kernel: in-kernel DMA of live pages only (vs v1 full-grid DMA)
+run bench_kernel_v2 900 env BENCH_OPEN=0 OPERATOR_TPU_PAGED_KERNEL=v2 python bench.py
 # literal BASELINE config 4: 32 slots, 32 concurrent arrivals -> one prefill
 run bench_slots32 900 env BENCH_OPEN=0 BENCH_SLOTS=32 python bench.py
 # north-star model class: llama-3-8b int8 (~8.2 GB) on the 16 GB chip

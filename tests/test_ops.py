@@ -132,6 +132,14 @@ def _dense_oracle(q, k_pages, v_pages, table, lengths):
     return out
 
 
+def _kernel_impls():
+    from operator_tpu.ops.paged_attention import (
+        _paged_attention_pallas,
+        _paged_attention_pallas_v2,
+    )
+    return {"v1": _paged_attention_pallas, "v2": _paged_attention_pallas_v2}
+
+
 class TestPagedAttention:
     @pytest.mark.parametrize(
         "batch,qh,kh,d,page_size,pages_per_seq,lengths",
@@ -161,8 +169,9 @@ class TestPagedAttention:
             (2, 32, 8, 128, 16, 2, [5, 32]),
         ],
     )
+    @pytest.mark.parametrize("impl", ["v1", "v2"])
     def test_kernel_parity(
-        self, batch, qh, kh, d, page_size, pages_per_seq, lengths
+        self, batch, qh, kh, d, page_size, pages_per_seq, lengths, impl
     ):
         q = jax.random.normal(jax.random.PRNGKey(2), (batch, qh, d), jnp.float32)
         k_pages, v_pages, table, lens = _make_paged(
@@ -170,13 +179,14 @@ class TestPagedAttention:
             kh, d, num_pages=batch * pages_per_seq + 1,
         )
         ref = paged_attention_reference(q, k_pages, v_pages, table, lens)
-        got = _paged_attention_pallas(
+        got = _kernel_impls()[impl](
             q, k_pages, v_pages, table, lens, interpret=True
         )
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-4)
 
+    @pytest.mark.parametrize("impl", ["v1", "v2"])
     @pytest.mark.parametrize("window", [8, 24, 1000])
-    def test_sliding_window_kernel_parity(self, window):
+    def test_sliding_window_kernel_parity(self, window, impl):
         """Windowed scores: kernel == reference == a trimmed full attention."""
         batch, qh, kh, d, page_size, pages_per_seq = 3, 8, 2, 128, 16, 4
         lengths = [10, 40, 64]
@@ -188,7 +198,7 @@ class TestPagedAttention:
         ref = paged_attention_reference(
             q, k_pages, v_pages, table, lens, sliding_window=window
         )
-        got = _paged_attention_pallas(
+        got = _kernel_impls()[impl](
             q, k_pages, v_pages, table, lens, interpret=True, sliding_window=window
         )
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-4)
@@ -206,7 +216,8 @@ class TestPagedAttention:
                     np.asarray(ref)[i, h], w @ flat_v[lo:n, h // g], atol=1e-4
                 )
 
-    def test_kernel_parity_bfloat16(self):
+    @pytest.mark.parametrize("impl", ["v1", "v2"])
+    def test_kernel_parity_bfloat16(self, impl):
         batch, qh, kh, d, page_size, pages_per_seq = 2, 8, 4, 128, 16, 3
         q = jax.random.normal(
             jax.random.PRNGKey(4), (batch, qh, d), jnp.float32
@@ -218,7 +229,7 @@ class TestPagedAttention:
         k_pages = k_pages.astype(jnp.bfloat16)
         v_pages = v_pages.astype(jnp.bfloat16)
         ref = paged_attention_reference(q, k_pages, v_pages, table, lens)
-        got = _paged_attention_pallas(
+        got = _kernel_impls()[impl](
             q, k_pages, v_pages, table, lens, interpret=True
         )
         np.testing.assert_allclose(
