@@ -31,6 +31,11 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
+from ..ops.flash_prefill import (
+    flash_prefill_attention,
+    flash_prefill_enabled,
+    flash_prefill_supported,
+)
 from .configs import ModelConfig
 from .quant import mm
 
@@ -289,6 +294,7 @@ def forward(
     kv_valid: Optional[jax.Array] = None,  # [B, S] validity override
     q_chunk: Optional[int] = None,  # explicit prefill chunk (tests)
     score_shards: int = 1,  # devices the batch axis is sharded over
+    prefill_lengths: Optional[jax.Array] = None,  # [B]; enables flash prefill
 ) -> tuple[jax.Array, Optional[KVCache]]:
     """One decoder pass.
 
@@ -326,7 +332,23 @@ def forward(
         if kv_valid is None:
             kv_valid = jnp.ones((b, t), bool)
 
-    if attn_mask is None:
+    # flash prefill (Pallas, gated): self-attention buckets where the kv
+    # range is exactly the q range and per-row validity is `pos < length`
+    # (kv_valid must be the caller's pos<lengths mask — required non-None so
+    # the no-cache all-ones default can never silently diverge from the
+    # kernel's length masking).  score_shards>1 means the bucket is sharded
+    # over a mesh: pallas_call has no SPMD rule here, so flash stays off.
+    use_flash = (
+        prefill_lengths is not None
+        and kv_valid is not None
+        and attn_mask is None
+        and score_shards == 1
+        and flash_prefill_enabled()
+        and flash_prefill_supported(t, max_seq, cache_offset)
+    )
+    if use_flash:
+        q_chunk = None
+    elif attn_mask is None:
         q_chunk = q_chunk or _pick_q_chunk(
             b, t, max_seq, config.num_heads, shards=score_shards
         )
@@ -365,7 +387,12 @@ def forward(
             new_cache = None
         k_att = k_all.astype(q.dtype)
         v_att = v_all.astype(q.dtype)
-        if q_chunk is not None:
+        if use_flash:
+            attn = flash_prefill_attention(
+                q, k_att, v_att, prefill_lengths,
+                sliding_window=config.sliding_window,
+            )
+        elif q_chunk is not None:
             attn = _attention_chunked(
                 q, k_att, v_att, positions, kv_positions, kv_valid, config, q_chunk
             )

@@ -1,0 +1,53 @@
+"""Shared online-softmax state update for the flash-style Pallas kernels.
+
+Both the paged decode kernel (paged_attention.py v2) and the prefill kernel
+(flash_prefill.py) keep running (max, denominator, accumulator) state in
+VMEM scratch and fold one masked score block in per step.  The update lives
+here once so a numerics fix (rescaling, the lane-broadcast layout, the
+denominator guard) cannot drift between them.
+
+State layout: ``m``/``l`` are ``[rows, LANE]`` float32 with the scalar
+duplicated across lanes (TPU vectors want a 128-wide last dim); ``acc`` is
+``[rows, D]`` float32.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+LANE = 128
+NEG_INF = -1e30
+
+
+def init_state(m_scratch, l_scratch, acc_scratch) -> None:
+    m_scratch[...] = jnp.full_like(m_scratch, NEG_INF)
+    l_scratch[...] = jnp.zeros_like(l_scratch)
+    acc_scratch[...] = jnp.zeros_like(acc_scratch)
+
+
+def update_state(m_scratch, l_scratch, acc_scratch, s, o_block) -> jax.Array:
+    """Fold one masked score block ``s`` [rows, block] into the running
+    state.  ``o_block(p)`` maps the [rows, block] probabilities to the
+    block's [rows, D] value contribution (the p @ V dot, shaped by the
+    caller).  Returns nothing useful; mutates the scratch refs."""
+    m_prev = m_scratch[...]
+    l_prev = l_scratch[...]
+    block_max = jnp.max(s, axis=1, keepdims=True)  # [rows, 1]
+    m_new = jnp.maximum(
+        m_prev, jax.lax.broadcast_in_dim(block_max, m_prev.shape, (0, 1))
+    )
+    alpha = jnp.exp(m_prev[:, :1] - m_new[:, :1])  # [rows, 1]
+    p = jnp.exp(s - m_new[:, :1])  # [rows, block]
+    l_scratch[...] = jax.lax.broadcast_in_dim(
+        alpha * l_prev[:, :1] + jnp.sum(p, axis=1, keepdims=True),
+        l_prev.shape, (0, 1),
+    )
+    m_scratch[...] = m_new
+    acc_scratch[...] = acc_scratch[...] * alpha + o_block(p)
+    return p
+
+
+def finalize(l_scratch, acc_scratch) -> jax.Array:
+    """acc / max(l, eps): zero rows (nothing attended) come out as zeros."""
+    return acc_scratch[...] / jnp.maximum(l_scratch[:, :1], 1e-30)

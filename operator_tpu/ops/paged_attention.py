@@ -154,6 +154,39 @@ def paged_attention_reference(
 # Pallas kernel
 # ---------------------------------------------------------------------------
 
+from ._flash_common import finalize, init_state, update_state  # noqa: E402
+
+
+def _gqa_scores(q, k, kv_heads: int, q_per_kv: int) -> jax.Array:
+    """[QH, D] q x [page, KH, D] k -> [QH, page] scores; GQA expanded via
+    per-kv-head dots so repeated KV never materialises."""
+    parts = []
+    for h in range(kv_heads):
+        q_h = q[h * q_per_kv : (h + 1) * q_per_kv]  # [G, D]
+        k_h = k[:, h, :].astype(jnp.float32)  # [page, D]
+        parts.append(
+            jax.lax.dot_general(
+                q_h, k_h, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        )
+    return jnp.concatenate(parts, axis=0)
+
+
+def _gqa_values(p, v, kv_heads: int, q_per_kv: int) -> jax.Array:
+    """[QH, page] probabilities x [page, KH, D] v -> [QH, D]."""
+    parts = []
+    for h in range(kv_heads):
+        p_h = p[h * q_per_kv : (h + 1) * q_per_kv]  # [G, page]
+        v_h = v[:, h, :].astype(jnp.float32)  # [page, D]
+        parts.append(
+            jax.lax.dot_general(
+                p_h, v_h, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        )
+    return jnp.concatenate(parts, axis=0)
+
 
 def _paged_attn_kernel(
     # scalar prefetch
@@ -183,9 +216,7 @@ def _paged_attn_kernel(
 
     @pl.when(j == 0)
     def _init():
-        m_scratch[...] = jnp.full_like(m_scratch, _NEG_INF)
-        l_scratch[...] = jnp.zeros_like(l_scratch)
-        acc_scratch[...] = jnp.zeros_like(acc_scratch)
+        init_state(m_scratch, l_scratch, acc_scratch)
 
     seq_len = len_ref[b]
 
@@ -202,54 +233,20 @@ def _paged_attn_kernel(
         k = k_ref[0]  # [page, KH, D]
         v = v_ref[0]
 
-        # scores [QH, page]: per-kv-head matmuls, GQA expanded in-register
-        parts = []
-        for h in range(kv_heads):
-            q_h = q[h * q_per_kv : (h + 1) * q_per_kv]  # [G, D]
-            k_h = k[:, h, :].astype(jnp.float32)  # [page, D]
-            parts.append(
-                jax.lax.dot_general(
-                    q_h, k_h, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-            )
-        s = jnp.concatenate(parts, axis=0) * scale  # [QH, page]
-
+        s = _gqa_scores(q, k, kv_heads, q_per_kv) * scale  # [QH, page]
         pos = j * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(pos < seq_len, s, _NEG_INF)
         if window is not None:
             s = jnp.where(pos >= window_lo, s, _NEG_INF)
 
-        m_prev = m_scratch[...]  # [QH, LANE]
-        l_prev = l_scratch[...]
-        block_max = jnp.max(s, axis=1, keepdims=True)  # [QH, 1]
-        m_new = jnp.maximum(m_prev, jax.lax.broadcast_in_dim(
-            block_max, m_prev.shape, (0, 1)
-        ))
-        alpha = jnp.exp(m_prev[:, :1] - m_new[:, :1])  # [QH, 1]
-        p = jnp.exp(s - m_new[:, :1])  # [QH, page]
-
-        l_new = alpha * l_prev[:, :1] + jnp.sum(p, axis=1, keepdims=True)
-        l_scratch[...] = jax.lax.broadcast_in_dim(l_new, l_prev.shape, (0, 1))
-        m_scratch[...] = m_new
-
-        parts_o = []
-        for h in range(kv_heads):
-            p_h = p[h * q_per_kv : (h + 1) * q_per_kv]  # [G, page]
-            v_h = v[:, h, :].astype(jnp.float32)  # [page, D]
-            parts_o.append(
-                jax.lax.dot_general(
-                    p_h, v_h, (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-            )
-        o = jnp.concatenate(parts_o, axis=0)  # [QH, D]
-        acc_scratch[...] = acc_scratch[...] * alpha + o
+        update_state(
+            m_scratch, l_scratch, acc_scratch, s,
+            lambda p: _gqa_values(p, v, kv_heads, q_per_kv),
+        )
 
     @pl.when(j == num_pages - 1)
     def _finish():
-        denom = jnp.maximum(l_scratch[:, :1], 1e-30)
-        out_ref[0] = (acc_scratch[...] / denom).astype(out_ref.dtype)
+        out_ref[0] = finalize(l_scratch, acc_scratch).astype(out_ref.dtype)
 
 
 def _paged_attn_kernel_v2(
@@ -296,9 +293,7 @@ def _paged_attn_kernel_v2(
         window_lo = jnp.maximum(seq_len - window, 0)
         first = window_lo // page_size
 
-    m_scratch[...] = jnp.full_like(m_scratch, _NEG_INF)
-    l_scratch[...] = jnp.zeros_like(l_scratch)
-    acc_scratch[...] = jnp.zeros_like(acc_scratch)
+    init_state(m_scratch, l_scratch, acc_scratch)
 
     def dma(slot, j):
         return (
@@ -326,53 +321,20 @@ def _paged_attn_kernel_v2(
         k = k_buf[slot]  # [page, KH, D]
         v = v_buf[slot]
 
-        parts = []
-        for h in range(kv_heads):
-            q_h = q[h * q_per_kv : (h + 1) * q_per_kv]  # [G, D]
-            k_h = k[:, h, :].astype(jnp.float32)  # [page, D]
-            parts.append(
-                jax.lax.dot_general(
-                    q_h, k_h, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-            )
-        s = jnp.concatenate(parts, axis=0) * scale  # [QH, page]
-
+        s = _gqa_scores(q, k, kv_heads, q_per_kv) * scale  # [QH, page]
         pos = j * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(pos < seq_len, s, _NEG_INF)
         if window is not None:
             s = jnp.where(pos >= window_lo, s, _NEG_INF)
 
-        m_prev = m_scratch[...]
-        l_prev = l_scratch[...]
-        block_max = jnp.max(s, axis=1, keepdims=True)  # [QH, 1]
-        m_new = jnp.maximum(m_prev, jax.lax.broadcast_in_dim(
-            block_max, m_prev.shape, (0, 1)
-        ))
-        alpha = jnp.exp(m_prev[:, :1] - m_new[:, :1])  # [QH, 1]
-        p = jnp.exp(s - m_new[:, :1])  # [QH, page]
-        l_scratch[...] = jax.lax.broadcast_in_dim(
-            alpha * l_prev[:, :1] + jnp.sum(p, axis=1, keepdims=True),
-            l_prev.shape, (0, 1),
+        update_state(
+            m_scratch, l_scratch, acc_scratch, s,
+            lambda p: _gqa_values(p, v, kv_heads, q_per_kv),
         )
-        m_scratch[...] = m_new
-
-        parts_o = []
-        for h in range(kv_heads):
-            p_h = p[h * q_per_kv : (h + 1) * q_per_kv]  # [G, page]
-            v_h = v[:, h, :].astype(jnp.float32)  # [page, D]
-            parts_o.append(
-                jax.lax.dot_general(
-                    p_h, v_h, (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-            )
-        acc_scratch[...] = acc_scratch[...] * alpha + jnp.concatenate(parts_o, axis=0)
         return 0
 
     jax.lax.fori_loop(first, num_live, body, 0)
-    denom = jnp.maximum(l_scratch[:, :1], 1e-30)
-    out_ref[0] = (acc_scratch[...] / denom).astype(out_ref.dtype)
+    out_ref[0] = finalize(l_scratch, acc_scratch).astype(out_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "sliding_window"))
@@ -488,8 +450,10 @@ def _kernel_version() -> str:
     """Which Pallas kernel serves decode on TPU: "v1" (BlockSpec page grid,
     every page slot DMA'd) or "v2" (in-kernel double-buffered DMA of live
     pages only).  v1 stays default until v2 is validated on hardware.  Read
-    at call time so long-lived processes honour the env; unknown values
-    raise rather than silently benching the wrong kernel."""
+    when a program is TRACED — already-compiled buckets keep whatever kernel
+    they were built with, so set the env before the process starts rather
+    than flipping it mid-flight.  Unknown values raise rather than silently
+    benching the wrong kernel."""
     version = os.environ.get("OPERATOR_TPU_PAGED_KERNEL", "v1").strip().lower()
     if version not in ("v1", "v2"):
         raise ValueError(

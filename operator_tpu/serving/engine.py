@@ -435,6 +435,7 @@ class BatchedGenerator:
             logits, mini = forward(
                 params, config, token_ids, positions, cache=mini,
                 cache_offset=0, kv_valid=kv_valid, score_shards=score_shards,
+                prefill_lengths=lengths,
             )
             # scatter the prompt KV into the big cache rows for these slots
             # (slot axis is axis 1 of [L, B, S, KH, D])
@@ -478,6 +479,7 @@ class BatchedGenerator:
             logits, mini = forward(
                 params, config, token_ids, positions, cache=mini,
                 cache_offset=0, kv_valid=kv_valid, score_shards=score_shards,
+                prefill_lengths=lengths,
             )
             zero = jnp.zeros((n_pad,), jnp.int32)
             scatter = jax.vmap(write_tokens, in_axes=(0, None, 0, None, None))

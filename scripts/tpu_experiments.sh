@@ -28,6 +28,8 @@ run bench_page256 900 env BENCH_OPEN=0 BENCH_PAGE_SIZE=256 python bench.py
 run bench_quant  900 env BENCH_OPEN=0 BENCH_QUANT=1 python bench.py
 # v2 paged kernel: in-kernel DMA of live pages only (vs v1 full-grid DMA)
 run bench_kernel_v2 900 env BENCH_OPEN=0 OPERATOR_TPU_PAGED_KERNEL=v2 python bench.py
+# flash prefill kernel (Pallas) instead of dense/chunked XLA prefill
+run bench_flash  900 env BENCH_OPEN=0 OPERATOR_TPU_FLASH_PREFILL=1 python bench.py
 # literal BASELINE config 4: 32 slots, 32 concurrent arrivals -> one prefill
 run bench_slots32 900 env BENCH_OPEN=0 BENCH_SLOTS=32 python bench.py
 # north-star model class: llama-3-8b int8 (~8.2 GB) on the 16 GB chip

@@ -263,3 +263,108 @@ class TestWriteTokens:
         assert cache.page_size == 4
         leaves = jax.tree_util.tree_leaves(cache)
         assert len(leaves) == 4
+
+
+class TestFlashPrefill:
+    """Flash prefill kernel (interpret mode) vs the dense oracle vs the
+    model's own masked attention — ragged lengths, GQA, sliding windows."""
+
+    def _inputs(self, key, b, t, qh, kh, d, lengths, dtype=jnp.float32):
+        ks = jax.random.split(key, 3)
+        q = jax.random.normal(ks[0], (b, t, qh, d), jnp.float32).astype(dtype)
+        k = jax.random.normal(ks[1], (b, t, kh, d), jnp.float32).astype(dtype)
+        v = jax.random.normal(ks[2], (b, t, kh, d), jnp.float32).astype(dtype)
+        return q, k, v, jnp.asarray(lengths, jnp.int32)
+
+    def test_reference_matches_model_attention(self):
+        from operator_tpu.models.llama import _attention, make_causal_mask
+        from operator_tpu.models.configs import TINY_TEST as cfg
+        from operator_tpu.ops.flash_prefill import flash_prefill_reference
+
+        b, t = 2, 32
+        q, k, v, lens = self._inputs(
+            jax.random.PRNGKey(0), b, t, cfg.num_heads, cfg.num_kv_heads,
+            cfg.head_dim, [32, 13],
+        )
+        pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
+        mask = make_causal_mask(pos, pos, pos < lens[:, None])
+        want = _attention(q, k, v, mask, cfg)
+        got = flash_prefill_reference(q, k, v, lens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    @pytest.mark.parametrize(
+        "b,t,qh,kh,d,lengths",
+        [
+            (2, 128, 8, 2, 128, [128, 40]),
+            (3, 256, 4, 4, 64, [1, 200, 256]),
+            (1, 64, 32, 8, 128, [50]),
+        ],
+    )
+    def test_kernel_parity(self, b, t, qh, kh, d, lengths):
+        from operator_tpu.ops.flash_prefill import (
+            _flash_prefill_pallas, flash_prefill_reference)
+
+        q, k, v, lens = self._inputs(jax.random.PRNGKey(1), b, t, qh, kh, d, lengths)
+        ref = flash_prefill_reference(q, k, v, lens)
+        got = _flash_prefill_pallas(q, k, v, lens, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-4)
+
+    @pytest.mark.parametrize("window", [16, 100])
+    def test_kernel_parity_sliding_window(self, window):
+        from operator_tpu.ops.flash_prefill import (
+            _flash_prefill_pallas, flash_prefill_reference)
+
+        q, k, v, lens = self._inputs(
+            jax.random.PRNGKey(2), 2, 256, 8, 2, 64, [256, 180])
+        ref = flash_prefill_reference(q, k, v, lens, sliding_window=window)
+        got = _flash_prefill_pallas(
+            q, k, v, lens, interpret=True, sliding_window=window)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-4)
+
+    def test_kernel_parity_bf16_small_blocks(self):
+        from operator_tpu.ops.flash_prefill import (
+            _flash_prefill_pallas, flash_prefill_reference)
+
+        q, k, v, lens = self._inputs(
+            jax.random.PRNGKey(3), 2, 128, 8, 4, 64, [77, 128], dtype=jnp.bfloat16)
+        ref = flash_prefill_reference(q, k, v, lens)
+        got = _flash_prefill_pallas(
+            q, k, v, lens, interpret=True, q_block=32, kv_block=64)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(ref, np.float32), atol=5e-2)
+
+    def test_supported_gate(self):
+        from operator_tpu.ops.flash_prefill import flash_prefill_supported
+
+        assert flash_prefill_supported(128, 128, 0)
+        assert flash_prefill_supported(64, 64, 0)
+        assert not flash_prefill_supported(128, 1024, 0)  # kv range != q range
+        assert not flash_prefill_supported(1, 1, 0)  # decode
+        assert not flash_prefill_supported(128, 128, jnp.zeros((2,), jnp.int32))
+        assert not flash_prefill_supported(192, 192, 0)  # not block-divisible
+
+    def test_forward_gate_engages_and_matches(self, monkeypatch):
+        """With the env gate on, forward takes the flash path (reference impl
+        on CPU) and the result matches the gated-off forward."""
+        from operator_tpu.models import TINY_TEST, init_params
+        from operator_tpu.models.llama import KVCache, forward
+
+        monkeypatch.setenv("OPERATOR_TPU_FLASH_PREFILL", "1")
+        config = TINY_TEST
+        params = init_params(config, jax.random.PRNGKey(0))
+        b, t = 2, 64
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (b, t), 0, config.vocab_size, dtype=jnp.int32)
+        pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
+        lens = jnp.asarray([64, 30], jnp.int32)
+        kv_valid = pos < lens[:, None]
+        on, cache_a = forward(
+            params, config, tokens, pos, cache=KVCache.create(config, b, t),
+            cache_offset=0, kv_valid=kv_valid, prefill_lengths=lens)
+        monkeypatch.setenv("OPERATOR_TPU_FLASH_PREFILL", "0")
+        off, cache_b = forward(
+            params, config, tokens, pos, cache=KVCache.create(config, b, t),
+            cache_offset=0, kv_valid=kv_valid, prefill_lengths=lens)
+        np.testing.assert_allclose(np.asarray(on), np.asarray(off), atol=0.05)
+        np.testing.assert_allclose(
+            np.asarray(cache_a.k), np.asarray(cache_b.k), atol=1e-6)
