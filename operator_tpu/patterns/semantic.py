@@ -93,14 +93,30 @@ class HashingEmbedder:
     overlap — strong enough to pair "OOMKilled exit code 137" with a
     pattern anchored on "container killed out of memory 137", with zero
     model weights.  Lexical overlap lives at line granularity, so the
-    default windows are small (``default_window_lines``); thresholds are
-    calibrated on the fixture logs (tests/test_semantic.py keeps them
-    honest).
+    default windows are small (``default_window_lines``); the threshold is
+    calibrated against the 12-fixture failure corpus: 0.3 keeps every
+    paraphrase recall (tests/test_corpus.py::TestSemanticCalibration) while
+    rejecting the strongest observed cross-class overlap (0.2-range hits
+    from generic words like "container"/"failed" shared across classes).
     """
 
-    default_threshold = 0.2
+    default_threshold = 0.3
     default_window_lines = 4
     default_stride = 2
+
+    #: tokens so common across failure classes (and English) that their
+    #: n-grams carry no class signal — every k8s log and every pattern
+    #: anchor says "container"/"failed"/"error".  Stripped SYMMETRICALLY
+    #: from pattern anchors and log windows before hashing, so similarity
+    #: is driven by the distinctive vocabulary (OOMKilled, init, heap,
+    #: x509, resolv...).  The neural path embeds the raw text — this list
+    #:  is a lexical-embedder concern only.
+    GENERIC_TOKENS = frozenset(
+        """container containers fail failed failure failures error errors
+        pod pods status exit exited code warning restarting restart kubelet
+        terminated reason process the a an was were with and for of to in
+        is are so not never main after before during""".split()
+    )
 
     def __init__(self, dim: int = 384, ngram_sizes: tuple[int, ...] = (3, 4, 5)) -> None:
         self.dim = dim
@@ -108,7 +124,11 @@ class HashingEmbedder:
 
     def _features(self, text: str) -> np.ndarray:
         vec = np.zeros(self.dim, np.float32)
-        normalized = " ".join(text.lower().split())
+        tokens = [
+            t for t in re.split(r"[^a-z0-9]+", text.lower())
+            if t and t not in self.GENERIC_TOKENS
+        ]
+        normalized = " ".join(tokens)
         data = normalized.encode("utf-8", errors="replace")
         for n in self.ngram_sizes:
             if len(data) < n:

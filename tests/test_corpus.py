@@ -92,3 +92,89 @@ def test_expected_class_fires_somewhere(engine):
     }
     missing = required - fired_anywhere
     assert not missing, f"classes never firing in the corpus: {missing}"
+
+
+class TestSemanticCalibration:
+    """The hashing-embedder semantic path calibrated against the full
+    corpus (VERDICT r2 weak #8): with the engine's default threshold it
+    must not cross-fire unrelated classes on ANY fixture, and it must
+    recall a paraphrased failure the regexes cannot see."""
+
+    # classes semantically adjacent to a fixture's true class — lexical
+    # overlap ("memory", "probe", "image"...) makes these legitimate
+    # sub-threshold-adjacent hits, not cross-fires
+    RELATED = {
+        "oom_java.log": {"oom-killed", "java-heap-oom", "pod-evicted"},
+        "eviction.log": {"pod-evicted", "oom-killed", "disk-full"},
+        "disk_full.log": {"disk-full", "pod-evicted"},
+        "python_module.log": {"python-module-missing", "python-traceback",
+                              "java-class-not-found"},
+        "go_panic.log": {"segfault", "python-traceback", "java-npe"},
+        "dns_failure.log": {"dns-failure", "db-connection-refused"},
+        "db_connection_refused.log": {"db-connection-refused", "dns-failure"},
+        "init_container_config.log": {"init-container-failure",
+                                      "crashloop-backoff", "config-missing"},
+        "crashloop_quarkus.log": {"crashloop-backoff", "port-conflict",
+                                  "config-missing", "java-class-not-found"},
+        "image_pull_backoff.log": {"image-pull-failure", "crashloop-backoff"},
+        "liveness_probe.log": {"liveness-probe-failure"},
+        "tls_cert.log": {"tls-certificate"},
+    }
+
+    @pytest.fixture(scope="class")
+    def semantic_engine(self):
+        return PatternEngine(semantic=True)
+
+    @pytest.mark.parametrize("fixture", sorted(MATRIX))
+    def test_no_semantic_cross_fire(self, semantic_engine, fixture):
+        with open(os.path.join(FIXTURES, fixture)) as f:
+            result = semantic_engine.analyze(PodFailureData(logs=f.read()))
+        semantic_ids = {
+            e.matched_pattern.id for e in result.events if e.source == "semantic"
+        }
+        allowed = self.RELATED[fixture]
+        stray = semantic_ids - allowed
+        assert not stray, f"{fixture}: semantic path cross-fired {stray}"
+
+    # paraphrased failure reports with no regex-matchable phrasing; the
+    # lexical embedder recalls them through the distinctive shared
+    # vocabulary (kernel/heap, registry/tag, resolv/hostname, x509...).
+    # Each entry pins recall for one class at the default threshold, so a
+    # future threshold bump that kills recall fails HERE, not in prod.
+    PARAPHRASES = {
+        ("oom-killed", "java-heap-oom"):
+            "kernel killed the java process after its memory was exhausted; "
+            "heap allocation kept failing",
+        ("image-pull-failure",):
+            "the node could not fetch the requested image tag from the "
+            "registry repository",
+        ("dns-failure",):
+            "lookups of the service hostname kept failing; resolv and "
+            "coredns settings look wrong",
+        ("pod-evicted",):
+            "the kubelet removed the workload because the node ran low on "
+            "resources",
+        ("tls-certificate",):
+            "the https handshake was rejected because the x509 certificate "
+            "chain is untrusted",
+        ("db-connection-refused",):
+            "the backend postgres endpoint refused tcp connections during "
+            "startup",
+        ("disk-full",):
+            "the filesystem volume filled up and new writes were rejected",
+        ("segfault",):
+            "the binary crashed with a segmentation violation and dumped core",
+    }
+
+    @pytest.mark.parametrize("want", sorted(PARAPHRASES), ids=lambda w: w[0])
+    def test_semantic_recalls_paraphrase(self, semantic_engine, want):
+        """Eight classes' paraphrases must each surface their own class as
+        the TOP semantic match at the default threshold."""
+        result = semantic_engine.analyze(
+            PodFailureData(logs=self.PARAPHRASES[want])
+        )
+        semantic = [e for e in result.events if e.source == "semantic"]
+        assert semantic, f"{want}: nothing cleared the semantic threshold"
+        top = max(semantic, key=lambda e: e.score)
+        assert top.matched_pattern.id in want, (
+            want, [(e.matched_pattern.id, e.score) for e in semantic])

@@ -241,7 +241,11 @@ class TestSemanticMatcher:
         assert m.num_patterns > 0
 
     def test_oom_log_matches_semantically(self, oom_log):
-        m = self._matcher()
+        # explicit sub-default threshold: this test pins RANKING (memory
+        # classes top on an OOM log); the default-threshold calibration
+        # (cross-fire/recall margins) lives in tests/test_corpus.py
+        m = SemanticMatcher(HashingEmbedder(), threshold=0.2)
+        m.rebuild([load_builtin_library()])
         events = m.match(oom_log.splitlines())
         assert events, "expected at least one semantic match on the OOM fixture"
         ids = [e.matched_pattern.id for e in events]
