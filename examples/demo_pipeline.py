@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""End-to-end demo on a laptop: the full Podmortem pipeline, no cluster.
+
+Drives the REAL control plane (watcher -> pattern match -> explanation ->
+storage -> events) against the in-memory fake Kubernetes API, with the
+tpu-native serving engine generating the explanation on whatever backend
+jax has (CPU here; the same code serves from TPU HBM in production).
+
+    python examples/demo_pipeline.py [fixture.log] [--tpu-native]
+
+By default explanations come from the deterministic template provider
+(readable without model weights).  --tpu-native routes through the real
+continuous-batching serving engine instead — with random weights the
+text is token noise; mount a checkpoint (CHECKPOINT_DIR) for real output.
+
+Prints the K8s Events and the Podmortem CR status the operator would have
+written to a live cluster — the system's user-facing result channel
+(reference EventService.java:45-128, AnalysisStorageService.java:60).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # demo runs anywhere
+
+from operator_tpu.models import TINY_TEST, init_params
+from operator_tpu.models.tokenizer import load_tokenizer
+from operator_tpu.operator import (
+    AnalysisPipeline,
+    FakeKubeApi,
+    PodFailureWatcher,
+    PodmortemCache,
+    default_registry,
+)
+from operator_tpu.patterns import PatternEngine
+from operator_tpu.schema import (
+    AIProvider,
+    AIProviderRef,
+    AIProviderSpec,
+    ContainerState,
+    ContainerStateTerminated,
+    ContainerStatus,
+    LabelSelector,
+    ObjectMeta,
+    Pod,
+    Podmortem,
+    PodmortemSpec,
+    PodStatus,
+)
+from operator_tpu.serving.engine import BatchedGenerator, ServingEngine
+from operator_tpu.serving.provider import TPUNativeProvider
+from operator_tpu.utils.config import OperatorConfig
+from operator_tpu.utils.timing import MetricsRegistry
+
+
+async def main(log_path: str, use_tpu_native: bool = False) -> None:
+    with open(log_path) as f:
+        pod_log = f.read()
+
+    api = FakeKubeApi()
+    config = OperatorConfig(pattern_cache_directory="/nonexistent")
+    engine = PatternEngine(semantic=True)
+    metrics = MetricsRegistry()
+
+    providers = default_registry()
+    serving = None
+    if use_tpu_native:
+        # tpu-native provider over the tiny demo model.  NOTE: random
+        # weights, so the "explanation" is token noise — in production a
+        # checkpoint is mounted and the provider refuses to run without one
+        # unless ALLOW_RANDOM_WEIGHTS is set (serving/provider.py).
+        generator = BatchedGenerator(
+            init_params(TINY_TEST, jax.random.PRNGKey(0)), TINY_TEST,
+            load_tokenizer(None), max_slots=2, max_seq=256,
+        )
+        serving = ServingEngine(generator)
+        providers.register(
+            "tpu-native", TPUNativeProvider(serving, model_id=TINY_TEST.name)
+        )
+    provider_id = "tpu-native" if use_tpu_native else "template"
+
+    pipeline = AnalysisPipeline(api, engine, config=config, metrics=metrics,
+                                providers=providers)
+    cache = PodmortemCache(api)
+    watcher = PodFailureWatcher(api, pipeline, config=config, metrics=metrics,
+                                cache=cache)
+
+    # a user's AIProvider CR routing to the in-process TPU engine, and a
+    # Podmortem CR watching app=web pods
+    await api.create("AIProvider", AIProvider(
+        metadata=ObjectMeta(name="tpu", namespace="prod"),
+        spec=AIProviderSpec(provider_id=provider_id, model_id=TINY_TEST.name),
+    ).to_dict())
+    await api.create("Podmortem", Podmortem(
+        metadata=ObjectMeta(name="demo", namespace="prod"),
+        spec=PodmortemSpec(
+            pod_selector=LabelSelector(match_labels={"app": "web"}),
+            ai_provider_ref=AIProviderRef(name="tpu", namespace="prod"),
+        ),
+    ).to_dict())
+    await cache.prime()
+
+    # ... and a pod that just failed
+    pod = Pod(
+        metadata=ObjectMeta(name="web-1", namespace="prod", labels={"app": "web"}),
+        status=PodStatus(phase="Running", container_statuses=[ContainerStatus(
+            name="app", restart_count=3,
+            state=ContainerState(terminated=ContainerStateTerminated(
+                exit_code=1, reason="Error",
+                finished_at="2026-07-30T01:00:00Z")),
+        )]),
+    )
+    await api.create("Pod", pod.to_dict())
+    api.set_pod_log("prod", "web-1", pod_log)
+
+    launched = await watcher.handle_pod_event("MODIFIED", pod)
+    print(f"watcher matched {launched} Podmortem CR(s); analyzing...\n")
+    await watcher.drain()
+    if serving is not None:
+        await serving.close()
+
+    print("=== Kubernetes Events the operator emitted ===")
+    for event in await api.list("Event"):
+        reason = event.get("reason")
+        target = (event.get("regarding") or {}).get("kind")
+        note = (event.get("note") or "").strip()
+        print(f"[{event.get('type')}] {reason} -> {target}\n    {note[:300]}\n")
+
+    status = (await api.get("Podmortem", "demo", "prod"))["status"]
+    print("=== Podmortem CR status.recentFailures ===")
+    for failure in status.get("recentFailures", []):
+        print(f"pod={failure.get('podName')} status={failure.get('analysisStatus')}")
+        print(f"    {(failure.get('explanation') or '')[:300]}")
+
+    annotations = (await api.get("Pod", "web-1", "prod"))["metadata"].get(
+        "annotations", {})
+    print("\n=== Pod annotations ===")
+    for key, value in annotations.items():
+        print(f"{key}: {value[:160]}")
+
+
+if __name__ == "__main__":
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    fixture = args[0] if args else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests", "fixtures", "oom_java.log",
+    )
+    try:
+        asyncio.run(main(fixture, use_tpu_native="--tpu-native" in sys.argv))
+    except BrokenPipeError:
+        pass

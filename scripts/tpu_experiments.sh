@@ -24,6 +24,8 @@ run bench_main   1500 env BENCH_OPEN_SECONDS=60 python bench.py
 run bench_nopipe 900 env BENCH_OPEN=0 BENCH_PIPELINE=1 python bench.py
 # bigger pages: 4x fewer grid steps in the paged kernel
 run bench_page256 900 env BENCH_OPEN=0 BENCH_PAGE_SIZE=256 python bench.py
+# contiguous cache: is paging costing anything at bench shapes?
+run bench_contig 900 env BENCH_OPEN=0 BENCH_PAGED=0 python bench.py
 # int8 weights: the bandwidth-halving claim, measured
 run bench_quant  900 env BENCH_OPEN=0 BENCH_QUANT=1 python bench.py
 # v2 paged kernel: in-kernel DMA of live pages only (vs v1 full-grid DMA)

@@ -121,6 +121,10 @@ class TestSemanticCalibration:
         "tls_cert.log": {"tls-certificate"},
     }
 
+    def test_related_covers_matrix(self):
+        assert set(self.RELATED) == set(MATRIX), (
+            "every fixture needs a semantic-calibration RELATED entry")
+
     @pytest.fixture(scope="class")
     def semantic_engine(self):
         return PatternEngine(semantic=True)
