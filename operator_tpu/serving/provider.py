@@ -167,6 +167,8 @@ def build_tpu_native_provider(
         kv_pages=config.kv_pages or None,
         mesh=mesh,
         decode_block=config.decode_block,
+        pipeline_depth=config.pipeline_depth,
+        sample_top_k=config.sample_top_k,
     )
     engine = ServingEngine(generator)
     return TPUNativeProvider(engine, model_id=model_id)

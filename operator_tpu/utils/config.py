@@ -65,6 +65,13 @@ class OperatorConfig:
     # decode steps fused per host round-trip (serving/engine.py): hides host
     # latency on K-1 of K tokens; admissions join at block boundaries
     decode_block: int = 4
+    # decode-ahead lookahead (serving/engine.py step()): blocks left in
+    # flight while the host processes older tokens; 2 hides the per-block
+    # host<->device round trip, 1 = synchronous
+    pipeline_depth: int = 2
+    # nucleus-sampling candidate set (engine SAMPLE_TOP_K): top-p filtering
+    # runs inside the top-k — raise for high-temperature diversity
+    sample_top_k: int = 64
     # "bf16" or "int8" (weight-only per-channel quant, models/quant.py):
     # int8 halves HBM weight traffic — decode at serving batch sizes is
     # bandwidth-bound, and it fits Mistral-7B per chip on v5e (config 5)
