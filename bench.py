@@ -213,17 +213,20 @@ def main() -> None:
 
     config = get_config(model_name)
     t0 = time.perf_counter()
-    # one jitted program: eager per-op dispatch compiles dozens of tiny
-    # programs, which is pathologically slow over a tunneled TPU backend
-    init = jax.jit(lambda key: init_params(config, key, dtype=jnp.bfloat16))
-    params = jax.block_until_ready(init(jax.random.PRNGKey(0)))
     quant = os.environ.get("BENCH_QUANT", "0") == "1"
     if quant:
-        from operator_tpu.models.quant import quantize_params
+        # per-matrix init+quantize: never materialises the float tree, so
+        # an 8B int8 bench fits the 16 GB chip (bf16 init alone would OOM)
+        from operator_tpu.models.quant import init_params_quantized
 
         params = jax.block_until_ready(
-            jax.jit(lambda p: quantize_params(p, config))(params)
+            init_params_quantized(config, jax.random.PRNGKey(0))
         )
+    else:
+        # one jitted program: eager per-op dispatch compiles dozens of tiny
+        # programs, which is pathologically slow over a tunneled TPU backend
+        init = jax.jit(lambda key: init_params(config, key, dtype=jnp.bfloat16))
+        params = jax.block_until_ready(init(jax.random.PRNGKey(0)))
     log(f"params initialised in {time.perf_counter() - t0:.1f}s (int8={quant})")
 
     paged = os.environ.get("BENCH_PAGED", "1") == "1"

@@ -47,38 +47,55 @@ Params = dict[str, Any]
 # --------------------------------------------------------------------------
 
 
+def layer_matrix_shapes(config: ModelConfig) -> dict[str, tuple[int, int, int]]:
+    """Stacked shapes of the seven per-layer matrices, in the canonical
+    order the init key-split follows (shared with quant.init_params_quantized
+    so the two init paths can never drift structurally)."""
+    h, f = config.hidden_size, config.intermediate_size
+    kvh, qh, d = config.num_kv_heads, config.num_heads, config.head_dim
+    n = config.num_layers
+    return {
+        "wq": (n, h, qh * d),
+        "wk": (n, h, kvh * d),
+        "wv": (n, h, kvh * d),
+        "wo": (n, qh * d, h),
+        "w_gate": (n, h, f),
+        "w_up": (n, h, f),
+        "w_down": (n, f, h),
+    }
+
+
+def dense_init(
+    key: jax.Array, shape: tuple[int, ...], fallback_fan_in: int, dtype: jnp.dtype
+) -> jax.Array:
+    """Normal init scaled by fan-in (the second-to-last axis)."""
+    scale = (shape[-2] if len(shape) >= 2 else fallback_fan_in) ** -0.5
+    return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+
 def init_params(
     config: ModelConfig, key: jax.Array, dtype: jnp.dtype = jnp.bfloat16
 ) -> Params:
     """Random init with per-layer params stacked on axis 0 for lax.scan."""
     k_embed, k_layers, k_head = jax.random.split(key, 3)
-    h, f = config.hidden_size, config.intermediate_size
-    kvh, qh, d = config.num_kv_heads, config.num_heads, config.head_dim
+    h = config.hidden_size
     n = config.num_layers
 
-    def dense(key: jax.Array, shape: tuple[int, ...]) -> jax.Array:
-        scale = (shape[-2] if len(shape) >= 2 else h) ** -0.5
-        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
-
-    keys = jax.random.split(k_layers, 7)
-    layers = {
-        "wq": dense(keys[0], (n, h, qh * d)),
-        "wk": dense(keys[1], (n, h, kvh * d)),
-        "wv": dense(keys[2], (n, h, kvh * d)),
-        "wo": dense(keys[3], (n, qh * d, h)),
-        "w_gate": dense(keys[4], (n, h, f)),
-        "w_up": dense(keys[5], (n, h, f)),
-        "w_down": dense(keys[6], (n, f, h)),
-        "ln_attn": jnp.ones((n, h), dtype),
-        "ln_mlp": jnp.ones((n, h), dtype),
+    shapes = layer_matrix_shapes(config)
+    keys = jax.random.split(k_layers, len(shapes))
+    layers: dict[str, jax.Array] = {
+        name: dense_init(k, shape, h, dtype)
+        for k, (name, shape) in zip(keys, shapes.items())
     }
+    layers["ln_attn"] = jnp.ones((n, h), dtype)
+    layers["ln_mlp"] = jnp.ones((n, h), dtype)
     params: Params = {
-        "embed": dense(k_embed, (config.vocab_size, h)),
+        "embed": dense_init(k_embed, (config.vocab_size, h), h, dtype),
         "layers": layers,
         "ln_final": jnp.ones((h,), dtype),
     }
     if not config.tie_embeddings:
-        params["lm_head"] = dense(k_head, (h, config.vocab_size))
+        params["lm_head"] = dense_init(k_head, (h, config.vocab_size), h, dtype)
     return params
 
 

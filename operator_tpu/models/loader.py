@@ -176,20 +176,43 @@ def load_params(
     config: ModelConfig,
     dtype: jnp.dtype = jnp.bfloat16,
     *,
-    shardings: Optional[Mapping[str, jax.sharding.Sharding]] = None,
+    shardings: Optional[Mapping[str, Any]] = None,
+    quantize: bool = False,
 ) -> Params:
     """Load a HF Llama checkpoint directory onto device.
 
     ``shardings`` optionally maps our param names (embed/lm_head/ln_final or
     stacked layer names wq/wk/...) to ``jax.sharding.Sharding``s so each
     tensor goes straight to its mesh placement (the TP path for Llama-3-8B
-    on v5e-4, BASELINE config ladder)."""
-    state = iter_safetensors(checkpoint_dir)
+    on v5e-4, BASELINE config ladder); for quantized matrices the entry may
+    be a ``{"q": ..., "s": ...}`` mapping (parallel/mesh.py param_shardings
+    with quantized=True) or one sharding applied to both leaves.
 
-    def put(name: str, array: np.ndarray) -> jax.Array:
+    ``quantize=True`` quantizes each layer-matrix GROUP the moment it is
+    placed (models/quant.py int8 scheme), so device peak memory is the int8
+    tree plus ONE bf16 group — loading then calling ``quantize_params``
+    would peak at float tree + int8 tree, an OOM for 8B-class checkpoints
+    on a 16 GB chip.
+    """
+    from .quant import QUANTIZED_LAYER_MATRICES, quantize_matrix
+
+    state = iter_safetensors(checkpoint_dir)
+    quantize_jit = jax.jit(quantize_matrix) if quantize else None
+
+    def place(value: jax.Array, sharding: Any) -> jax.Array:
+        return jax.device_put(value, sharding) if sharding is not None else value
+
+    def put(name: str, array: np.ndarray) -> Any:
         value = jnp.asarray(array, dtype)
-        if shardings and name in shardings:
-            value = jax.device_put(value, shardings[name])
-        return value
+        sharding = shardings.get(name) if shardings else None
+        if quantize and name in QUANTIZED_LAYER_MATRICES:
+            out = quantize_jit(value)
+            # block so XLA frees the bf16 group before the next one arrives
+            out = jax.block_until_ready(out)
+            del value
+            if isinstance(sharding, Mapping):
+                return {k: place(v, sharding.get(k)) for k, v in out.items()}
+            return {k: place(v, sharding) for k, v in out.items()}
+        return place(value, sharding)
 
     return convert_hf_state_dict(state, config, dtype, put=put)

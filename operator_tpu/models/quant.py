@@ -79,3 +79,55 @@ def mm(x: jax.Array, w: "jax.Array | dict[str, jax.Array]") -> jax.Array:
 
 def quantized_bytes(params: Params) -> int:
     return sum(int(p.size * p.dtype.itemsize) for p in jax.tree_util.tree_leaves(params))
+
+
+def init_params_quantized(
+    config: ModelConfig, key: jax.Array, dtype: jnp.dtype = jnp.bfloat16
+) -> Params:
+    """Random-init an ALREADY-quantized tree without ever materialising the
+    full float tree.
+
+    ``init_params`` + ``quantize_params`` peaks at float-tree + int8-tree
+    simultaneously — for llama-3-8b that is ~16 GB of bf16 alone, i.e. an
+    OOM before quantization can start on a 16 GB chip.  Here each stacked
+    layer matrix is initialised and quantized in its own jitted call (the
+    float tensor is a transient XLA frees immediately), so peak memory is
+    the final int8 tree plus ONE bf16 matrix stack (~1 GB at 8B scale).
+
+    Matches ``quantize_params(init_params(config, key, dtype), config)`` to
+    within one quantization level / one bf16 ulp (same per-matrix PRNG keys
+    and distribution; XLA rounds fused init slightly differently across jit
+    boundaries, so bit-exactness is not promised) — tests/test_quant.py
+    pins the tolerance.
+    """
+    from .llama import dense_init, layer_matrix_shapes
+
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    h = config.hidden_size
+    n = config.num_layers
+
+    # shapes, key-split order and init scaling all come from llama.py — the
+    # two init paths share one structural source of truth
+    def dense(key: jax.Array, shape: tuple[int, ...]) -> jax.Array:
+        return dense_init(key, shape, h, dtype)
+
+    shapes = layer_matrix_shapes(config)
+    keys = jax.random.split(k_layers, len(shapes))
+    # dense-init and quantize are SEPARATE jits on purpose: fused, XLA elides
+    # the f32->bf16->f32 round trip and quantizes unrounded values — bit
+    # drift vs the two-step reference path this function promises to match
+    init_dense = jax.jit(dense, static_argnames=("shape",))
+    quantize = jax.jit(quantize_matrix)
+    layers: dict[str, Any] = {}
+    for key_i, (name, shape) in zip(keys, shapes.items()):
+        layers[name] = jax.block_until_ready(quantize(init_dense(key_i, shape=shape)))
+    layers["ln_attn"] = jnp.ones((n, h), dtype)
+    layers["ln_mlp"] = jnp.ones((n, h), dtype)
+    params: Params = {
+        "embed": init_dense(k_embed, shape=(config.vocab_size, h)),
+        "layers": layers,
+        "ln_final": jnp.ones((h,), dtype),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = init_dense(k_head, shape=(h, config.vocab_size))
+    return params

@@ -118,16 +118,33 @@ def build_tpu_native_provider(
 
     checkpoint_dir = config.checkpoint_dir
     tokenizer = load_tokenizer(checkpoint_dir)
+    quantize = config.weight_dtype == "int8"
+    if quantize:
+        log.info("int8 weight-only serving (per-output-channel)")
+    elif config.weight_dtype not in ("", "bf16", "bfloat16"):
+        raise ValueError(f"unknown weight_dtype {config.weight_dtype!r}")
     if checkpoint_dir and os.path.isdir(checkpoint_dir):
         log.info("loading %s weights from %s", model_id, checkpoint_dir)
-        params = load_params(checkpoint_dir, model_config, dtype=jnp.bfloat16)
+        # quantize-at-load: each layer group quantizes as it is placed, so
+        # an 8B int8 load peaks at int8 tree + one bf16 group, never the
+        # full float tree (models/loader.py)
+        params = load_params(
+            checkpoint_dir, model_config, dtype=jnp.bfloat16, quantize=quantize
+        )
     elif config.allow_random_weights:
         log.warning(
             "no checkpoint for %s (checkpoint_dir=%r); using random init — "
             "explanations will be non-linguistic (allow_random_weights set)",
             model_id, checkpoint_dir,
         )
-        params = init_params(model_config, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+        if quantize:
+            from ..models.quant import init_params_quantized
+
+            params = init_params_quantized(
+                model_config, jax.random.PRNGKey(0), dtype=jnp.bfloat16
+            )
+        else:
+            params = init_params(model_config, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
     else:
         # refusing keeps random-weight noise out of pod annotations: the
         # pipeline catches the ProviderError and stores the pattern-only
@@ -138,14 +155,6 @@ def build_tpu_native_provider(
             f"checkpoint_dir={checkpoint_dir!r} does not exist; mount a "
             f"checkpoint or set ALLOW_RANDOM_WEIGHTS=true (testing only)"
         )
-
-    if config.weight_dtype == "int8":
-        from ..models.quant import quantize_params
-
-        log.info("quantizing %s weights to int8 (per-output-channel)", model_id)
-        params = quantize_params(params, model_config)
-    elif config.weight_dtype not in ("", "bf16", "bfloat16"):
-        raise ValueError(f"unknown weight_dtype {config.weight_dtype!r}")
 
     mesh = None
     if config.serving_mesh:

@@ -336,15 +336,10 @@ def test_parity_survives_kv_cache_decode(hf_tiny_model):
 # --- loader validation ----------------------------------------------------
 
 
-def test_safetensors_roundtrip(tmp_path):
-    """init -> save HF-layout safetensors shards -> load_params -> same logits."""
+def write_hf_checkpoint(tmp_path, config, params):
+    """Write our params back out as a sharded HF-layout checkpoint."""
     from safetensors.numpy import save_file
 
-    from operator_tpu.models import load_params
-
-    config = TINY_TEST
-    params = init_params(config, jax.random.PRNGKey(5), dtype=jnp.float32)
-    # write an HF-layout checkpoint from our params (transposing back)
     state = {
         "model.embed_tokens.weight": np.asarray(params["embed"]),
         "model.norm.weight": np.asarray(params["ln_final"]),
@@ -366,6 +361,15 @@ def test_safetensors_roundtrip(tmp_path):
     names = sorted(state)
     save_file({k: state[k] for k in names[::2]}, tmp_path / "model-00001.safetensors")
     save_file({k: state[k] for k in names[1::2]}, tmp_path / "model-00002.safetensors")
+
+
+def test_safetensors_roundtrip(tmp_path):
+    """init -> save HF-layout safetensors shards -> load_params -> same logits."""
+    from operator_tpu.models import load_params
+
+    config = TINY_TEST
+    params = init_params(config, jax.random.PRNGKey(5), dtype=jnp.float32)
+    write_hf_checkpoint(tmp_path, config, params)
 
     loaded = load_params(str(tmp_path), config, dtype=jnp.float32)
     tokens = make_tokens(jax.random.PRNGKey(6), config, batch=1, seq=8)
@@ -503,3 +507,36 @@ class TestChunkedPrefill:
         out = gen.generate("pod exited with code 137 after OOM",
                            SamplingParams(max_tokens=4, temperature=0.0))
         assert len(out.token_ids) >= 1
+
+
+def test_quantize_at_load_matches_post_hoc(tmp_path):
+    """load_params(quantize=True) must equal load-then-quantize_params —
+    without ever holding the full float tree (the 8B-int8 OOM fix)."""
+    from operator_tpu.models import load_params
+    from operator_tpu.models.quant import quantize_params
+
+    config = TINY_TEST
+    params = init_params(config, jax.random.PRNGKey(7), dtype=jnp.float32)
+    write_hf_checkpoint(tmp_path, config, params)
+
+    fused = load_params(str(tmp_path), config, dtype=jnp.bfloat16, quantize=True)
+    two_step = quantize_params(
+        load_params(str(tmp_path), config, dtype=jnp.bfloat16), config
+    )
+    flat_a, tree_a = jax.tree_util.tree_flatten(fused)
+    flat_b, tree_b = jax.tree_util.tree_flatten(two_step)
+    assert tree_a == tree_b
+    for a, b in zip(flat_a, flat_b):
+        assert a.dtype == b.dtype
+        af = np.asarray(a, np.float32)
+        bf = np.asarray(b, np.float32)
+        if a.dtype == jnp.int8:  # jit-boundary rounding: <=1 quantization level
+            assert np.abs(af - bf).max() <= 1
+            assert (af != bf).mean() < 0.05
+        else:
+            np.testing.assert_allclose(af, bf, rtol=1e-2, atol=1e-3)
+    # and the quantized tree actually serves
+    from operator_tpu.models.llama import forward as fwd
+    tokens = make_tokens(jax.random.PRNGKey(8), config, batch=1, seq=8)
+    logits, _ = fwd(fused, config, tokens, positions_for(tokens))
+    assert np.isfinite(np.asarray(logits)).all()
