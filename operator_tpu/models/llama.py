@@ -74,17 +74,30 @@ def dense_init(
 
 
 def init_params(
-    config: ModelConfig, key: jax.Array, dtype: jnp.dtype = jnp.bfloat16
+    config: ModelConfig,
+    key: jax.Array,
+    dtype: jnp.dtype = jnp.bfloat16,
+    *,
+    layer_matrix_init: Optional[Any] = None,
 ) -> Params:
-    """Random init with per-layer params stacked on axis 0 for lax.scan."""
+    """Random init with per-layer params stacked on axis 0 for lax.scan.
+
+    ``layer_matrix_init(key, shape) -> leaf`` overrides how the seven layer
+    matrices are built (default: ``dense_init``).  quant.py passes a
+    per-matrix jitted init+quantize so the int8 tree never coexists with a
+    full float tree — ONE assembly of the non-matrix leaves serves both.
+    """
     k_embed, k_layers, k_head = jax.random.split(key, 3)
     h = config.hidden_size
     n = config.num_layers
+    if layer_matrix_init is None:
+        def layer_matrix_init(k, shape):
+            return dense_init(k, shape, h, dtype)
 
     shapes = layer_matrix_shapes(config)
     keys = jax.random.split(k_layers, len(shapes))
-    layers: dict[str, jax.Array] = {
-        name: dense_init(k, shape, h, dtype)
+    layers: dict[str, Any] = {
+        name: layer_matrix_init(k, shape)
         for k, (name, shape) in zip(keys, shapes.items())
     }
     layers["ln_attn"] = jnp.ones((n, h), dtype)
@@ -249,6 +262,12 @@ def _attention(
 _SCORE_BUDGET_BYTES = int(
     float(os.environ.get("OPERATOR_TPU_SCORE_BUDGET_MB", "256")) * 2**20
 )
+
+#: unroll factor for the layer lax.scan (1 = rolled).  Unrolling lets XLA
+#: schedule/alias per-layer cache updates without the scan's stacked-ys
+#: round trip — a decode-bandwidth experiment knob (scripts/tpu_experiments.sh);
+#: compile time grows with the factor.
+_LAYER_UNROLL = int(os.environ.get("OPERATOR_TPU_LAYER_UNROLL", "1"))
 
 
 def _pick_q_chunk(b: int, t: int, s: int, qh: int, shards: int = 1) -> Optional[int]:
@@ -426,11 +445,15 @@ def forward(
     if use_cache:
         scanned_in = {"w": layers, "cache": {"k": cache.k, "v": cache.v}}
         x, cache_out = jax.lax.scan(
-            lambda carry, s: layer_step(carry, s), x, scanned_in
+            lambda carry, s: layer_step(carry, s), x, scanned_in,
+            unroll=_LAYER_UNROLL,
         )
         new_cache = KVCache(k=cache_out["k"], v=cache_out["v"])
     else:
-        x, _ = jax.lax.scan(lambda carry, s: (layer_step(carry, {"w": s})[0], None), x, layers)
+        x, _ = jax.lax.scan(
+            lambda carry, s: (layer_step(carry, {"w": s})[0], None), x, layers,
+            unroll=_LAYER_UNROLL,
+        )
         new_cache = None
 
     x = rms_norm(x, params["ln_final"], config.rms_norm_eps)
@@ -502,7 +525,7 @@ def decode_step_paged(
         return x, {"k": k_pages, "v": v_pages}
 
     scanned_in = {"w": params["layers"], "k": paged.k_pages, "v": paged.v_pages}
-    x, pages_out = jax.lax.scan(layer_step, x, scanned_in)
+    x, pages_out = jax.lax.scan(layer_step, x, scanned_in, unroll=_LAYER_UNROLL)
 
     x = rms_norm(x, params["ln_final"], config.rms_norm_eps)
     head = params["embed"].T if config.tie_embeddings else params["lm_head"]

@@ -186,7 +186,8 @@ def load_params(
     tensor goes straight to its mesh placement (the TP path for Llama-3-8B
     on v5e-4, BASELINE config ladder); for quantized matrices the entry may
     be a ``{"q": ..., "s": ...}`` mapping (parallel/mesh.py param_shardings
-    with quantized=True) or one sharding applied to both leaves.
+    with quantized=True), or a single sharding applied to ``q`` with ``s``
+    replicated (a matrix-rank spec cannot place the rank-2 scales).
 
     ``quantize=True`` quantizes each layer-matrix GROUP the moment it is
     placed (models/quant.py int8 scheme), so device peak memory is the int8
@@ -212,7 +213,9 @@ def load_params(
             del value
             if isinstance(sharding, Mapping):
                 return {k: place(v, sharding.get(k)) for k, v in out.items()}
-            return {k: place(v, sharding) for k, v in out.items()}
+            # single sharding: it has the matrix's rank, so it can only
+            # place q; scales stay replicated (they're [n_layers, out])
+            return {"q": place(out["q"], sharding), "s": out["s"]}
         return place(value, sharding)
 
     return convert_hf_state_dict(state, config, dtype, put=put)

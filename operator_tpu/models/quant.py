@@ -100,34 +100,22 @@ def init_params_quantized(
     boundaries, so bit-exactness is not promised) — tests/test_quant.py
     pins the tolerance.
     """
-    from .llama import dense_init, layer_matrix_shapes
+    from .llama import dense_init, init_params
 
-    k_embed, k_layers, k_head = jax.random.split(key, 3)
     h = config.hidden_size
-    n = config.num_layers
-
-    # shapes, key-split order and init scaling all come from llama.py — the
-    # two init paths share one structural source of truth
-    def dense(key: jax.Array, shape: tuple[int, ...]) -> jax.Array:
-        return dense_init(key, shape, h, dtype)
-
-    shapes = layer_matrix_shapes(config)
-    keys = jax.random.split(k_layers, len(shapes))
     # dense-init and quantize are SEPARATE jits on purpose: fused, XLA elides
     # the f32->bf16->f32 round trip and quantizes unrounded values — bit
     # drift vs the two-step reference path this function promises to match
-    init_dense = jax.jit(dense, static_argnames=("shape",))
+    init_dense = jax.jit(
+        lambda key, shape: dense_init(key, shape, h, dtype),
+        static_argnames=("shape",),
+    )
     quantize = jax.jit(quantize_matrix)
-    layers: dict[str, Any] = {}
-    for key_i, (name, shape) in zip(keys, shapes.items()):
-        layers[name] = jax.block_until_ready(quantize(init_dense(key_i, shape=shape)))
-    layers["ln_attn"] = jnp.ones((n, h), dtype)
-    layers["ln_mlp"] = jnp.ones((n, h), dtype)
-    params: Params = {
-        "embed": init_dense(k_embed, shape=(config.vocab_size, h)),
-        "layers": layers,
-        "ln_final": jnp.ones((h,), dtype),
-    }
-    if not config.tie_embeddings:
-        params["lm_head"] = init_dense(k_head, shape=(h, config.vocab_size))
-    return params
+
+    def init_quantized_matrix(key: jax.Array, shape: tuple[int, ...]) -> Any:
+        # block per matrix so the bf16 transient frees before the next one
+        return jax.block_until_ready(quantize(init_dense(key, shape=shape)))
+
+    return init_params(
+        config, key, dtype, layer_matrix_init=init_quantized_matrix
+    )

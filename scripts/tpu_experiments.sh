@@ -37,6 +37,8 @@ run bench_slots32 900 env BENCH_OPEN=0 BENCH_SLOTS=32 python bench.py
 # north-star model class: llama-3-8b int8 (~8.2 GB) on the 16 GB chip
 run bench_8b     2400 env BENCH_OPEN=0 BENCH_MODEL=llama-3-8b BENCH_QUANT=1 \
     BENCH_SLOTS=8 BENCH_REQUESTS=16 BENCH_MAX_SEQ=2048 python bench.py
+# layer-scan unrolling: does scan ys-stacking cost decode bandwidth?
+run bench_unroll 900 env BENCH_OPEN=0 OPERATOR_TPU_LAYER_UNROLL=22 python bench.py
 # xplane trace of the timed region for the remaining-gap attribution
 run bench_profile 900 env BENCH_OPEN=0 BENCH_PROFILE=$OUT/xplane python bench.py
 echo "series done $(date +%H:%M:%S)" | tee -a "$OUT/series.log"
