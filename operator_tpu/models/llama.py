@@ -331,6 +331,8 @@ def forward(
     q_chunk: Optional[int] = None,  # explicit prefill chunk (tests)
     score_shards: int = 1,  # devices the batch axis is sharded over
     prefill_lengths: Optional[jax.Array] = None,  # [B]; enables flash prefill
+    lora: Optional[dict[str, dict[str, jax.Array]]] = None,  # parallel/lora.py
+    lora_alpha: float = 16.0,
 ) -> tuple[jax.Array, Optional[KVCache]]:
     """One decoder pass.
 
@@ -401,11 +403,25 @@ def forward(
     def layer_step(carry: jax.Array, scanned: dict[str, jax.Array]):
         x = carry
         weights, layer_cache = scanned["w"], scanned.get("cache")
+        layer_lora = scanned.get("lora")
+
+        def proj(h_in: jax.Array, name: str) -> jax.Array:
+            """x @ W plus the low-rank LoRA path x @ A @ B — the factors
+            are never expanded to a full delta matrix, so training memory
+            stays rank-r (parallel/lora.py)."""
+            y = mm(h_in, weights[name])
+            if layer_lora is not None and name in layer_lora:
+                a = layer_lora[name]["a"].astype(h_in.dtype)
+                bmat = layer_lora[name]["b"].astype(h_in.dtype)
+                scale = lora_alpha / a.shape[-1]
+                y = y + ((h_in @ a) @ bmat) * scale
+            return y
+
         # -- attention ---------------------------------------------------
         attn_in = rms_norm(x, weights["ln_attn"], config.rms_norm_eps)
-        q = mm(attn_in, weights["wq"]).reshape(b, t, config.num_heads, config.head_dim)
-        k = mm(attn_in, weights["wk"]).reshape(b, t, config.num_kv_heads, config.head_dim)
-        v = mm(attn_in, weights["wv"]).reshape(b, t, config.num_kv_heads, config.head_dim)
+        q = proj(attn_in, "wq").reshape(b, t, config.num_heads, config.head_dim)
+        k = proj(attn_in, "wk").reshape(b, t, config.num_kv_heads, config.head_dim)
+        v = proj(attn_in, "wv").reshape(b, t, config.num_kv_heads, config.head_dim)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         if layer_cache is not None:
@@ -434,24 +450,29 @@ def forward(
             )
         else:
             attn = _attention(q, k_att, v_att, attn_mask, config)
-        x = x + mm(attn, weights["wo"])
+        x = x + proj(attn, "wo")
         # -- mlp ----------------------------------------------------------
         mlp_in = rms_norm(x, weights["ln_mlp"], config.rms_norm_eps)
-        gate = jax.nn.silu(mm(mlp_in, weights["w_gate"]))
-        up = mm(mlp_in, weights["w_up"])
-        x = x + mm(gate * up, weights["w_down"])
+        gate = jax.nn.silu(proj(mlp_in, "w_gate"))
+        up = proj(mlp_in, "w_up")
+        x = x + proj(gate * up, "w_down")
         return x, new_cache
 
     if use_cache:
         scanned_in = {"w": layers, "cache": {"k": cache.k, "v": cache.v}}
+        if lora is not None:
+            scanned_in["lora"] = lora
         x, cache_out = jax.lax.scan(
             lambda carry, s: layer_step(carry, s), x, scanned_in,
             unroll=_LAYER_UNROLL,
         )
         new_cache = KVCache(k=cache_out["k"], v=cache_out["v"])
     else:
+        scanned_in = {"w": layers}
+        if lora is not None:
+            scanned_in["lora"] = lora
         x, _ = jax.lax.scan(
-            lambda carry, s: (layer_step(carry, {"w": s})[0], None), x, layers,
+            lambda carry, s: (layer_step(carry, s)[0], None), x, scanned_in,
             unroll=_LAYER_UNROLL,
         )
         new_cache = None

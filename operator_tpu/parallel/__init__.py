@@ -17,6 +17,14 @@ from .mesh import (
     shard_params,
     validate_param_shardings,
 )
+from .lora import (
+    apply_lora,
+    init_lora,
+    lora_param_count,
+    lora_shardings,
+    make_lora_train_step,
+    merge_lora,
+)
 from .train import TrainState, make_optimizer, make_train_step, next_token_loss
 
 __all__ = [name for name in dir() if not name.startswith("_")]

@@ -1,0 +1,214 @@
+"""LoRA adapters: low-rank fine-tuning of the explanation models.
+
+Full fine-tuning an 8B model needs optimizer state for every weight —
+3x the parameter bytes in f32 moments, far beyond one v5e chip.  LoRA
+trains only rank-r factors per projection:
+
+    W_eff = W + (alpha / r) * A @ B      A: [in, r]   B: [r, out]
+
+so the trainable state at 8B/rank-16 is ~50 MB instead of ~90 GB, and the
+frozen base weights stay int8/bf16 on device.  Adapters follow the stacked
+``[n_layers, ...]`` layout of models/llama.py and shard over the same mesh
+axes as their base matrix (A takes the base fan-in axis, B the fan-out
+axis — derived per matrix in :func:`lora_specs`, so row-parallel wo/w_down
+get the transposed layout), and XLA's collectives match the base model's.
+
+TRAINING never materialises a delta matrix: the low-rank path ``x @ A @ B``
+is added inside the model's projections (models/llama.py ``forward(lora=)``)
+so gradients exist for the rank-r factors alone.  SERVING merges once at
+load (:func:`merge_lora` / :func:`apply_lora`) — a load-time operation
+whose full-size f32 delta transients are acceptable there, with zero
+runtime overhead afterwards.
+
+The reference has no training of any kind (SURVEY.md §2: frozen API
+calls); this is the tpu-native "adapt the explanation model on recorded
+failure/explanation pairs" flow the rebuild adds.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.configs import ModelConfig
+from ..models.llama import Params, layer_matrix_shapes
+from .mesh import batch_spec, param_shardings
+from .train import TrainState, make_optimizer, next_token_loss
+
+#: default adaptation targets: attention in/out projections — the standard
+#: LoRA placement; add mlp names for higher-capacity adaptation
+DEFAULT_TARGETS = ("wq", "wk", "wv", "wo")
+
+LoraParams = dict[str, dict[str, jax.Array]]
+
+
+def init_lora(
+    config: ModelConfig,
+    key: jax.Array,
+    *,
+    rank: int = 16,
+    targets: Sequence[str] = DEFAULT_TARGETS,
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> LoraParams:
+    """A ~ N(0, 1/r) and B = 0, so W_eff == W at step 0 (standard LoRA)."""
+    shapes = layer_matrix_shapes(config)
+    unknown = set(targets) - set(shapes)
+    assert not unknown, f"unknown LoRA targets {unknown}"
+    adapters: LoraParams = {}
+    for name, sub in zip(targets, jax.random.split(key, len(targets))):
+        n, fan_in, fan_out = shapes[name]
+        adapters[name] = {
+            "a": (jax.random.normal(sub, (n, fan_in, rank), jnp.float32)
+                  * rank**-0.5).astype(dtype),
+            "b": jnp.zeros((n, rank, fan_out), dtype),
+        }
+    return adapters
+
+
+def lora_param_count(adapters: LoraParams) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(adapters))
+
+
+def _delta(adapter: dict[str, jax.Array], alpha: float, rank: int) -> jax.Array:
+    scale = alpha / rank
+    return jnp.einsum(
+        "nir,nro->nio", adapter["a"].astype(jnp.float32),
+        adapter["b"].astype(jnp.float32),
+    ) * scale
+
+
+def apply_lora(
+    params: Params, adapters: LoraParams, *, alpha: float = 16.0
+) -> Params:
+    """Merged params for SERVING (a load-time operation: the full-size f32
+    delta transients are fine once, not per train step — training threads
+    the factors through ``forward(lora=...)`` instead).  Quantized base
+    matrices dequantize, merge, and stay float — merging into int8 would
+    quantize the delta away at small ranks."""
+    layers = dict(params["layers"])
+    for name, adapter in adapters.items():
+        base = layers[name]
+        rank = adapter["a"].shape[-1]
+        delta = _delta(adapter, alpha, rank)
+        if isinstance(base, dict):  # quantized {q, s}
+            dequant = base["q"].astype(jnp.float32) * base["s"][:, None, :]
+            layers[name] = (dequant + delta).astype(adapter["a"].dtype)
+        else:
+            layers[name] = (base.astype(jnp.float32) + delta).astype(base.dtype)
+    return {**params, "layers": layers}
+
+
+def merge_lora(
+    params: Params, adapters: LoraParams, *, alpha: float = 16.0
+) -> Params:
+    """Eager merge for serving (one jit per adapted matrix group)."""
+    merge = jax.jit(partial(apply_lora, alpha=alpha))
+    return jax.block_until_ready(merge(params, adapters))
+
+
+def lora_specs(config: ModelConfig, targets: Sequence[str]) -> Any:
+    """PartitionSpecs for adapter factors, DERIVED from each base matrix's
+    spec (mesh.param_specs): A takes the base fan-in axis, B the base
+    fan-out axis — so column-parallel wq/wk/wv (in on fsdp, out on tp) and
+    row-parallel wo/w_down (in on tp, out on fsdp) both merge without any
+    resharding of a full-size matrix."""
+    from .mesh import param_specs
+
+    base = param_specs(config)["layers"]  # plain (unquantized) matrix specs
+    out = {}
+    for name in targets:
+        spec = base[name]
+        out[name] = {
+            "a": P(None, spec[1], None),  # [n, in, r]
+            "b": P(None, None, spec[2]),  # [n, r, out]
+        }
+    return out
+
+
+def lora_shardings(mesh: Mesh, adapters: LoraParams, config: ModelConfig) -> Any:
+    specs = lora_specs(config, tuple(adapters))
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def make_lora_train_step(
+    config: ModelConfig,
+    mesh: Mesh,
+    *,
+    alpha: float = 16.0,
+    targets: Sequence[str] = DEFAULT_TARGETS,
+    quantized_base: bool = False,
+    optimizer: Optional[optax.GradientTransformation] = None,
+):
+    """Returns (init_state, train_step): trains ONLY the adapters.
+
+    The forward threads the rank-r factors through ``forward(lora=...)``
+    (models/llama.py) — no delta matrix, no full-rank gradients — so
+    trainable memory is the factors plus their optimizer moments.  The
+    frozen base rides along as a jit constant input (``quantized_base``
+    selects the int8 {q, s} sharding tree).  Adapters are pinned to
+    :func:`lora_specs` placements every step, mirroring train.py's
+    ``with_sharding_constraint`` discipline.
+    """
+    optimizer = optimizer or make_optimizer()
+    p_shardings = param_shardings(mesh, config, quantized=quantized_base)
+    data_sharding = NamedSharding(mesh, batch_spec())
+    adapter_shardings = {
+        name: {
+            key: NamedSharding(mesh, spec) for key, spec in pair.items()
+        }
+        for name, pair in lora_specs(config, targets).items()
+    }
+
+    def init_state(adapters: LoraParams) -> TrainState:
+        assert set(adapters) == set(targets), (set(adapters), set(targets))
+        adapters = jax.tree_util.tree_map(jax.device_put, adapters, adapter_shardings)
+        return TrainState(params=adapters, opt_state=optimizer.init(adapters),
+                          step=jnp.zeros((), jnp.int32))
+
+    def loss_fn(adapters, base_params, token_ids, loss_mask):
+        return next_token_loss(
+            base_params, config, token_ids, loss_mask,
+            lora=adapters, lora_alpha=alpha,
+        )
+
+    @partial(
+        jax.jit,
+        in_shardings=(None, p_shardings, data_sharding, data_sharding),
+        donate_argnums=(0,),
+    )
+    def train_step(
+        state: TrainState, base_params: Params,
+        token_ids: jax.Array, loss_mask: jax.Array,
+    ):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state.params, base_params, token_ids, loss_mask
+        )
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_adapters = optax.apply_updates(state.params, updates)
+        new_adapters = jax.lax.with_sharding_constraint(
+            new_adapters, adapter_shardings
+        )
+        return TrainState(new_adapters, new_opt, state.step + 1), loss
+
+    return init_state, train_step
+
+
+__all__ = [
+    "DEFAULT_TARGETS",
+    "LoraParams",
+    "apply_lora",
+    "init_lora",
+    "lora_param_count",
+    "lora_shardings",
+    "lora_specs",
+    "make_lora_train_step",
+    "merge_lora",
+]

@@ -37,11 +37,16 @@ def next_token_loss(
     config: ModelConfig,
     token_ids: jax.Array,  # [B, T]
     loss_mask: jax.Array,  # [B, T] 1.0 where the target counts
+    *,
+    lora: Any = None,  # adapter tree (parallel/lora.py); low-rank path only
+    lora_alpha: float = 16.0,
 ) -> jax.Array:
     """Mean next-token cross-entropy (float32 logits; stable logsumexp)."""
     b, t = token_ids.shape
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
-    logits, _ = forward(params, config, token_ids, positions)
+    logits, _ = forward(
+        params, config, token_ids, positions, lora=lora, lora_alpha=lora_alpha
+    )
     targets = token_ids[:, 1:]
     logits = logits[:, :-1]
     mask = loss_mask[:, 1:].astype(jnp.float32)
