@@ -23,7 +23,7 @@ from .llama import (
     param_count,
     rms_norm,
 )
-from .loader import convert_hf_state_dict, load_params
+from .loader import convert_hf_state_dict, load_params, save_params
 from .tokenizer import ByteTokenizer, HFTokenizer, Tokenizer, load_tokenizer
 
 __all__ = [name for name in dir() if not name.startswith("_")]

@@ -171,6 +171,102 @@ def iter_safetensors(checkpoint_dir: str):
                 yield name, f.get_tensor(name)
 
 
+# inverse of _LAYER_MAP: ours -> (hf name, transpose) — derived so the two
+# directions can never drift
+_HF_LAYER_NAMES = {ours: (hf, t) for hf, (ours, t) in _LAYER_MAP.items()}
+
+
+def save_params(
+    params: Params,
+    checkpoint_dir: str,
+    config: ModelConfig,
+    *,
+    shard_bytes: int = 4 << 30,
+) -> list[str]:
+    """Write our stacked pytree back out as a sharded HF-layout safetensors
+    checkpoint (with ``model.safetensors.index.json``) that ``load_params``
+    — or any HF Llama loader — reads back.
+
+    Completes the checkpoint/resume story for the fine-tune flows
+    (parallel/train.py, parallel/lora.py merge_lora output): train on the
+    mesh, save, reload for serving.  Quantized trees must be dequantized or
+    merged first (HF layout has no {q, s} convention).
+
+    Returns the written shard file names.
+    """
+    from safetensors.numpy import save_file
+
+    from .quant import is_quantized
+
+    if is_quantized(params):
+        raise ValueError(
+            "save_params writes HF layout, which has no int8 {q, s} "
+            "convention — expand with quant.dequantize_params first "
+            "(merge_lora output still holds untargeted int8 groups)"
+        )
+    os.makedirs(checkpoint_dir, exist_ok=True)
+
+    def tensors():
+        """(name, array) lazily — one stacked group fetched at a time, so
+        host peak is one group + the shard being packed (mirrors the
+        loader's streaming discipline)."""
+        yield "model.embed_tokens.weight", np.asarray(params["embed"])
+        yield "model.norm.weight", np.asarray(params["ln_final"])
+        if "lm_head" in params:
+            yield "lm_head.weight", np.ascontiguousarray(
+                np.asarray(params["lm_head"]).T
+            )
+        for ours, (hf, transpose) in _HF_LAYER_NAMES.items():
+            stacked = np.asarray(params["layers"][ours])
+            for i in range(config.num_layers):
+                tensor = stacked[i].T if transpose else stacked[i]
+                yield f"model.layers.{i}.{hf}.weight", np.ascontiguousarray(tensor)
+            del stacked
+
+    # pack + write shard-by-shard; rename to the final -of-NNNNN names once
+    # the count is known
+    weight_map: dict[str, str] = {}
+    tmp_files: list[str] = []
+    shard: dict[str, np.ndarray] = {}
+    size = total_size = 0
+
+    def flush():
+        nonlocal shard, size
+        if not shard:
+            return
+        fname = f"model-{len(tmp_files) + 1:05d}.tmp"
+        save_file(shard, os.path.join(checkpoint_dir, fname))
+        tmp_files.append(fname)
+        for name in shard:
+            weight_map[name] = fname
+        shard, size = {}, 0
+
+    for name, array in tensors():
+        if size and size + array.nbytes > shard_bytes:
+            flush()
+        shard[name] = array
+        size += array.nbytes
+        total_size += array.nbytes
+    flush()
+
+    total = len(tmp_files)
+    files: list[str] = []
+    renames = {}
+    for i, tmp in enumerate(tmp_files, start=1):
+        final = f"model-{i:05d}-of-{total:05d}.safetensors"
+        os.replace(
+            os.path.join(checkpoint_dir, tmp), os.path.join(checkpoint_dir, final)
+        )
+        renames[tmp] = final
+        files.append(final)
+    weight_map = {name: renames[tmp] for name, tmp in weight_map.items()}
+    with open(os.path.join(checkpoint_dir, "model.safetensors.index.json"), "w") as f:
+        json.dump(
+            {"metadata": {"total_size": total_size}, "weight_map": weight_map}, f
+        )
+    return files
+
+
 def load_params(
     checkpoint_dir: str,
     config: ModelConfig,

@@ -44,8 +44,27 @@ QUANTIZED_LAYER_MATRICES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
 
 def is_quantized(params: Params) -> bool:
-    wq = params.get("layers", {}).get("wq")
-    return isinstance(wq, dict) and "q" in wq
+    """True if ANY layer matrix is an int8 {q, s} group — partially-merged
+    trees (e.g. LoRA merged into a quantized base, which dequantizes only
+    its targets) count as quantized."""
+    return any(
+        isinstance(leaf, dict) and "q" in leaf
+        for leaf in params.get("layers", {}).values()
+    )
+
+
+def dequantize_params(params: Params, dtype: jnp.dtype = jnp.bfloat16) -> Params:
+    """Expand every int8 {q, s} group back to a float matrix (e.g. before
+    save_params, whose HF layout has no quantized convention)."""
+    layers = {
+        name: (
+            (leaf["q"].astype(jnp.float32) * leaf["s"][..., None, :]).astype(dtype)
+            if isinstance(leaf, dict) and "q" in leaf
+            else leaf
+        )
+        for name, leaf in params["layers"].items()
+    }
+    return {**params, "layers": layers}
 
 
 def quantize_matrix(w: jax.Array) -> dict[str, jax.Array]:

@@ -160,12 +160,7 @@ def make_lora_train_step(
     optimizer = optimizer or make_optimizer()
     p_shardings = param_shardings(mesh, config, quantized=quantized_base)
     data_sharding = NamedSharding(mesh, batch_spec())
-    adapter_shardings = {
-        name: {
-            key: NamedSharding(mesh, spec) for key, spec in pair.items()
-        }
-        for name, pair in lora_specs(config, targets).items()
-    }
+    adapter_shardings = lora_shardings(mesh, dict.fromkeys(targets), config)
 
     def init_state(adapters: LoraParams) -> TrainState:
         assert set(adapters) == set(targets), (set(adapters), set(targets))

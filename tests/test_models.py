@@ -540,3 +540,39 @@ def test_quantize_at_load_matches_post_hoc(tmp_path):
     tokens = make_tokens(jax.random.PRNGKey(8), config, batch=1, seq=8)
     logits, _ = fwd(fused, config, tokens, positions_for(tokens))
     assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_save_params_roundtrip_and_index(tmp_path):
+    """save_params -> load_params identity; index + shard layout valid."""
+    import json as json_mod
+
+    from operator_tpu.models import load_params, save_params
+
+    config = TINY_TEST
+    params = init_params(config, jax.random.PRNGKey(9), dtype=jnp.float32)
+    files = save_params(params, str(tmp_path), config, shard_bytes=200_000)
+    assert len(files) > 1  # small shard budget forces multiple shards
+    index = json_mod.load(open(tmp_path / "model.safetensors.index.json"))
+    assert set(index["weight_map"].values()) == set(files)
+
+    loaded = load_params(str(tmp_path), config, dtype=jnp.float32)
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(loaded)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # quantized trees are refused — including PARTIALLY quantized ones
+    # (merge_lora output keeps untargeted int8 groups) — and
+    # dequantize_params makes them saveable
+    from operator_tpu.models.quant import dequantize_params, quantize_params
+    from operator_tpu.parallel import init_lora, merge_lora
+
+    qparams = quantize_params(params, config)
+    with pytest.raises(ValueError, match="dequantize"):
+        save_params(qparams, str(tmp_path), config)
+    merged = merge_lora(qparams, init_lora(config, jax.random.PRNGKey(1), rank=2))
+    with pytest.raises(ValueError, match="dequantize"):
+        save_params(merged, str(tmp_path), config)
+    out = tmp_path / "dequant"
+    save_params(dequantize_params(merged, dtype=jnp.float32), str(out), config)
+    reloaded = load_params(str(out), config, dtype=jnp.float32)
+    assert "lm_head" in reloaded
