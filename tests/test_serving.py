@@ -387,10 +387,12 @@ class TestDecodeAheadPipelining:
             outs[depth] = [done[i] for i in ids]
         assert outs[1] == outs[2] == outs[3]
 
-    def test_slot_recycling_under_pipelining(self):
+    @pytest.mark.parametrize("paged", [True, False])
+    def test_slot_recycling_under_pipelining(self, paged):
         """A slot finishing and being re-admitted while a block is in flight
-        must not leak stale tokens into the new sequence (epoch guard)."""
-        gen = self._gen(2, paged=True, slots=2, block=2)
+        must not leak stale tokens into the new sequence (epoch guard),
+        for BOTH cache layouts."""
+        gen = self._gen(2, paged=paged, slots=2, block=2)
         short = SamplingParams(max_tokens=3, temperature=0.0, stop_on_eos=False)
         long = SamplingParams(max_tokens=20, temperature=0.0, stop_on_eos=False)
         [a, b] = gen.admit(["first short", "long runner xxxxx"], [short, long])
@@ -408,7 +410,7 @@ class TestDecodeAheadPipelining:
         # greedy decode is deterministic: the recycled generation must match
         # a fresh generator's tokens exactly — any stale in-flight token
         # credited to the new sequence would diverge here
-        reference = self._gen(1, paged=True, slots=2, block=2).generate(
+        reference = self._gen(1, paged=paged, slots=2, block=2).generate(
             "second short", short
         )
         assert results[a][1].token_ids == reference.token_ids
