@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Summarise a jax.profiler xplane trace: top ops by accumulated duration.
+
+Usage: python scripts/analyze_xplane.py <dir-or-xplane.pb> [top_n]
+
+Walks every plane/line in the XSpace (TPU device planes carry the XLA op
+timeline; host planes carry runtime calls) and prints, per plane, the top
+events by total duration with occurrence counts — enough to attribute a
+decode step's time budget (BENCH_PROFILE=dir python bench.py writes the
+trace this reads).
+
+Parsing uses the raw XSpace protobuf via tensorflow's bundled schema — the
+tensorboard_plugin_profile converters in this image are protobuf-version
+broken, so this stays dependency-minimal on purpose.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from collections import defaultdict
+
+
+def find_xplane_files(path: str) -> list[str]:
+    if os.path.isfile(path):
+        return [path]
+    found = []
+    for root, _, files in os.walk(path):
+        for fname in files:
+            if fname.endswith(".xplane.pb"):
+                found.append(os.path.join(root, fname))
+    return sorted(found)
+
+
+def load_xspace(path: str):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    space = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        space.ParseFromString(f.read())
+    return space
+
+
+def summarize(space, top_n: int = 25) -> None:
+    for plane in space.planes:
+        metadata = {m_id: m.name for m_id, m in plane.event_metadata.items()}
+        # (line name, event name) -> [total_ps, count]
+        totals: dict[tuple[str, str], list[float]] = defaultdict(lambda: [0.0, 0])
+        line_totals: dict[str, float] = defaultdict(float)
+        for line in plane.lines:
+            lname = line.name or f"line-{line.id}"
+            for event in line.events:
+                name = metadata.get(event.metadata_id, str(event.metadata_id))
+                entry = totals[(lname, name)]
+                entry[0] += event.duration_ps
+                entry[1] += 1
+                line_totals[lname] += event.duration_ps
+        if not totals:
+            continue
+        print(f"\n=== plane: {plane.name} ===")
+        for lname, total_ps in sorted(line_totals.items(), key=lambda kv: -kv[1])[:6]:
+            print(f"  line {lname}: {total_ps / 1e9:.3f} ms total")
+        print(f"  top {top_n} events by accumulated duration:")
+        ranked = sorted(totals.items(), key=lambda kv: -kv[1][0])[:top_n]
+        for (lname, name), (ps, count) in ranked:
+            print(f"    {ps / 1e9:9.3f} ms  x{count:<6} [{lname}] {name[:90]}")
+
+
+def main() -> None:
+    if len(sys.argv) < 2:
+        sys.exit(__doc__)
+    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 25
+    files = find_xplane_files(sys.argv[1])
+    if not files:
+        sys.exit(f"no .xplane.pb under {sys.argv[1]}")
+    for path in files:
+        print(f"### {path}")
+        summarize(load_xspace(path), top_n)
+
+
+if __name__ == "__main__":
+    main()

@@ -41,4 +41,5 @@ run bench_8b     2400 env BENCH_OPEN=0 BENCH_MODEL=llama-3-8b BENCH_QUANT=1 \
 run bench_unroll 900 env BENCH_OPEN=0 OPERATOR_TPU_LAYER_UNROLL=22 python bench.py
 # xplane trace of the timed region for the remaining-gap attribution
 run bench_profile 900 env BENCH_OPEN=0 BENCH_PROFILE=$OUT/xplane python bench.py
+run trace_summary 300 python scripts/analyze_xplane.py "$OUT/xplane" 40
 echo "series done $(date +%H:%M:%S)" | tee -a "$OUT/series.log"
