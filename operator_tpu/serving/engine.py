@@ -29,6 +29,7 @@ from __future__ import annotations
 import asyncio
 import functools
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Optional, Sequence
@@ -332,10 +333,28 @@ class BatchedGenerator:
         )
         return new_paged, next_tokens, rng
 
+    #: unroll the K-step decode block into straight-line XLA instead of a
+    #: lax.scan: a scan CARRIES the whole KV cache/page pool, and XLA's
+    #: loop handling may double-buffer (copy) the carry every iteration —
+    #: unrolled, updates chain without loop plumbing.  Experiment knob
+    #: (scripts/tpu_experiments.sh); compile time grows ~K-fold.
+    DECODE_UNROLL = os.environ.get("OPERATOR_TPU_DECODE_UNROLL", "0") == "1"
+
     def _decode_block(self, params, cache, tokens, offsets, rng, temp, top_p, active):
-        """K chained decode steps in one program (lax.scan); returns the
-        [K, B] token matrix plus final carry state."""
-        jax = self._jax
+        """K chained decode steps in one program; returns the [K, B] token
+        matrix plus final carry state.  lax.scan by default, straight-line
+        unrolled under OPERATOR_TPU_DECODE_UNROLL=1 (see DECODE_UNROLL)."""
+        jax, jnp = self._jax, self._jnp
+
+        if self.DECODE_UNROLL:
+            toks = []
+            for _ in range(self.decode_block):
+                cache, next_tokens, offsets, rng = self._decode_step(
+                    params, cache, tokens, offsets, rng, temp, top_p, active
+                )
+                tokens = next_tokens[:, None]
+                toks.append(next_tokens)
+            return cache, jnp.stack(toks), tokens, offsets, rng
 
         def body(carry, _):
             cache, tokens, offsets, rng = carry
@@ -350,7 +369,17 @@ class BatchedGenerator:
         return cache, toks, last, offsets, rng
 
     def _decode_block_paged(self, params, paged, tokens, rng, temp, top_p, active):
-        jax = self._jax
+        jax, jnp = self._jax, self._jnp
+
+        if self.DECODE_UNROLL:
+            toks = []
+            for _ in range(self.decode_block):
+                paged, next_tokens, rng = self._decode_step_paged(
+                    params, paged, tokens, rng, temp, top_p, active
+                )
+                tokens = next_tokens[:, None]
+                toks.append(next_tokens)
+            return paged, jnp.stack(toks), tokens, rng
 
         def body(carry, _):
             paged, tokens, rng = carry

@@ -39,6 +39,8 @@ run bench_8b     2400 env BENCH_OPEN=0 BENCH_MODEL=llama-3-8b BENCH_QUANT=1 \
     BENCH_SLOTS=8 BENCH_REQUESTS=16 BENCH_MAX_SEQ=2048 python bench.py
 # layer-scan unrolling: does scan ys-stacking cost decode bandwidth?
 run bench_unroll 900 env BENCH_OPEN=0 OPERATOR_TPU_LAYER_UNROLL=22 python bench.py
+# decode-block straight-lining: does the scan CARRY (cache) get copied?
+run bench_block_unroll 900 env BENCH_OPEN=0 OPERATOR_TPU_DECODE_UNROLL=1 python bench.py
 # xplane trace of the timed region for the remaining-gap attribution
 run bench_profile 900 env BENCH_OPEN=0 BENCH_PROFILE=$OUT/xplane python bench.py
 run trace_summary 300 python scripts/analyze_xplane.py "$OUT/xplane" 40

@@ -429,3 +429,24 @@ class TestDecodeAheadPipelining:
         assert result is not None and result.finish_reason == "length"
         # prompt + generated never crosses the guarded margin
         assert result.prompt_tokens + result.completion_tokens <= 128 - 3 * 4 + 4
+
+
+def test_decode_unroll_token_parity(monkeypatch):
+    """OPERATOR_TPU_DECODE_UNROLL straight-lines the decode block; tokens
+    must be identical to the lax.scan path for both cache layouts."""
+    import operator_tpu.serving.engine as engine_mod
+
+    params = init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+    sampling = SamplingParams(max_tokens=9, temperature=0.6, top_p=0.9,
+                              stop_on_eos=False)
+    for paged in (False, True):
+        outs = []
+        for unroll in (False, True):
+            monkeypatch.setattr(engine_mod.BatchedGenerator, "DECODE_UNROLL", unroll)
+            gen = BatchedGenerator(
+                params, TINY_TEST, ByteTokenizer(), max_slots=2, max_seq=128,
+                paged=paged, page_size=16, decode_block=4, seed=5,
+                cache_dtype=jnp.float32,
+            )
+            outs.append(gen.generate("pod oom killed", sampling).token_ids)
+        assert outs[0] == outs[1], (paged, outs)
