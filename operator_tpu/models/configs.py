@@ -40,6 +40,7 @@ class ModelConfig:
     sliding_window: Optional[int] = None  # Mistral-style local attention
     tie_embeddings: bool = False
     rope_scaling: Optional[RopeScaling] = None  # Llama-3.1+ long context
+    attention_bias: bool = False  # Qwen2-style bias on the q/k/v projections
 
     @property
     def q_per_kv(self) -> int:
@@ -135,6 +136,39 @@ MISTRAL_7B = ModelConfig(
     max_seq_len=8192,
 )
 
+# Qwen2 family: same decoder skeleton plus bias vectors on the q/k/v
+# projections (HF Qwen2Config attention_bias); 2.5 generation sizes
+QWEN2_5_7B = ModelConfig(
+    name="qwen2.5-7b",
+    vocab_size=152064,
+    hidden_size=3584,
+    intermediate_size=18944,
+    num_layers=28,
+    num_heads=28,
+    num_kv_heads=4,
+    head_dim=128,
+    rope_theta=1_000_000.0,
+    rms_norm_eps=1e-6,
+    max_seq_len=16384,  # serving cap; the model supports 32k
+    attention_bias=True,
+)
+
+QWEN2_5_1_5B = ModelConfig(
+    name="qwen2.5-1.5b",
+    vocab_size=151936,
+    hidden_size=1536,
+    intermediate_size=8960,
+    num_layers=28,
+    num_heads=12,
+    num_kv_heads=2,
+    head_dim=128,
+    rope_theta=1_000_000.0,
+    rms_norm_eps=1e-6,
+    max_seq_len=16384,
+    tie_embeddings=True,
+    attention_bias=True,
+)
+
 #: small config for tests and the compile-check entry point: real arrays,
 #: real architecture, laptop-sized
 TINY_TEST = ModelConfig(
@@ -159,6 +193,8 @@ _REGISTRY = {
         LLAMA_3_2_1B,
         LLAMA_3_2_3B,
         MISTRAL_7B,
+        QWEN2_5_7B,
+        QWEN2_5_1_5B,
         TINY_TEST,
     )
 }

@@ -41,6 +41,9 @@ from .quant import mm
 
 Params = dict[str, Any]
 
+#: projections that carry a bias vector when config.attention_bias (Qwen2)
+_PROJ_BIAS = {"wq": "bq", "wk": "bk", "wv": "bv"}
+
 
 # --------------------------------------------------------------------------
 # parameter init
@@ -102,6 +105,13 @@ def init_params(
     }
     layers["ln_attn"] = jnp.ones((n, h), dtype)
     layers["ln_mlp"] = jnp.ones((n, h), dtype)
+    if config.attention_bias:
+        # Qwen2-style q/k/v projection biases (HF Qwen2Config attention_bias);
+        # zero-init so random-weight parity tests see the unbiased model
+        d, kvh, qh = config.head_dim, config.num_kv_heads, config.num_heads
+        layers["bq"] = jnp.zeros((n, qh * d), dtype)
+        layers["bk"] = jnp.zeros((n, kvh * d), dtype)
+        layers["bv"] = jnp.zeros((n, kvh * d), dtype)
     params: Params = {
         "embed": dense_init(k_embed, (config.vocab_size, h), h, dtype),
         "layers": layers,
@@ -410,6 +420,9 @@ def forward(
             are never expanded to a full delta matrix, so training memory
             stays rank-r (parallel/lora.py)."""
             y = mm(h_in, weights[name])
+            bias = _PROJ_BIAS.get(name)
+            if bias is not None and bias in weights:
+                y = y + weights[bias].astype(y.dtype)
             if layer_lora is not None and name in layer_lora:
                 a = layer_lora[name]["a"].astype(h_in.dtype)
                 bmat = layer_lora[name]["b"].astype(h_in.dtype)
@@ -526,9 +539,17 @@ def decode_step_paged(
         x = carry
         weights = scanned["w"]
         attn_in = rms_norm(x, weights["ln_attn"], config.rms_norm_eps)
-        q = mm(attn_in, weights["wq"]).reshape(b, 1, config.num_heads, config.head_dim)
-        k = mm(attn_in, weights["wk"]).reshape(b, 1, config.num_kv_heads, config.head_dim)
-        v = mm(attn_in, weights["wv"]).reshape(b, 1, config.num_kv_heads, config.head_dim)
+
+        def proj(name: str) -> jax.Array:
+            y = mm(attn_in, weights[name])
+            bias = _PROJ_BIAS.get(name)
+            if bias is not None and bias in weights:
+                y = y + weights[bias].astype(y.dtype)
+            return y
+
+        q = proj("wq").reshape(b, 1, config.num_heads, config.head_dim)
+        k = proj("wk").reshape(b, 1, config.num_kv_heads, config.head_dim)
+        v = proj("wv").reshape(b, 1, config.num_kv_heads, config.head_dim)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         k_pages = write_tokens(scanned["k"], paged.page_table, k, paged.lengths)

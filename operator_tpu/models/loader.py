@@ -37,6 +37,7 @@ from .llama import Params
 log = logging.getLogger(__name__)
 
 _LAYER_RE = re.compile(r"model\.layers\.(\d+)\.(.+)\.weight")
+_BIAS_RE = re.compile(r"model\.layers\.(\d+)\.self_attn\.([qkv])_proj\.bias")
 
 #: HF sub-name -> (our stacked name, transpose?)
 _LAYER_MAP = {
@@ -86,9 +87,19 @@ def convert_hf_state_dict(
     per_layer: dict[str, list[Optional[np.ndarray]]] = {
         ours: [None] * n for ours, _ in _LAYER_MAP.values()
     }
+    if config.attention_bias:
+        per_layer.update({f"b{axis}": [None] * n for axis in "qkv"})
     filled: dict[str, int] = {ours: 0 for ours in per_layer}
     layers: dict[str, jax.Array] = {}
     top: dict[str, jax.Array] = {}
+    def record(ours: str, idx: int, array: np.ndarray) -> None:
+        per_layer[ours][idx] = array
+        filled[ours] += 1
+        if filled[ours] == n:
+            # group complete: stack (native dtype), place, free host refs
+            layers[ours] = put(ours, np.stack(per_layer[ours]))
+            per_layer[ours] = []
+
     items = state.items() if hasattr(state, "items") else state
     for name, raw in items:
         if name == "model.embed_tokens.weight":
@@ -98,6 +109,14 @@ def convert_hf_state_dict(
         elif name == "lm_head.weight":
             top["lm_head"] = put("lm_head", _to_numpy(raw).T)
         else:
+            bias_match = _BIAS_RE.fullmatch(name)
+            if bias_match:
+                idx = int(bias_match.group(1))
+                if not config.attention_bias:
+                    log.debug("config has no attention_bias; ignoring %s", name)
+                elif idx < n:
+                    record(f"b{bias_match.group(2)}", idx, _to_numpy(raw))
+                continue
             match = _LAYER_RE.fullmatch(name)
             if not match:
                 log.debug("ignoring unknown checkpoint tensor %s", name)
@@ -111,12 +130,7 @@ def convert_hf_state_dict(
             if idx >= n:
                 continue  # scaled-down config loads a prefix of the layers
             array = _to_numpy(raw)
-            per_layer[ours][idx] = array.T if transpose else array
-            filled[ours] += 1
-            if filled[ours] == n:
-                # group complete: stack (native dtype), place, free host refs
-                layers[ours] = put(ours, np.stack(per_layer[ours]))
-                per_layer[ours] = []
+            record(ours, idx, array.T if transpose else array)
 
     missing = [
         f"{ours}[{i}]"
@@ -221,6 +235,16 @@ def save_params(
             for i in range(config.num_layers):
                 tensor = stacked[i].T if transpose else stacked[i]
                 yield f"model.layers.{i}.{hf}.weight", np.ascontiguousarray(tensor)
+            del stacked
+        for axis in "qkv":
+            if f"b{axis}" not in params["layers"]:
+                continue
+            stacked = np.asarray(params["layers"][f"b{axis}"])
+            for i in range(config.num_layers):
+                yield (
+                    f"model.layers.{i}.self_attn.{axis}_proj.bias",
+                    np.ascontiguousarray(stacked[i]),
+                )
             del stacked
 
     # pack + write shard-by-shard; rename to the final -of-NNNNN names once

@@ -162,6 +162,12 @@ def param_specs(
         "ln_attn": P(None, None),
         "ln_mlp": P(None, None),
     }
+    if config.attention_bias:
+        # Qwen2 q/k/v biases live on the projections' OUTPUT axis, which is
+        # tp-sharded — the bias add happens on the tp-local shard
+        layer_specs["bq"] = P(None, "tp")
+        layer_specs["bk"] = P(None, "tp")
+        layer_specs["bv"] = P(None, "tp")
     if quantized:
         from ..models.quant import QUANTIZED_LAYER_MATRICES
 
