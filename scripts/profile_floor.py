@@ -95,23 +95,26 @@ def main():
         x = x + (gate * up) @ w["w_down"]
         return x * 0.999, None
 
-    def one_token(x):
+    def one_token(x, layers, head):
         x, _ = jax.lax.scan(layer_step, x, layers)
         logits = (x @ head).astype(jnp.float32)
         return x * 0.9 + logits[:, :H].astype(jnp.bfloat16) * 1e-6
 
     for K in (1, 8):
+        # weights are runtime ARGUMENTS, not closed-over constants: capturing
+        # 2 GB as constants makes lowering/compile pathologically slow on a
+        # tunneled backend and lets XLA constant-fold the thing being measured
         @jax.jit
-        def block(x, K=K):
+        def block(x, layers, head, K=K):
             def body(x, _):
-                return one_token(x), None
+                return one_token(x, layers, head), None
             x, _ = jax.lax.scan(body, x, None, length=K)
             return x
 
         x0 = jax.random.normal(key, (B, H), jnp.bfloat16)
         state3 = {"x": x0}
         def step3():
-            state3["x"] = block(state3["x"])
+            state3["x"] = block(state3["x"], layers, head)
             return state3["x"][:1, :1]
         dt = fetch_time(step3, iters=8)
         per = dt / K

@@ -9,10 +9,26 @@ OUT=$(realpath -m "${1:-/tmp/r3_experiments}")  # absolute BEFORE the cd below
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 
+wait_chip() {  # block until the TPU answers a device probe (a step killed at
+  # its timebox can leave the tunnel holding the chip for a while; starting
+  # the next step immediately makes its backend probe hang -> cpu fallback)
+  for _ in $(seq 1 30); do
+    if timeout 60 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" \
+        > /dev/null 2>&1; then
+      return 0
+    fi
+    echo "  (chip busy; waiting)" | tee -a "$OUT/series.log"
+    sleep 10
+  done
+  echo "  chip never came back" | tee -a "$OUT/series.log"
+  return 1
+}
+
 run() {  # run <name> <timeout_s> <cmd...>
   local name=$1 tmo=$2; shift 2
   echo "=== $name ($(date +%H:%M:%S)) ===" | tee -a "$OUT/series.log"
-  timeout "$tmo" "$@" > "$OUT/$name.log" 2>&1
+  wait_chip || { echo "skipped $name (no chip)" | tee -a "$OUT/series.log"; return 1; }
+  timeout --kill-after=30 "$tmo" "$@" > "$OUT/$name.log" 2>&1
   echo "rc=$? $name" | tee -a "$OUT/series.log"
 }
 
