@@ -13,5 +13,7 @@ __all__ = [
     "build_prompt",
     # lazy (import jax): serving.engine — BatchedGenerator, ServingEngine,
     # SamplingParams, GenerationResult; serving.provider —
-    # TPUNativeProvider, build_tpu_native_provider
+    # TPUNativeProvider, build_serving_engine, build_tpu_native_provider;
+    # serving.httpserver — CompletionServer (OpenAI-compatible API;
+    # `python -m operator_tpu.serving` serves it standalone)
 ]

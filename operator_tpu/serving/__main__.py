@@ -1,0 +1,60 @@
+"""Serve the TPU engine over the OpenAI wire format.
+
+    python -m operator_tpu.serving [--host 0.0.0.0] [--port 8000]
+
+Model/weights/mesh come from the same operator config env the cluster
+deployment uses (utils/config.py): OPERATOR_TPU_MODEL, CHECKPOINT_DIR,
+WEIGHT_DTYPE, SERVING_MESH, MAX_BATCH_SIZE, ... plus
+OPERATOR_TPU_API_TOKEN to require a bearer token.  This is the
+standalone-inference face of the framework — the in-cluster operator
+drives the identical engine in-process (serving/provider.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--host", default=os.environ.get("OPERATOR_TPU_HOST", "0.0.0.0"))
+    parser.add_argument(
+        "--port", type=int, default=int(os.environ.get("OPERATOR_TPU_PORT", "8000"))
+    )
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s"
+    )
+
+    platform = os.environ.get("OPERATOR_TPU_PLATFORM", "").strip()
+    if platform:
+        # the env's sitecustomize may force jax_platforms to the TPU plugin;
+        # only a live config update reliably pins another backend (same
+        # pattern as bench.py BENCH_PLATFORM / tests/conftest.py)
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+    from .httpserver import serve_forever
+    from .provider import build_serving_engine
+
+    engine, model_id = build_serving_engine()
+    try:
+        asyncio.run(
+            serve_forever(
+                engine,
+                model_id=model_id,
+                host=args.host,
+                port=args.port,
+                api_token=os.environ.get("OPERATOR_TPU_API_TOKEN") or None,
+            )
+        )
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

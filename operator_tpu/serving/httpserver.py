@@ -1,0 +1,369 @@
+"""OpenAI-compatible HTTP front for the batching engine.
+
+The reference's ai-interface is an internal REST service the operator
+calls (`AIInterfaceRestClient.java:26,37-39`); this module is its
+externally-callable form: any OpenAI SDK / curl user can drive the same
+continuous-batching TPU engine the operator uses in-process.
+
+Endpoints (stdlib asyncio, close-delimited HTTP/1.1 — same discipline as
+operator/httpserver.py):
+
+- ``GET  /v1/models``            — the one loaded model
+- ``POST /v1/completions``       — prompt (str or list), n, max_tokens,
+  temperature, top_p, stop; every prompt/replica joins the shared
+  continuous batch and decodes concurrently
+- ``POST /v1/chat/completions``  — messages flattened with a minimal
+  chat template (the operator's own prompts live in serving/prompts.py)
+- ``GET  /healthz``              — liveness for probes
+
+Deliberate non-features: ``stream`` returns 400 (the engine surfaces
+whole completions; SSE would add state for no operator value), logprobs
+are null, and ``stop`` sequences are applied by post-truncation (the
+jitted decode block has fixed shape; a stop hit sets finish_reason but
+the step still ran its block — honest accounting, not early exit).
+
+Auth: set ``api_token`` (env OPERATOR_TPU_API_TOKEN via the CLI) to
+require ``Authorization: Bearer <token>``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+import uuid
+from typing import Any, Optional
+
+from .engine import GenerationResult, SamplingParams, ServingEngine
+
+log = logging.getLogger(__name__)
+
+_MAX_HEADER_BYTES = 16384
+_MAX_BODY_BYTES = 10 << 20
+_READ_TIMEOUT_S = 30.0
+
+
+def _content_text(content: Any) -> str:
+    """Flatten OpenAI message content: plain string or content-parts list
+    (``[{"type": "text", "text": ...}, ...]``; non-text parts rejected)."""
+    if isinstance(content, str):
+        return content
+    if isinstance(content, list):
+        texts = []
+        for part in content:
+            if not isinstance(part, dict) or part.get("type") != "text" \
+                    or not isinstance(part.get("text"), str):
+                raise ValueError("only string or text content parts are supported")
+            texts.append(part["text"])
+        return "".join(texts)
+    raise ValueError("message content must be a string or list of text parts")
+
+
+def _chat_prompt(messages: list) -> str:
+    """Minimal role-tagged chat template.
+
+    The engine serves base/instruct checkpoints whose canonical template
+    lives with the tokenizer upstream; without egress we use a neutral
+    plain-text convention rather than guessing a model-specific one.
+    """
+    parts = []
+    for msg in messages:
+        if not isinstance(msg, dict) or "content" not in msg:
+            raise ValueError("each message needs 'role' and 'content'")
+        parts.append(f"{msg.get('role', 'user')}: {_content_text(msg['content'])}")
+    parts.append("assistant:")
+    return "\n".join(parts)
+
+
+def _truncate_at_stop(
+    result: GenerationResult, stop: list[str]
+) -> tuple[str, str]:
+    """Earliest stop-sequence occurrence wins; returns (text, finish_reason)."""
+    text = result.text
+    cut = -1
+    for seq in stop:
+        idx = text.find(seq)
+        if idx >= 0 and (cut < 0 or idx < cut):
+            cut = idx
+    if cut >= 0:
+        return text[:cut], "stop"
+    return text, result.finish_reason
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str, err_type: str = "invalid_request_error"):
+        super().__init__(message)
+        self.status = status
+        self.err_type = err_type
+
+
+class CompletionServer:
+    """Serve the shared ``ServingEngine`` over the OpenAI wire format."""
+
+    def __init__(
+        self,
+        engine: ServingEngine,
+        *,
+        model_id: str,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        api_token: Optional[str] = None,
+        max_tokens_cap: int = 2048,
+    ) -> None:
+        self.engine = engine
+        self.model_id = model_id
+        self.host = host
+        self.port = port
+        self.api_token = api_token
+        self.max_tokens_cap = max_tokens_cap
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._started = time.time()
+
+    @property
+    def bound_port(self) -> Optional[int]:
+        if self._server is None or not self._server.sockets:
+            return None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def start(self) -> None:
+        await self.engine.start()
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        log.info("completion api listening on %s:%s", self.host, self.bound_port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # -- http plumbing ------------------------------------------------------
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        status, payload = 500, {"error": {"message": "internal error"}}
+        try:
+            method, path, headers, body = await self._read_request(reader)
+            if path.split("?", 1)[0] != "/healthz":  # probes can't carry tokens
+                self._check_auth(headers)
+            status, payload = await self._route(method, path, body)
+        except ApiError as exc:
+            status = exc.status
+            payload = {"error": {"message": str(exc), "type": exc.err_type, "code": None}}
+        except asyncio.TimeoutError:
+            status = 408
+            payload = {"error": {"message": "request read timed out",
+                                 "type": "invalid_request_error", "code": None}}
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            # TCP health probes / port scans connect and hang up without a
+            # full request — a normal disconnect, not an error to log
+            writer.close()
+            return
+        except asyncio.CancelledError:
+            # engine shutdown resolves in-flight futures with CancelledError
+            # (BaseException: would otherwise skip the response entirely and
+            # strand the client); the handler task itself is not cancelled
+            # by server.close(), so answering 503 here is always safe
+            status = 503
+            payload = {"error": {"message": "server shutting down",
+                                 "type": "server_error", "code": None}}
+        except Exception:  # noqa: BLE001 - never leak a traceback to the wire
+            log.exception("completion api request failed")
+        try:
+            data = json.dumps(payload).encode()
+            writer.write(
+                f"HTTP/1.1 {status} {'OK' if status < 400 else 'Error'}\r\n"
+                f"Content-Type: application/json\r\n"
+                f"Content-Length: {len(data)}\r\n"
+                f"Connection: close\r\n\r\n".encode() + data
+            )
+            await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+    async def _read_request(self, reader: asyncio.StreamReader):
+        head = await asyncio.wait_for(
+            reader.readuntil(b"\r\n\r\n"), timeout=_READ_TIMEOUT_S
+        )
+        if len(head) > _MAX_HEADER_BYTES:
+            raise ApiError(431, "headers too large")
+        request_line, *header_lines = head.decode("latin-1").split("\r\n")
+        parts = request_line.split()
+        if len(parts) != 3:
+            raise ApiError(400, "malformed request line")
+        method, path = parts[0].upper(), parts[1]
+        headers = {}
+        for line in header_lines:
+            if ":" in line:
+                key, value = line.split(":", 1)
+                headers[key.strip().lower()] = value.strip()
+        body = b""
+        length = int(headers.get("content-length", "0") or "0")
+        if length > _MAX_BODY_BYTES:
+            raise ApiError(413, "request body too large")
+        if length:
+            body = await asyncio.wait_for(
+                reader.readexactly(length), timeout=_READ_TIMEOUT_S
+            )
+        return method, path, headers, body
+
+    def _check_auth(self, headers: dict) -> None:
+        if not self.api_token:
+            return
+        import hmac
+
+        supplied = headers.get("authorization", "")
+        if not hmac.compare_digest(supplied, f"Bearer {self.api_token}"):
+            raise ApiError(401, "missing or invalid bearer token", "authentication_error")
+
+    # -- routing ------------------------------------------------------------
+
+    async def _route(self, method: str, path: str, body: bytes):
+        path = path.split("?", 1)[0]
+        if method == "GET" and path == "/healthz":
+            return 200, {"status": "ok", "uptime_s": round(time.time() - self._started, 1)}
+        if method == "GET" and path == "/v1/models":
+            return 200, {
+                "object": "list",
+                "data": [{
+                    "id": self.model_id,
+                    "object": "model",
+                    "created": int(self._started),
+                    "owned_by": "operator-tpu",
+                }],
+            }
+        if method == "POST" and path == "/v1/completions":
+            return await self._completions(self._parse_json(body), chat=False)
+        if method == "POST" and path == "/v1/chat/completions":
+            return await self._completions(self._parse_json(body), chat=True)
+        raise ApiError(404, f"no route for {method} {path}")
+
+    @staticmethod
+    def _parse_json(body: bytes) -> dict:
+        try:
+            parsed = json.loads(body or b"null")
+        except json.JSONDecodeError as exc:
+            raise ApiError(400, f"body is not valid JSON: {exc}") from None
+        if not isinstance(parsed, dict):
+            raise ApiError(400, "body must be a JSON object")
+        return parsed
+
+    # -- completion handling -------------------------------------------------
+
+    def _sampling(self, req: dict) -> tuple[SamplingParams, list[str]]:
+        if req.get("stream"):
+            raise ApiError(400, "stream=true is not supported; poll the non-streaming API")
+        max_tokens = req.get("max_tokens", 256)
+        if not isinstance(max_tokens, int) or max_tokens < 1:
+            raise ApiError(400, "max_tokens must be a positive integer")
+        max_tokens = min(max_tokens, self.max_tokens_cap)
+        temperature = req.get("temperature", 0.3)
+        top_p = req.get("top_p", 0.95)
+        for name, value in (("temperature", temperature), ("top_p", top_p)):
+            if not isinstance(value, (int, float)) or value < 0:
+                raise ApiError(400, f"{name} must be a non-negative number")
+        stop = req.get("stop") or []
+        if isinstance(stop, str):
+            stop = [stop]
+        if not isinstance(stop, list) or not all(isinstance(s, str) for s in stop):
+            raise ApiError(400, "stop must be a string or list of strings")
+        params = SamplingParams(
+            max_tokens=max_tokens, temperature=float(temperature), top_p=float(top_p)
+        )
+        return params, stop
+
+    async def _completions(self, req: dict, *, chat: bool):
+        params, stop = self._sampling(req)
+        n = req.get("n", 1)
+        if not isinstance(n, int) or not 1 <= n <= 16:
+            raise ApiError(400, "n must be an integer in [1, 16]")
+
+        if chat:
+            messages = req.get("messages")
+            if not isinstance(messages, list) or not messages:
+                raise ApiError(400, "messages must be a non-empty list")
+            try:
+                prompts = [_chat_prompt(messages)]
+            except ValueError as exc:
+                raise ApiError(400, str(exc)) from None
+        else:
+            prompt = req.get("prompt")
+            if isinstance(prompt, str):
+                prompts = [prompt]
+            elif isinstance(prompt, list) and prompt and all(
+                isinstance(p, str) for p in prompt
+            ):
+                prompts = prompt
+            else:
+                raise ApiError(400, "prompt must be a string or non-empty list of strings")
+
+        # every replica of every prompt joins the shared continuous batch
+        jobs = [p for p in prompts for _ in range(n)]
+        try:
+            results = await asyncio.gather(
+                *(self.engine.generate(p, params) for p in jobs)
+            )
+        except RuntimeError as exc:
+            raise ApiError(503, f"engine unavailable: {exc}", "server_error") from None
+
+        choices = []
+        usage_prompt = usage_completion = 0
+        for index, result in enumerate(results):
+            text, finish = _truncate_at_stop(result, stop)
+            usage_prompt += result.prompt_tokens
+            usage_completion += result.completion_tokens
+            if chat:
+                choices.append({
+                    "index": index,
+                    "message": {"role": "assistant", "content": text},
+                    "logprobs": None,
+                    "finish_reason": finish,
+                })
+            else:
+                choices.append({
+                    "index": index,
+                    "text": text,
+                    "logprobs": None,
+                    "finish_reason": finish,
+                })
+        kind = "chat.completion" if chat else "text_completion"
+        prefix = "chatcmpl" if chat else "cmpl"
+        return 200, {
+            "id": f"{prefix}-{uuid.uuid4().hex[:24]}",
+            "object": kind,
+            "created": int(time.time()),
+            "model": req.get("model") or self.model_id,
+            "choices": choices,
+            "usage": {
+                "prompt_tokens": usage_prompt,
+                "completion_tokens": usage_completion,
+                "total_tokens": usage_prompt + usage_completion,
+            },
+        }
+
+
+async def serve_forever(
+    engine: ServingEngine,
+    *,
+    model_id: str,
+    host: str = "0.0.0.0",
+    port: int = 8000,
+    api_token: Optional[str] = None,
+) -> None:
+    """Run the completion API until cancelled (SIGINT/SIGTERM via CLI)."""
+    server = CompletionServer(
+        engine, model_id=model_id, host=host, port=port, api_token=api_token
+    )
+    await server.start()
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await server.stop()
+        await engine.close()

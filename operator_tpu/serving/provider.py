@@ -96,14 +96,16 @@ class TPUNativeProvider:
         )
 
 
-def build_tpu_native_provider(
+def build_serving_engine(
     config: Optional[OperatorConfig] = None,
-) -> TPUNativeProvider:
-    """Factory for ProviderRegistry.register_factory('tpu-native', ...).
+) -> "tuple[ServingEngine, str]":
+    """Build the shared batching engine from operator config.
 
-    Loads weights (checkpoint if configured, random init otherwise) and
-    builds the shared engine once; every AIProvider CR with
-    ``providerId: tpu-native`` then multiplexes onto the same batch.
+    Loads weights (checkpoint if configured, random init otherwise when
+    ``allow_random_weights``), applies the serving mesh, and wraps the
+    generator in a ``ServingEngine``.  Shared by the in-process
+    ``tpu-native`` provider and the OpenAI-compatible HTTP server
+    (serving/httpserver.py).  Returns ``(engine, model_id)``.
     """
     import jax
     import jax.numpy as jnp
@@ -179,5 +181,16 @@ def build_tpu_native_provider(
         pipeline_depth=config.pipeline_depth,
         sample_top_k=config.sample_top_k,
     )
-    engine = ServingEngine(generator)
+    return ServingEngine(generator), model_id
+
+
+def build_tpu_native_provider(
+    config: Optional[OperatorConfig] = None,
+) -> TPUNativeProvider:
+    """Factory for ProviderRegistry.register_factory('tpu-native', ...).
+
+    Builds the shared engine once; every AIProvider CR with
+    ``providerId: tpu-native`` then multiplexes onto the same batch.
+    """
+    engine, model_id = build_serving_engine(config)
     return TPUNativeProvider(engine, model_id=model_id)

@@ -26,8 +26,16 @@ wait_chip() {  # block until the TPU answers a device probe (a step killed at
 
 run() {  # run <name> <timeout_s> <cmd...>
   local name=$1 tmo=$2; shift 2
+  # resumable: a relaunch after a mid-series tunnel death (watcher rc=2
+  # loop) skips steps that already completed cleanly
+  if grep -q "^rc=0 $name\$" "$OUT/series.log" 2>/dev/null; then
+    echo "skip $name (already done)" | tee -a "$OUT/series.log"
+    return 0
+  fi
   echo "=== $name ($(date +%H:%M:%S)) ===" | tee -a "$OUT/series.log"
-  wait_chip || { echo "skipped $name (no chip)" | tee -a "$OUT/series.log"; return 1; }
+  # a dead tunnel fails every step: abort the series rather than serially
+  # burning each step's full wait window (an outer watcher relaunches)
+  wait_chip || { echo "ABORT series at $name (no chip)" | tee -a "$OUT/series.log"; exit 2; }
   timeout --kill-after=30 "$tmo" "$@" > "$OUT/$name.log" 2>&1
   echo "rc=$? $name" | tee -a "$OUT/series.log"
 }

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Recovery watcher: poll until the TPU tunnel answers, then run the full
+# experiment series once.  Survives tunnel outages that outlast any single
+# step's wait window (scripts/tpu_experiments.sh aborts fast on a dead
+# tunnel; this relaunches it when the chip returns).
+set -u
+OUT=$(realpath -m "${1:-/root/r3_experiments}")
+cd "$(dirname "$0")/.."
+mkdir -p "$OUT"
+echo "watcher start $(date +%H:%M:%S)" >> "$OUT/watcher.log"
+while true; do
+  if timeout 90 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" \
+      > /dev/null 2>&1; then
+    echo "chip up $(date +%H:%M:%S); launching series" >> "$OUT/watcher.log"
+    bash scripts/tpu_experiments.sh "$OUT"
+    rc=$?
+    echo "series rc=$rc $(date +%H:%M:%S)" >> "$OUT/watcher.log"
+    # rc=2 means the tunnel died mid-series: go back to polling and rerun
+    [ "$rc" != 2 ] && break
+  else
+    echo "chip down $(date +%H:%M:%S)" >> "$OUT/watcher.log"
+    sleep 120
+  fi
+done
+echo "watcher done $(date +%H:%M:%S)" >> "$OUT/watcher.log"
