@@ -175,6 +175,11 @@ class BatchedGenerator:
                 f"stop margin — generations would truncate immediately"
             )
         self.pipeline_depth = pipeline_depth
+        #: optional ``hook(slot_id, token_ids_so_far)`` called after each
+        #: processed block for slots that are still generating — the
+        #: streaming feed (ServingEngine marshals it onto the event loop).
+        #: Called from the decode worker thread; must not block.
+        self.partial_hook: Optional[Any] = None
         self._inflight_blocks: list[tuple[Any, dict]] = []
 
         # ---- sharded serving (BASELINE configs 3/5): params TP on heads /
@@ -811,6 +816,7 @@ class BatchedGenerator:
             # block was dispatched: its lanes hold junk for the new epoch
             if not slot.active or self._slot_epoch[i] != epoch:
                 continue
+            generated_before = len(slot.generated)
             for k in range(block):
                 token = int(toks_np[k, i])
                 previous = slot.generated[-1] if slot.generated else None
@@ -835,6 +841,17 @@ class BatchedGenerator:
                 ):
                     finished.append((i, self._finish(i, reason="length")))
                     break
+            if (
+                self.partial_hook is not None
+                # identity: _finish() swaps in a fresh _Slot, so a slot that
+                # finished inside this block is skipped (its result carries
+                # the tail) — `slot.active` alone would read the OLD object
+                and self.slots[i] is slot
+                and len(slot.generated) > generated_before
+            ):
+                # list COPY: the hook crosses into the event-loop thread
+                # while this worker keeps appending
+                self.partial_hook(i, list(slot.generated))
         return finished
 
     def _finish(self, slot_id: int, *, reason: str) -> GenerationResult:
@@ -920,6 +937,13 @@ class ServingEngine:
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
         self._pending: dict[int, asyncio.Future] = {}  # slot id -> future
         self._inflight: list = []  # popped from queue, not yet in _pending
+        # streaming: future -> on_partial registered in generate(); slot ->
+        # on_partial once admitted.  The generator's hook fires on the
+        # decode worker; call_soon_threadsafe marshals it onto the loop.
+        self._partial_by_future: dict[asyncio.Future, Any] = {}
+        self._partial_cbs: dict[int, Any] = {}
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        generator.partial_hook = self._on_partial_from_worker
         self._stalled_avail: Optional[int] = None  # pages free at last stall
         self._task: Optional[asyncio.Task] = None
         self._closed = False
@@ -939,8 +963,19 @@ class ServingEngine:
             return False
         return True
 
+    def _on_partial_from_worker(self, slot_id: int, token_ids: list) -> None:
+        """Generator hook (decode worker thread) -> event-loop callback."""
+        entry = self._partial_cbs.get(slot_id)
+        if entry is None or self._loop is None:
+            return
+        callback, future = entry
+        if future.done():  # streaming client cancelled; slot drains unheard
+            return
+        self._loop.call_soon_threadsafe(callback, token_ids)
+
     async def start(self) -> None:
         if self._task is None:
+            self._loop = asyncio.get_running_loop()
             self._task = asyncio.create_task(self._run(), name="serving-engine")
 
     async def close(self) -> None:
@@ -957,6 +992,8 @@ class ServingEngine:
 
     def _fail_outstanding(self, exc: BaseException) -> None:
         """Resolve every in-flight and queued future so callers never hang."""
+        self._partial_cbs.clear()
+        self._partial_by_future.clear()
         for future in self._pending.values():
             if not future.done():
                 future.set_exception(exc)
@@ -971,8 +1008,15 @@ class ServingEngine:
                 future.set_exception(exc)
 
     async def generate(
-        self, prompt: str, params: Optional[SamplingParams] = None
+        self,
+        prompt: str,
+        params: Optional[SamplingParams] = None,
+        *,
+        on_partial: Optional[Any] = None,
     ) -> GenerationResult:
+        """Generate; ``on_partial(token_ids_so_far)`` (if given) fires on the
+        event loop after each decode block while the request is generating —
+        the streaming feed for the completion API (serving/httpserver.py)."""
         if self._closed:
             raise RuntimeError("serving engine is closed")
         if self._error is not None:
@@ -980,11 +1024,14 @@ class ServingEngine:
         if self._task is None:
             await self.start()
         future: asyncio.Future = asyncio.get_running_loop().create_future()
+        if on_partial is not None:
+            self._partial_by_future[future] = on_partial
         await self._queue.put((prompt, params or SamplingParams(), future))
         # the put may have landed after close()/loop-death drained the
         # queue; _closed/_error were set before the drain, so re-checking
         # here closes that window
         if (self._closed or self._error is not None) and not future.done():
+            self._partial_by_future.pop(future, None)
             future.set_exception(RuntimeError("serving engine is closed"))
         return await future
 
@@ -1046,6 +1093,7 @@ class ServingEngine:
                     self._executor, self.generator.step
                 )
                 for slot_id, result in finished:
+                    self._partial_cbs.pop(slot_id, None)
                     future = self._pending.pop(slot_id, None)
                     if future is not None and not future.done():
                         future.set_result(result)
@@ -1063,6 +1111,7 @@ class ServingEngine:
             # only the head request is impossible; fail it alone and let
             # the rest retry next round
             _, _, future = batch[0]
+            self._partial_by_future.pop(future, None)
             if not future.done():
                 future.set_exception(exc)
             return 1
@@ -1070,9 +1119,15 @@ class ServingEngine:
             # the batch futures are out of the queue but not yet in
             # _pending — fail them here or their callers hang forever
             for _, _, future in batch:
+                self._partial_by_future.pop(future, None)
                 if not future.done():
                     future.set_exception(exc)
             raise
         for slot_id, (_, _, future) in zip(slot_ids, batch):
             self._pending[slot_id] = future
+            callback = self._partial_by_future.pop(future, None)
+            if callback is not None:
+                # future travels with the callback so the worker-side hook
+                # can drop deltas once the streaming client is gone
+                self._partial_cbs[slot_id] = (callback, future)
         return len(slot_ids)

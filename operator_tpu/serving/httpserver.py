@@ -16,11 +16,15 @@ operator/httpserver.py):
   chat template (the operator's own prompts live in serving/prompts.py)
 - ``GET  /healthz``              — liveness for probes
 
-Deliberate non-features: ``stream`` returns 400 (the engine surfaces
-whole completions; SSE would add state for no operator value), logprobs
-are null, and ``stop`` sequences are applied by post-truncation (the
-jitted decode block has fixed shape; a stop hit sets finish_reason but
-the step still ran its block — honest accounting, not early exit).
+``stream: true`` serves Server-Sent Events: one OpenAI-format chunk per
+decode BLOCK (the engine's host-sync granularity — per-token events
+would fabricate a cadence the device doesn't have), then ``[DONE]``.
+Streaming is per-request (n=1, single prompt), like the SDKs use it.
+
+Deliberate non-features: logprobs are null, and ``stop`` sequences are
+applied by post-truncation (the jitted decode block has fixed shape; a
+stop hit sets finish_reason but the step still ran its block — honest
+accounting, not early exit).
 
 Auth: set ``api_token`` (env OPERATOR_TPU_API_TOKEN via the CLI) to
 require ``Authorization: Bearer <token>``.
@@ -42,6 +46,9 @@ log = logging.getLogger(__name__)
 _MAX_HEADER_BYTES = 16384
 _MAX_BODY_BYTES = 10 << 20
 _READ_TIMEOUT_S = 30.0
+
+#: sentinel: the handler already wrote the (SSE) response to the socket
+_STREAMED = object()
 
 
 def _content_text(content: Any) -> str:
@@ -76,19 +83,24 @@ def _chat_prompt(messages: list) -> str:
     return "\n".join(parts)
 
 
+def _earliest_stop(text: str, stop: list[str]) -> Optional[int]:
+    """Index of the earliest stop-sequence occurrence, or None."""
+    cut = None
+    for seq in stop:
+        idx = text.find(seq)
+        if idx >= 0 and (cut is None or idx < cut):
+            cut = idx
+    return cut
+
+
 def _truncate_at_stop(
     result: GenerationResult, stop: list[str]
 ) -> tuple[str, str]:
     """Earliest stop-sequence occurrence wins; returns (text, finish_reason)."""
-    text = result.text
-    cut = -1
-    for seq in stop:
-        idx = text.find(seq)
-        if idx >= 0 and (cut < 0 or idx < cut):
-            cut = idx
-    if cut >= 0:
-        return text[:cut], "stop"
-    return text, result.finish_reason
+    cut = _earliest_stop(result.text, stop)
+    if cut is not None:
+        return result.text[:cut], "stop"
+    return result.text, result.finish_reason
 
 
 class ApiError(Exception):
@@ -147,7 +159,7 @@ class CompletionServer:
             method, path, headers, body = await self._read_request(reader)
             if path.split("?", 1)[0] != "/healthz":  # probes can't carry tokens
                 self._check_auth(headers)
-            status, payload = await self._route(method, path, body)
+            status, payload = await self._route(method, path, body, writer)
         except ApiError as exc:
             status = exc.status
             payload = {"error": {"message": str(exc), "type": exc.err_type, "code": None}}
@@ -170,6 +182,13 @@ class CompletionServer:
                                  "type": "server_error", "code": None}}
         except Exception:  # noqa: BLE001 - never leak a traceback to the wire
             log.exception("completion api request failed")
+        if payload is _STREAMED:  # response already written chunk by chunk
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+            return
         try:
             data = json.dumps(payload).encode()
             writer.write(
@@ -225,7 +244,7 @@ class CompletionServer:
 
     # -- routing ------------------------------------------------------------
 
-    async def _route(self, method: str, path: str, body: bytes):
+    async def _route(self, method: str, path: str, body: bytes, writer):
         path = path.split("?", 1)[0]
         if method == "GET" and path == "/healthz":
             return 200, {"status": "ok", "uptime_s": round(time.time() - self._started, 1)}
@@ -240,9 +259,9 @@ class CompletionServer:
                 }],
             }
         if method == "POST" and path == "/v1/completions":
-            return await self._completions(self._parse_json(body), chat=False)
+            return await self._completions(self._parse_json(body), chat=False, writer=writer)
         if method == "POST" and path == "/v1/chat/completions":
-            return await self._completions(self._parse_json(body), chat=True)
+            return await self._completions(self._parse_json(body), chat=True, writer=writer)
         raise ApiError(404, f"no route for {method} {path}")
 
     @staticmethod
@@ -258,8 +277,6 @@ class CompletionServer:
     # -- completion handling -------------------------------------------------
 
     def _sampling(self, req: dict) -> tuple[SamplingParams, list[str]]:
-        if req.get("stream"):
-            raise ApiError(400, "stream=true is not supported; poll the non-streaming API")
         max_tokens = req.get("max_tokens", 256)
         if not isinstance(max_tokens, int) or max_tokens < 1:
             raise ApiError(400, "max_tokens must be a positive integer")
@@ -279,7 +296,7 @@ class CompletionServer:
         )
         return params, stop
 
-    async def _completions(self, req: dict, *, chat: bool):
+    async def _completions(self, req: dict, *, chat: bool, writer=None):
         params, stop = self._sampling(req)
         n = req.get("n", 1)
         if not isinstance(n, int) or not 1 <= n <= 16:
@@ -303,6 +320,12 @@ class CompletionServer:
                 prompts = prompt
             else:
                 raise ApiError(400, "prompt must be a string or non-empty list of strings")
+
+        if req.get("stream"):
+            if n != 1 or len(prompts) != 1:
+                raise ApiError(400, "stream=true requires n=1 and a single prompt")
+            await self._stream(writer, prompts[0], params, stop, req, chat=chat)
+            return 200, _STREAMED
 
         # every replica of every prompt joins the shared continuous batch
         jobs = [p for p in prompts for _ in range(n)]
@@ -347,6 +370,124 @@ class CompletionServer:
                 "total_tokens": usage_prompt + usage_completion,
             },
         }
+
+
+    # -- streaming -----------------------------------------------------------
+
+    async def _stream(
+        self,
+        writer: asyncio.StreamWriter,
+        prompt: str,
+        params: SamplingParams,
+        stop: list[str],
+        req: dict,
+        *,
+        chat: bool,
+    ) -> None:
+        """Write one SSE chunk per decode block, then [DONE] and close.
+
+        Emission holds back an unstable tail so what is sent is never
+        retracted: trailing U+FFFD (an incomplete UTF-8 sequence mid-block
+        decodes to a replacement char that a later block may *replace* with
+        the real character) and ``max(len(stop))-1`` chars (a stop sequence
+        may span a block boundary; the non-streaming truncation must never
+        cut below already-sent text).  Engine failures after the SSE
+        headers surface as an OpenAI-style ``{"error": ...}`` event — a
+        second HTTP response can never be written into an open stream.
+        """
+        tokenizer = self.engine.generator.tokenizer
+        updates: asyncio.Queue = asyncio.Queue()
+        job = asyncio.ensure_future(
+            self.engine.generate(prompt, params, on_partial=updates.put_nowait)
+        )
+        job.add_done_callback(lambda _: updates.put_nowait(None))  # wake the loop
+
+        ident = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:24]}"
+        created = int(time.time())
+        model = req.get("model") or self.model_id
+        kind = "chat.completion.chunk" if chat else "text_completion"
+        stop_holdback = max((len(s) for s in stop), default=0)
+        stop_holdback = stop_holdback - 1 if stop_holdback else 0
+
+        def chunk(delta_text: Optional[str], finish: Optional[str]) -> bytes:
+            if chat:
+                delta: dict = {}
+                if delta_text is not None:
+                    delta = {"role": "assistant", "content": delta_text}
+                choice = {"index": 0, "delta": delta, "finish_reason": finish}
+            else:
+                choice = {"index": 0, "text": delta_text or "",
+                          "logprobs": None, "finish_reason": finish}
+            event = {"id": ident, "object": kind, "created": created,
+                     "model": model, "choices": [choice]}
+            return f"data: {json.dumps(event)}\n\n".encode()
+
+        def stable_prefix(text: str) -> str:
+            """Strip the tail that a later block might rewrite."""
+            end = len(text)
+            while end > 0 and text[end - 1] == "�":
+                end -= 1  # incomplete multi-byte sequence still in flight
+            return text[: max(0, end - stop_holdback)]
+
+        writer.write(
+            b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: text/event-stream\r\n"
+            b"Cache-Control: no-cache\r\n"
+            b"Connection: close\r\n\r\n"
+        )
+        sent_text = ""
+        stopped = False
+        try:
+            await writer.drain()
+            while True:
+                token_ids = await updates.get()
+                if token_ids is None:
+                    break
+                if stopped:
+                    continue  # drain remaining deltas past a stop match
+                text = tokenizer.decode(token_ids)
+                cut = _earliest_stop(text, stop)
+                if cut is not None:
+                    text, stopped = text[:cut], True
+                else:
+                    text = stable_prefix(text)
+                if len(text) > len(sent_text) and text.startswith(sent_text):
+                    writer.write(chunk(text[len(sent_text):], None))
+                    await writer.drain()
+                    sent_text = text
+            try:
+                result = await job
+            except asyncio.CancelledError:
+                if not job.done():
+                    raise  # this handler task was cancelled, not the engine
+                # engine shutdown resolved the future with CancelledError
+                writer.write(
+                    b'data: {"error": {"message": "server shutting down", '
+                    b'"type": "server_error", "code": null}}\n\n'
+                    b"data: [DONE]\n\n"
+                )
+                await writer.drain()
+                return
+            except Exception as exc:  # engine failure mid-stream
+                log.exception("stream generation failed")
+                event = {"error": {"message": str(exc) or type(exc).__name__,
+                                   "type": "server_error", "code": None}}
+                writer.write(
+                    f"data: {json.dumps(event)}\n\ndata: [DONE]\n\n".encode()
+                )
+                await writer.drain()
+                return
+            text, finish = _truncate_at_stop(result, stop)
+            if len(text) > len(sent_text) and text.startswith(sent_text):
+                writer.write(chunk(text[len(sent_text):], None))
+            writer.write(chunk(None, "stop" if stopped else finish))
+            writer.write(b"data: [DONE]\n\n")
+            await writer.drain()
+        except OSError:  # client went away mid-stream (reset/abort/pipe)
+            job.cancel()
+        finally:
+            if not job.done():
+                job.cancel()
 
 
 async def serve_forever(

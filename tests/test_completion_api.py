@@ -178,6 +178,111 @@ def test_stop_sequence_truncates(server_port):
     assert "\x00" not in choice["text"]
 
 
+def _stream_events(port, path, body, token="sekrit"):
+    """POST with stream=true; returns the parsed SSE data payloads."""
+
+    async def go():
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        payload = json.dumps(body).encode()
+        writer.write(
+            f"POST {path} HTTP/1.1\r\nHost: t\r\n"
+            f"Authorization: Bearer {token}\r\n"
+            f"Content-Length: {len(payload)}\r\n\r\n".encode() + payload
+        )
+        await writer.drain()
+        raw = await asyncio.wait_for(reader.read(), timeout=120)
+        writer.close()
+        return raw
+
+    raw = asyncio.run(go())
+    head, _, stream = raw.partition(b"\r\n\r\n")
+    assert b"200" in head.split(b"\r\n")[0], head
+    assert b"text/event-stream" in head
+    events = []
+    for line in stream.decode().split("\n"):
+        if line.startswith("data: "):
+            data = line[len("data: "):]
+            events.append(None if data == "[DONE]" else json.loads(data))
+    return events
+
+
+def test_streaming_completion(server_port):
+    events = _stream_events(
+        server_port, "/v1/completions",
+        {"prompt": "stream me", "max_tokens": 6, "temperature": 0.0,
+         "stream": True},
+    )
+    assert events[-1] is None  # [DONE] terminator
+    chunks = events[:-1]
+    assert chunks, "no stream chunks before [DONE]"
+    assert all(c["object"] == "text_completion" for c in chunks)
+    # deltas concatenate to the full text; final chunk carries finish_reason
+    assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+    assert all(c["choices"][0]["finish_reason"] is None for c in chunks[:-1])
+    text = "".join(c["choices"][0]["text"] for c in chunks)
+    assert isinstance(text, str)
+    # multiple block-granularity events for a 6-token request at block=2
+    assert len(chunks) >= 2
+
+
+def test_streaming_chat_matches_nonstream_tokens(server_port):
+    """Greedy streaming must reassemble to the same text the non-streaming
+    path returns for the same prompt."""
+    request = {"messages": [{"role": "user", "content": "compare me"}],
+               "max_tokens": 6, "temperature": 0.0}
+    status, body = _request(
+        server_port, "POST", "/v1/chat/completions", request)
+    assert status == 200
+    expected = body["choices"][0]["message"]["content"]
+
+    events = _stream_events(
+        server_port, "/v1/chat/completions", {**request, "stream": True})
+    chunks = [e for e in events if e is not None]
+    assert all(c["object"] == "chat.completion.chunk" for c in chunks)
+    text = "".join(
+        c["choices"][0]["delta"].get("content", "") for c in chunks
+    )
+    assert text == expected
+
+
+def test_streaming_stop_spanning_blocks_matches_nonstream(server_port):
+    """A stop sequence that straddles a decode-block boundary must truncate
+    the streamed concatenation exactly like the non-streaming path (the
+    emitter holds back len(stop)-1 chars so sent text is never retracted)."""
+    base = {"prompt": "span me", "max_tokens": 8, "temperature": 0.0}
+    status, body = _request(server_port, "POST", "/v1/completions", base)
+    assert status == 200
+    full = body["choices"][0]["text"]
+    if len(full) < 5:
+        pytest.skip("greedy output too short to span a block boundary")
+    # decode_block=2 and ~1 char per byte token: chars 3..4 straddle the
+    # boundary between the 2nd and 3rd blocks
+    stop_seq = full[3:5]
+
+    status, body = _request(
+        server_port, "POST", "/v1/completions", {**base, "stop": stop_seq})
+    assert status == 200
+    expected = body["choices"][0]["text"]
+
+    events = _stream_events(
+        server_port, "/v1/completions", {**base, "stop": stop_seq, "stream": True})
+    chunks = [e for e in events if e is not None]
+    text = "".join(c["choices"][0]["text"] for c in chunks)
+    assert text == expected
+    assert stop_seq not in text
+
+
+def test_streaming_rejects_fanout(server_port):
+    status, body = _request(
+        server_port, "POST", "/v1/completions",
+        {"prompt": ["a", "b"], "stream": True})
+    assert status == 400 and "stream" in body["error"]["message"]
+    status, body = _request(
+        server_port, "POST", "/v1/completions",
+        {"prompt": "a", "n": 2, "stream": True})
+    assert status == 400
+
+
 def test_auth_required(server_port):
     status, body = _request(server_port, "GET", "/v1/models", token=None)
     assert status == 401
@@ -194,11 +299,6 @@ def test_error_surface(server_port):
     # missing prompt
     status, body = _request(server_port, "POST", "/v1/completions", {})
     assert status == 400 and "prompt" in body["error"]["message"]
-    # stream unsupported
-    status, body = _request(
-        server_port, "POST", "/v1/completions",
-        {"prompt": "x", "stream": True})
-    assert status == 400 and "stream" in body["error"]["message"]
     # bad n
     status, body = _request(
         server_port, "POST", "/v1/completions", {"prompt": "x", "n": 0})
