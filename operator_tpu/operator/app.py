@@ -88,6 +88,8 @@ class Operator:
                 host=self.config.health_host,
                 port=self.config.health_port,
             )
+        self.completion_server = None  # started on demand (completion_api_port)
+        self.completion_task: Optional[asyncio.Task] = None
         self._stop = asyncio.Event()
         self._tasks: list[asyncio.Task] = []
 
@@ -124,6 +126,46 @@ class Operator:
             )
             return None
 
+    async def _start_completion_api(self) -> None:
+        """Serve the OpenAI-compatible API from the operator process on the
+        SAME engine the tpu-native provider uses (one shared batch for
+        in-cluster explanations and external callers).  Fully degrade-quietly:
+        an unusable engine (no jax, no checkpoint) or an unbindable port
+        disables the API with a warning — it must never take down the
+        operator control plane.  Runs as its own task so watcher/reconciler
+        startup is never serialised behind a multi-second weight load."""
+        engine = None
+        try:
+            from ..serving.httpserver import CompletionServer
+            from ..serving.provider import TPUNativeProvider, build_serving_engine
+
+            loop = asyncio.get_running_loop()
+            # weight loading blocks for seconds at 8B scale: keep probes live
+            engine, model_id = await loop.run_in_executor(
+                None, build_serving_engine, self.config
+            )
+            server = CompletionServer(
+                engine,
+                model_id=model_id,
+                host=self.config.completion_api_host,
+                port=self.config.completion_api_port,
+                api_token=self.config.completion_api_token or None,
+            )
+            await server.start()
+        except Exception:  # noqa: BLE001 - optional surface, degrade quietly
+            log.warning("completion api disabled", exc_info=True)
+            if engine is not None:  # free the loaded weights, not just leak them
+                await engine.close()
+            return
+        # register (not register_factory): overwrite any backend a pipeline
+        # already resolved from the lazy factory, so a stop/start cycle can
+        # never leave explanations on a CLOSED engine while HTTP callers get
+        # the new one
+        self.providers.register(
+            "tpu-native", TPUNativeProvider(engine, model_id=model_id)
+        )
+        self.completion_server = server
+
     # ------------------------------------------------------------------
     async def start(self) -> None:
         log.info("operator starting (namespaces: %s)",
@@ -131,6 +173,10 @@ class Operator:
         self._stop.clear()
         if self.health_server is not None:
             await self.health_server.start()
+        if self.config.completion_api_port >= 0:
+            self.completion_task = asyncio.create_task(
+                self._start_completion_api(), name="completion-api"
+            )
         self._tasks = [
             asyncio.create_task(self.watcher.run(self._stop), name="pod-watcher"),
             asyncio.create_task(self.podmortem_reconciler.run(self._stop), name="podmortem-reconciler"),
@@ -142,6 +188,14 @@ class Operator:
         self._stop.set()
         if self.health_server is not None:
             await self.health_server.stop()
+        if self.completion_task is not None and not self.completion_task.done():
+            self.completion_task.cancel()  # stop mid-weight-load
+            await asyncio.gather(self.completion_task, return_exceptions=True)
+        self.completion_task = None
+        if self.completion_server is not None:
+            await self.completion_server.stop()
+            await self.completion_server.engine.close()
+            self.completion_server = None
         await self.watcher.drain()
         for task in self._tasks:
             task.cancel()

@@ -83,6 +83,12 @@ class OperatorConfig:
     # noise from random weights; the provider factory refuses unless this
     # is set (tests/benches opt in explicitly)
     allow_random_weights: bool = False
+    # OpenAI-compatible completion API (serving/httpserver.py) served from
+    # the operator process on the SAME engine the tpu-native provider uses;
+    # -1 = disabled (default), 0 = ephemeral port (tests)
+    completion_api_port: int = -1
+    completion_api_host: str = "0.0.0.0"
+    completion_api_token: str = ""  # "" = no auth required
 
     @classmethod
     def from_env(cls, env: Optional[dict[str, str]] = None) -> "OperatorConfig":

@@ -1,0 +1,139 @@
+"""The operator process serving the completion API on the shared engine.
+
+completion_api_port >= 0 builds ONE engine used by BOTH the in-cluster
+``tpu-native`` provider and the OpenAI-compatible HTTP surface — external
+callers and pod-failure explanations share a single continuous batch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+
+from operator_tpu.operator.app import Operator
+from operator_tpu.operator.kubeapi import FakeKubeApi
+from operator_tpu.utils.config import OperatorConfig
+
+
+def _config(**kw) -> OperatorConfig:
+    base = dict(
+        pattern_cache_directory="/nonexistent",
+        health_port=-1,
+        completion_api_port=0,  # ephemeral
+        model_id="tiny-test",
+        allow_random_weights=True,
+        max_batch_size=4,
+        decode_block=2,
+    )
+    base.update(kw)
+    return OperatorConfig(**base)
+
+
+async def _get(port: int, path: str) -> tuple[int, dict]:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: t\r\n\r\n".encode())
+    await writer.drain()
+    raw = await asyncio.wait_for(reader.read(), timeout=60)
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    return int(head.split()[1]), json.loads(body)
+
+
+def test_operator_serves_completion_api_on_shared_engine():
+    async def scenario():
+        app = Operator(FakeKubeApi(), config=_config(completion_api_host="127.0.0.1"))
+        await app.start()
+        try:
+            # the API starts concurrently (weight load must not delay the
+            # watcher); wait for its task before asserting
+            await asyncio.wait_for(app.completion_task, timeout=120)
+            assert app.completion_server is not None
+            port = app.completion_server.bound_port
+            status, body = await _get(port, "/v1/models")
+            assert status == 200 and body["data"][0]["id"] == "tiny-test"
+
+            # the tpu-native provider resolves to the SAME engine object —
+            # one shared batch for API callers and pod-failure explanations
+            backend = app.providers.resolve("tpu-native")
+            assert backend.engine is app.completion_server.engine
+        finally:
+            await app.stop()
+        assert app.completion_server is None
+
+    asyncio.run(scenario())
+
+
+def test_restart_rebinds_provider_to_fresh_engine():
+    """stop()/start() must never leave explanations on a CLOSED engine: the
+    registry backend is overwritten with the new shared engine each start."""
+
+    async def scenario():
+        app = Operator(FakeKubeApi(), config=_config(completion_api_host="127.0.0.1"))
+        await app.start()
+        await asyncio.wait_for(app.completion_task, timeout=120)
+        first = app.providers.resolve("tpu-native")  # caches the backend
+        first_engine = first.engine
+        await app.stop()
+
+        await app.start()
+        await asyncio.wait_for(app.completion_task, timeout=120)
+        try:
+            backend = app.providers.resolve("tpu-native")
+            assert backend.engine is app.completion_server.engine
+            assert backend.engine is not first_engine
+            assert first_engine._closed  # the old engine really was closed
+        finally:
+            await app.stop()
+
+    asyncio.run(scenario())
+
+
+def test_port_collision_degrades_quietly():
+    """An unbindable API port disables the API, never the control plane."""
+
+    async def scenario():
+        blocker = await asyncio.start_server(
+            lambda r, w: None, "127.0.0.1", 0)
+        port = blocker.sockets[0].getsockname()[1]
+        app = Operator(FakeKubeApi(), config=_config(
+            completion_api_host="127.0.0.1", completion_api_port=port))
+        await app.start()
+        try:
+            await asyncio.wait_for(app.completion_task, timeout=120)
+            assert app.completion_server is None  # degraded, not crashed
+            assert app._tasks  # watcher/reconcilers are running
+        finally:
+            await app.stop()
+            blocker.close()
+            await blocker.wait_closed()
+
+    asyncio.run(scenario())
+
+
+def test_operator_api_disabled_by_default_and_degrades():
+    async def scenario():
+        # default: no API configured
+        app = Operator(FakeKubeApi(), config=OperatorConfig(
+            pattern_cache_directory="/nonexistent", health_port=-1))
+        await app.start()
+        try:
+            assert app.completion_server is None
+        finally:
+            await app.stop()
+
+        # configured but engine unbuildable (no checkpoint, random weights
+        # not allowed): operator still starts, API quietly disabled
+        bad = Operator(FakeKubeApi(), config=OperatorConfig(
+            pattern_cache_directory="/nonexistent", health_port=-1,
+            completion_api_port=0, model_id="tiny-test",
+            allow_random_weights=False))
+        await bad.start()
+        try:
+            await asyncio.wait_for(bad.completion_task, timeout=60)
+            assert bad.completion_server is None
+        finally:
+            await bad.stop()
+
+    asyncio.run(scenario())
