@@ -113,18 +113,12 @@ class Operator:
         directory = self.config.encoder_checkpoint_dir
         if not directory:
             return None
-        try:
-            from ..patterns.semantic import NeuralEmbedder, SemanticMatcher
+        from ..patterns.semantic import SemanticMatcher, build_embedder
 
-            embedder = NeuralEmbedder.from_checkpoint(directory)
-            log.info("semantic matching: MiniLM encoder from %s", directory)
-            return SemanticMatcher(embedder=embedder)
-        except Exception:  # noqa: BLE001 - degrade to lexical-only
-            log.warning(
-                "encoder checkpoint %s unusable; semantic matching disabled",
-                directory, exc_info=True,
-            )
+        embedder = build_embedder(directory, fallback=False)
+        if embedder is None:
             return None
+        return SemanticMatcher(embedder=embedder)
 
     async def _start_completion_api(self) -> None:
         """Serve the OpenAI-compatible API from the operator process on the
@@ -144,12 +138,24 @@ class Operator:
             engine, model_id = await loop.run_in_executor(
                 None, build_serving_engine, self.config
             )
+            # /v1/embeddings reuses the pattern engine's embedder (MiniLM if
+            # an encoder checkpoint is mounted, lexical hashing otherwise);
+            # NeuralEmbedder.embed is internally locked, so sharing one
+            # instance with the analysis pipeline's thread is safe
+            semantic = getattr(self.engine, "semantic", None)
+            if semantic is not None:
+                embedder = semantic.embedder
+            else:
+                from ..patterns.semantic import build_embedder
+
+                embedder = build_embedder(None)
             server = CompletionServer(
                 engine,
                 model_id=model_id,
                 host=self.config.completion_api_host,
                 port=self.config.completion_api_port,
                 api_token=self.config.completion_api_token or None,
+                embedder=embedder,
             )
             await server.start()
         except Exception:  # noqa: BLE001 - optional surface, degrade quietly

@@ -174,6 +174,8 @@ class NeuralEmbedder:
         max_tokens: int = 256,
         batch_size: int = 32,
     ) -> None:
+        import threading
+
         import jax
 
         from ..models.encoder import encode
@@ -185,6 +187,10 @@ class NeuralEmbedder:
         self.batch_size = batch_size
         self.dim = config.hidden_size
         self._encode = jax.jit(lambda ids, mask: encode(params, config, ids, mask))
+        # one instance may be shared by the pipeline's analysis thread and
+        # the /v1/embeddings executor; HF fast tokenizers are not safe for
+        # concurrent encode on one instance ("Already borrowed")
+        self._lock = threading.Lock()
 
     @classmethod
     def from_checkpoint(
@@ -221,6 +227,12 @@ class NeuralEmbedder:
 
         if not texts:
             return np.zeros((0, self.dim), np.float32)
+        with self._lock:
+            return self._embed_locked(texts)
+
+    def _embed_locked(self, texts: Sequence[str]) -> np.ndarray:
+        import numpy as np
+
         out = []
         for lo in range(0, len(texts), self.batch_size):
             chunk = texts[lo : lo + self.batch_size]
@@ -233,6 +245,31 @@ class NeuralEmbedder:
             emb = np.asarray(self._encode(ids, mask), np.float32)
             out.append(emb[: len(chunk)])
         return np.concatenate(out, axis=0)
+
+
+def build_embedder(
+    encoder_checkpoint_dir: "str | None", *, fallback: bool = True
+):
+    """The one embedder ladder every surface uses: MiniLM-class neural
+    encoder when a checkpoint dir is given and loads, degrading with a
+    warning to the lexical ``HashingEmbedder`` (or ``None`` when
+    ``fallback=False`` — the semantic matcher treats no-encoder as
+    "lexical matching only").
+
+    Call sites: operator/app.py (semantic matcher + embedded completion
+    API), serving/__main__.py (standalone API CLI).
+    """
+    if encoder_checkpoint_dir:
+        try:
+            embedder = NeuralEmbedder.from_checkpoint(encoder_checkpoint_dir)
+            log.info("neural embedder from %s", encoder_checkpoint_dir)
+            return embedder
+        except Exception:  # noqa: BLE001 - optional neural path degrades
+            log.warning(
+                "encoder checkpoint %s unusable; degrading to lexical",
+                encoder_checkpoint_dir, exc_info=True,
+            )
+    return HashingEmbedder() if fallback else None
 
 
 # ---------------------------------------------------------------------------

@@ -42,6 +42,13 @@ def main() -> None:
     from .provider import build_serving_engine
 
     engine, model_id = build_serving_engine()
+
+    # /v1/embeddings: MiniLM when a checkpoint is mounted, lexical hashing
+    # otherwise — the one shared ladder (patterns/semantic.py)
+    from ..patterns.semantic import build_embedder
+
+    embedder = build_embedder(os.environ.get("ENCODER_CHECKPOINT_DIR", "").strip())
+
     try:
         asyncio.run(
             serve_forever(
@@ -50,6 +57,7 @@ def main() -> None:
                 host=args.host,
                 port=args.port,
                 api_token=os.environ.get("OPERATOR_TPU_API_TOKEN") or None,
+                embedder=embedder,
             )
         )
     except KeyboardInterrupt:

@@ -8,12 +8,15 @@ continuous-batching TPU engine the operator uses in-process.
 Endpoints (stdlib asyncio, close-delimited HTTP/1.1 — same discipline as
 operator/httpserver.py):
 
-- ``GET  /v1/models``            — the one loaded model
+- ``GET  /v1/models``            — the loaded model (+ embedder if wired)
 - ``POST /v1/completions``       — prompt (str or list), n, max_tokens,
   temperature, top_p, stop; every prompt/replica joins the shared
   continuous batch and decodes concurrently
 - ``POST /v1/chat/completions``  — messages flattened with a minimal
   chat template (the operator's own prompts live in serving/prompts.py)
+- ``POST /v1/embeddings``        — the pattern-matching embedder (MiniLM
+  when an encoder checkpoint is mounted, lexical hashing otherwise)
+  exposed OpenAI-style for log-similarity tooling
 - ``GET  /healthz``              — liveness for probes
 
 ``stream: true`` serves Server-Sent Events: one OpenAI-format chunk per
@@ -122,6 +125,8 @@ class CompletionServer:
         port: int = 8000,
         api_token: Optional[str] = None,
         max_tokens_cap: int = 2048,
+        embedder: Optional[Any] = None,  # .embed(texts)->ndarray, .dim
+        embedding_model_id: str = "log-embedder",
     ) -> None:
         self.engine = engine
         self.model_id = model_id
@@ -129,6 +134,8 @@ class CompletionServer:
         self.port = port
         self.api_token = api_token
         self.max_tokens_cap = max_tokens_cap
+        self.embedder = embedder
+        self.embedding_model_id = embedding_model_id
         self._server: Optional[asyncio.AbstractServer] = None
         self._started = time.time()
 
@@ -249,15 +256,22 @@ class CompletionServer:
         if method == "GET" and path == "/healthz":
             return 200, {"status": "ok", "uptime_s": round(time.time() - self._started, 1)}
         if method == "GET" and path == "/v1/models":
-            return 200, {
-                "object": "list",
-                "data": [{
-                    "id": self.model_id,
+            models = [{
+                "id": self.model_id,
+                "object": "model",
+                "created": int(self._started),
+                "owned_by": "operator-tpu",
+            }]
+            if self.embedder is not None:
+                models.append({
+                    "id": self.embedding_model_id,
                     "object": "model",
                     "created": int(self._started),
                     "owned_by": "operator-tpu",
-                }],
-            }
+                })
+            return 200, {"object": "list", "data": models}
+        if method == "POST" and path == "/v1/embeddings":
+            return await self._embeddings(self._parse_json(body))
         if method == "POST" and path == "/v1/completions":
             return await self._completions(self._parse_json(body), chat=False, writer=writer)
         if method == "POST" and path == "/v1/chat/completions":
@@ -371,6 +385,43 @@ class CompletionServer:
             },
         }
 
+
+    # -- embeddings ----------------------------------------------------------
+
+    async def _embeddings(self, req: dict):
+        if self.embedder is None:
+            raise ApiError(404, "no embedding model is configured")
+        texts = req.get("input")
+        if isinstance(texts, str):
+            texts = [texts]
+        if (
+            not isinstance(texts, list)
+            or not texts
+            or not all(isinstance(t, str) for t in texts)
+            or len(texts) > 256
+        ):
+            raise ApiError(
+                400, "input must be a string or list of <=256 strings"
+            )
+        loop = asyncio.get_running_loop()
+        # neural embedders run a jax forward; keep the event loop responsive
+        vectors = await loop.run_in_executor(None, self.embedder.embed, texts)
+        return 200, {
+            "object": "list",
+            "model": req.get("model") or self.embedding_model_id,
+            "data": [
+                {
+                    "object": "embedding",
+                    "index": i,
+                    "embedding": [float(x) for x in row],
+                }
+                for i, row in enumerate(vectors)
+            ],
+            "usage": {
+                "prompt_tokens": sum(len(t.split()) for t in texts),
+                "total_tokens": sum(len(t.split()) for t in texts),
+            },
+        }
 
     # -- streaming -----------------------------------------------------------
 
@@ -497,10 +548,12 @@ async def serve_forever(
     host: str = "0.0.0.0",
     port: int = 8000,
     api_token: Optional[str] = None,
+    embedder: Optional[Any] = None,
 ) -> None:
     """Run the completion API until cancelled (SIGINT/SIGTERM via CLI)."""
     server = CompletionServer(
-        engine, model_id=model_id, host=host, port=port, api_token=api_token
+        engine, model_id=model_id, host=host, port=port, api_token=api_token,
+        embedder=embedder,
     )
     await server.start()
     try:

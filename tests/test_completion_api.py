@@ -40,10 +40,12 @@ def server_port():
     started = {}
 
     async def run():
+        from operator_tpu.patterns.semantic import HashingEmbedder
+
         engine = ServingEngine(generator, admission_wait_s=0.005)
         server = CompletionServer(
             engine, model_id="tiny-test", host="127.0.0.1", port=0,
-            api_token="sekrit",
+            api_token="sekrit", embedder=HashingEmbedder(dim=64),
         )
         await server.start()
         started["port"] = server.bound_port
@@ -95,6 +97,7 @@ def test_models_and_health(server_port):
     status, body = _request(server_port, "GET", "/v1/models")
     assert status == 200
     assert body["data"][0]["id"] == "tiny-test"
+    assert body["data"][1]["id"] == "log-embedder"
     # healthz is auth-exempt: kubelet probes cannot carry bearer tokens
     status, body = _request(server_port, "GET", "/healthz", token=None)
     assert status == 200 and body["status"] == "ok"
@@ -280,6 +283,28 @@ def test_streaming_rejects_fanout(server_port):
     status, body = _request(
         server_port, "POST", "/v1/completions",
         {"prompt": "a", "n": 2, "stream": True})
+    assert status == 400
+
+
+def test_embeddings(server_port):
+    status, body = _request(
+        server_port, "POST", "/v1/embeddings",
+        {"input": ["OOMKilled exit 137", "ImagePullBackOff"]},
+    )
+    assert status == 200
+    assert body["object"] == "list"
+    assert [d["index"] for d in body["data"]] == [0, 1]
+    assert all(len(d["embedding"]) == 64 for d in body["data"])
+    # identical inputs embed identically; distinct log lines do not
+    status, body2 = _request(
+        server_port, "POST", "/v1/embeddings", {"input": "OOMKilled exit 137"})
+    assert status == 200
+    assert body2["data"][0]["embedding"] == body["data"][0]["embedding"]
+    assert body["data"][0]["embedding"] != body["data"][1]["embedding"]
+    # error surface
+    status, _ = _request(server_port, "POST", "/v1/embeddings", {"input": []})
+    assert status == 400
+    status, _ = _request(server_port, "POST", "/v1/embeddings", {"input": [1]})
     assert status == 400
 
 
