@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import asyncio
 import functools
+import itertools
 import logging
 import os
 import time
@@ -934,7 +935,18 @@ class ServingEngine:
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="tpu-decode"
         )
-        self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
+        # priority queue: (-priority, arrival_seq, entry) — higher-priority
+        # requests admit first, FIFO within a class.  The operator pipeline
+        # submits explanations at priority 10 so external completion-API
+        # callers sharing the engine cannot starve incident analysis.  The
+        # queue itself is unbounded; max_queue bounds only the priority<=0
+        # lane (via semaphore), so a flood of external callers blocks THEIR
+        # puts while high-priority puts always enter immediately — a bounded
+        # PriorityQueue would grant space to put-waiters in FIFO order,
+        # reintroducing the starvation at the put() boundary.
+        self._queue: asyncio.PriorityQueue = asyncio.PriorityQueue()
+        self._low_lane = asyncio.Semaphore(max_queue)
+        self._seq = itertools.count()
         self._pending: dict[int, asyncio.Future] = {}  # slot id -> future
         self._inflight: list = []  # popped from queue, not yet in _pending
         # streaming: future -> on_partial registered in generate(); slot ->
@@ -948,6 +960,13 @@ class ServingEngine:
         self._task: Optional[asyncio.Task] = None
         self._closed = False
         self._error: Optional[BaseException] = None
+
+    def _unwrap(self, item: tuple) -> tuple:
+        """Pop bookkeeping for a queue entry: low-lane slots free on pop."""
+        neg_priority, _, entry = item
+        if neg_priority >= 0:  # priority <= 0 went through the bounded lane
+            self._low_lane.release()
+        return entry
 
     def _page_stalled(self, batch: list) -> bool:
         """True when a backpressured batch has no new pages to retry with —
@@ -1003,7 +1022,7 @@ class ServingEngine:
                 future.set_exception(exc)
         self._inflight.clear()
         while not self._queue.empty():
-            _, _, future = self._queue.get_nowait()
+            _, _, future = self._unwrap(self._queue.get_nowait())
             if not future.done():
                 future.set_exception(exc)
 
@@ -1013,10 +1032,16 @@ class ServingEngine:
         params: Optional[SamplingParams] = None,
         *,
         on_partial: Optional[Any] = None,
+        priority: int = 0,
     ) -> GenerationResult:
         """Generate; ``on_partial(token_ids_so_far)`` (if given) fires on the
         event loop after each decode block while the request is generating —
-        the streaming feed for the completion API (serving/httpserver.py)."""
+        the streaming feed for the completion API (serving/httpserver.py).
+
+        ``priority`` orders ADMISSION only (higher first, FIFO within a
+        class): the operator pipeline uses 10 so external API callers on the
+        shared engine can never starve incident analysis.  Already-admitted
+        and backpressured-in-hand requests are not preempted."""
         if self._closed:
             raise RuntimeError("serving engine is closed")
         if self._error is not None:
@@ -1026,7 +1051,11 @@ class ServingEngine:
         future: asyncio.Future = asyncio.get_running_loop().create_future()
         if on_partial is not None:
             self._partial_by_future[future] = on_partial
-        await self._queue.put((prompt, params or SamplingParams(), future))
+        if priority <= 0:
+            await self._low_lane.acquire()  # released when the entry is popped
+        await self._queue.put(
+            (-priority, next(self._seq), (prompt, params or SamplingParams(), future))
+        )
         # the put may have landed after close()/loop-death drained the
         # queue; _closed/_error were set before the drain, so re-checking
         # here closes that window
@@ -1056,7 +1085,7 @@ class ServingEngine:
             if not batch and self.generator.num_active == 0 and self._queue.empty():
                 # fully idle: block until a request arrives (never while
                 # backpressured requests are already waiting in hand)
-                batch.append(await self._queue.get())
+                batch.append(self._unwrap(await self._queue.get()))
             total_free = len(self.generator.free_slots())
             stalled = self._page_stalled(batch)
             if (
@@ -1071,7 +1100,7 @@ class ServingEngine:
                 # active sequence exactly when the engine is most loaded
                 await asyncio.sleep(self.admission_wait_s)
                 while len(batch) < total_free and not self._queue.empty():
-                    batch.append(self._queue.get_nowait())
+                    batch.append(self._unwrap(self._queue.get_nowait()))
             if batch and not stalled:
                 admitted = await self._admit(batch)
                 # paged backpressure: requests beyond the KV free list stay

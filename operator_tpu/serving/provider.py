@@ -81,7 +81,9 @@ class TPUNativeProvider:
             ),
         )
         try:
-            result = await self.engine.generate(prompt, params)
+            # priority 10: pod-failure explanations admit ahead of external
+            # completion-API callers sharing the engine (engine.generate)
+            result = await self.engine.generate(prompt, params, priority=10)
         except asyncio.CancelledError:
             raise
         except Exception as exc:  # noqa: BLE001 - pipeline degrades to pattern-only

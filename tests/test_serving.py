@@ -450,3 +450,77 @@ def test_decode_unroll_token_parity(monkeypatch):
             )
             outs.append(gen.generate("pod oom killed", sampling).token_ids)
         assert outs[0] == outs[1], (paged, outs)
+
+
+class TestPriorityAdmission:
+    def test_high_priority_admits_before_earlier_low(self):
+        """With the single slot held, a priority-10 request submitted AFTER
+        several priority-0 requests must still be admitted (and finish)
+        before them.  Deterministic: the decode worker is gated shut until
+        every request is queued, so the occupant cannot finish early no
+        matter how fast the machine is."""
+        import threading
+
+        params = init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+        generator = BatchedGenerator(
+            params, TINY_TEST, ByteTokenizer(), max_slots=1, max_seq=128,
+            cache_dtype=jnp.float32,
+        )
+        gate = threading.Event()
+        original_step = generator.step
+        generator.step = lambda: (gate.wait(30), original_step())[1]
+        order: list[str] = []
+
+        async def scenario():
+            engine = ServingEngine(generator, admission_wait_s=0.0)
+            await engine.start()
+            sampling = SamplingParams(max_tokens=12, temperature=0.0,
+                                      stop_on_eos=False)
+
+            async def one(tag: str, priority: int) -> None:
+                await engine.generate(f"req {tag}", sampling, priority=priority)
+                order.append(tag)
+
+            # occupy the single slot (admission happens before the gated
+            # step), then queue lows before the high
+            first = asyncio.ensure_future(one("occupant", 0))
+            await asyncio.sleep(0.2)  # occupant admitted; worker gated
+            lows = [asyncio.ensure_future(one(f"low{i}", 0)) for i in range(3)]
+            await asyncio.sleep(0.05)  # lows queued (slot busy, none admitted)
+            high = asyncio.ensure_future(one("analysis", 10))
+            await asyncio.sleep(0.05)  # high queued
+            gate.set()
+            await asyncio.gather(first, *lows, high)
+            await engine.close()
+
+        asyncio.run(scenario())
+        assert order[0] == "occupant"
+        assert order[1] == "analysis", order  # beat all 3 earlier lows
+        assert sorted(order[2:]) == ["low0", "low1", "low2"]
+
+    def test_fifo_within_priority_class(self):
+        params = init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+        generator = BatchedGenerator(
+            params, TINY_TEST, ByteTokenizer(), max_slots=1, max_seq=128,
+            cache_dtype=jnp.float32,
+        )
+        order: list[str] = []
+
+        async def scenario():
+            engine = ServingEngine(generator, admission_wait_s=0.0)
+            await engine.start()
+            sampling = SamplingParams(max_tokens=8, temperature=0.0,
+                                      stop_on_eos=False)
+
+            async def one(tag: str) -> None:
+                await engine.generate(f"req {tag}", sampling)
+                order.append(tag)
+
+            first = asyncio.ensure_future(one("a"))
+            await asyncio.sleep(0.2)
+            rest = [asyncio.ensure_future(one(t)) for t in ("b", "c", "d")]
+            await asyncio.gather(first, *rest)
+            await engine.close()
+
+        asyncio.run(scenario())
+        assert order == ["a", "b", "c", "d"]
