@@ -131,6 +131,28 @@ def param_count(params: Params) -> int:
 # --------------------------------------------------------------------------
 
 
+def _lora_path(
+    h_in: jax.Array,  # [B, T, in]
+    factors: dict[str, jax.Array],
+    alpha: float,
+    lora_indices: Optional[jax.Array],  # [B] adapter ids, or None
+) -> jax.Array:
+    """The low-rank delta ``(x @ A @ B) * alpha/r``, never expanded to a
+    full matrix.  With ``lora_indices``, the factors carry a per-layer
+    ADAPTER axis (``[n_adapters, in, r]`` — parallel/lora.py
+    ``stack_adapters``) and each batch row applies its own adapter: the
+    multi-LoRA serving path, one compiled program for the whole set."""
+    a = factors["a"].astype(h_in.dtype)
+    b = factors["b"].astype(h_in.dtype)
+    scale = alpha / a.shape[-1]
+    if lora_indices is None:
+        return ((h_in @ a) @ b) * scale
+    a_sel = a[lora_indices]  # [B, in, r] — rank-r gather, kilobytes per row
+    b_sel = b[lora_indices]  # [B, r, out]
+    low = jnp.einsum("bti,bir->btr", h_in, a_sel)
+    return jnp.einsum("btr,bro->bto", low, b_sel) * scale
+
+
 def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
     """Float32 accumulation regardless of activation dtype."""
     x32 = x.astype(jnp.float32)
@@ -343,6 +365,7 @@ def forward(
     prefill_lengths: Optional[jax.Array] = None,  # [B]; enables flash prefill
     lora: Optional[dict[str, dict[str, jax.Array]]] = None,  # parallel/lora.py
     lora_alpha: float = 16.0,
+    lora_indices: Optional[jax.Array] = None,  # [B]; lora holds STACKED adapters
 ) -> tuple[jax.Array, Optional[KVCache]]:
     """One decoder pass.
 
@@ -424,10 +447,9 @@ def forward(
             if bias is not None and bias in weights:
                 y = y + weights[bias].astype(y.dtype)
             if layer_lora is not None and name in layer_lora:
-                a = layer_lora[name]["a"].astype(h_in.dtype)
-                bmat = layer_lora[name]["b"].astype(h_in.dtype)
-                scale = lora_alpha / a.shape[-1]
-                y = y + ((h_in @ a) @ bmat) * scale
+                y = y + _lora_path(
+                    h_in, layer_lora[name], lora_alpha, lora_indices
+                )
             return y
 
         # -- attention ---------------------------------------------------
@@ -503,10 +525,14 @@ def decode_step(
     positions: jax.Array,  # [B, 1]
     cache: KVCache,
     cache_offset: jax.Array,
+    lora: Optional[dict[str, dict[str, jax.Array]]] = None,
+    lora_alpha: float = 16.0,
+    lora_indices: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, KVCache]:
     """Single-token decode (jit once, call per step)."""
     logits, new_cache = forward(
-        params, config, token_ids, positions, cache=cache, cache_offset=cache_offset
+        params, config, token_ids, positions, cache=cache, cache_offset=cache_offset,
+        lora=lora, lora_alpha=lora_alpha, lora_indices=lora_indices,
     )
     return logits[:, -1, :], new_cache
 
@@ -516,6 +542,9 @@ def decode_step_paged(
     config: ModelConfig,
     token_ids: jax.Array,  # [B, 1]
     paged: "PagedKVCache",
+    lora: Optional[dict[str, dict[str, jax.Array]]] = None,  # stacked adapters
+    lora_alpha: float = 16.0,
+    lora_indices: Optional[jax.Array] = None,  # [B] adapter id per slot
 ) -> tuple[jax.Array, "PagedKVCache"]:
     """Single-token decode over a paged KV cache (ops/paged_attention.py).
 
@@ -538,18 +567,23 @@ def decode_step_paged(
     def layer_step(carry: jax.Array, scanned: dict[str, jax.Array]):
         x = carry
         weights = scanned["w"]
+        layer_lora = scanned.get("lora")
         attn_in = rms_norm(x, weights["ln_attn"], config.rms_norm_eps)
 
-        def proj(name: str) -> jax.Array:
-            y = mm(attn_in, weights[name])
+        def proj(h_in: jax.Array, name: str) -> jax.Array:
+            y = mm(h_in, weights[name])
             bias = _PROJ_BIAS.get(name)
             if bias is not None and bias in weights:
                 y = y + weights[bias].astype(y.dtype)
+            if layer_lora is not None and name in layer_lora:
+                y = y + _lora_path(
+                    h_in, layer_lora[name], lora_alpha, lora_indices
+                )
             return y
 
-        q = proj("wq").reshape(b, 1, config.num_heads, config.head_dim)
-        k = proj("wk").reshape(b, 1, config.num_kv_heads, config.head_dim)
-        v = proj("wv").reshape(b, 1, config.num_kv_heads, config.head_dim)
+        q = proj(attn_in, "wq").reshape(b, 1, config.num_heads, config.head_dim)
+        k = proj(attn_in, "wk").reshape(b, 1, config.num_kv_heads, config.head_dim)
+        v = proj(attn_in, "wv").reshape(b, 1, config.num_kv_heads, config.head_dim)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         k_pages = write_tokens(scanned["k"], paged.page_table, k, paged.lengths)
@@ -559,14 +593,16 @@ def decode_step_paged(
             paged.page_table, new_lengths,
             sliding_window=config.sliding_window,
         )  # [B, QH, D]
-        x = x + mm(attn.astype(x.dtype).reshape(b, 1, -1), weights["wo"])
+        x = x + proj(attn.astype(x.dtype).reshape(b, 1, -1), "wo")
         mlp_in = rms_norm(x, weights["ln_mlp"], config.rms_norm_eps)
-        gate = jax.nn.silu(mm(mlp_in, weights["w_gate"]))
-        up = mm(mlp_in, weights["w_up"])
-        x = x + mm(gate * up, weights["w_down"])
+        gate = jax.nn.silu(proj(mlp_in, "w_gate"))
+        up = proj(mlp_in, "w_up")
+        x = x + proj(gate * up, "w_down")
         return x, {"k": k_pages, "v": v_pages}
 
     scanned_in = {"w": params["layers"], "k": paged.k_pages, "v": paged.v_pages}
+    if lora is not None:
+        scanned_in["lora"] = lora
     x, pages_out = jax.lax.scan(layer_step, x, scanned_in, unroll=_LAYER_UNROLL)
 
     x = rms_norm(x, params["ln_final"], config.rms_norm_eps)

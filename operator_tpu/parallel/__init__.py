@@ -20,10 +20,14 @@ from .mesh import (
 from .lora import (
     apply_lora,
     init_lora,
+    load_lora,
     lora_param_count,
     lora_shardings,
     make_lora_train_step,
     merge_lora,
+    save_lora,
+    stack_adapters,
+    zero_lora,
 )
 from .train import TrainState, make_optimizer, make_train_step, next_token_loss
 

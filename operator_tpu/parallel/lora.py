@@ -111,6 +111,87 @@ def merge_lora(
     return jax.block_until_ready(merge(params, adapters))
 
 
+def zero_lora(
+    config: ModelConfig,
+    *,
+    rank: int = 16,
+    targets: Sequence[str] = DEFAULT_TARGETS,
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> LoraParams:
+    """An all-zeros adapter: W_eff == W exactly.  Slot 0 of every stacked
+    multi-adapter batch, so un-adapted requests ride the same program."""
+    shapes = layer_matrix_shapes(config)
+    return {
+        name: {
+            "a": jnp.zeros((shapes[name][0], shapes[name][1], rank), dtype),
+            "b": jnp.zeros((shapes[name][0], rank, shapes[name][2]), dtype),
+        }
+        for name in targets
+    }
+
+
+def stack_adapters(adapters: Sequence[LoraParams]) -> LoraParams:
+    """Stack adapters for per-request serving: each leaf becomes
+    ``[n_layers, n_adapters, ...]`` — the LAYER axis stays leading so the
+    model's layer scan slices it, handing the per-layer ``[n_adapters, ...]``
+    factors to the per-slot gather (models/llama.py ``lora_indices``).
+
+    All adapters must share targets and rank (one compiled program serves
+    the whole set; pad ranks up-front if they differ).
+    """
+    if not adapters:
+        raise ValueError("need at least one adapter")
+    first = adapters[0]
+    for other in adapters[1:]:
+        if set(other) != set(first):
+            raise ValueError(
+                f"adapters must share targets: {sorted(other)} vs {sorted(first)}"
+            )
+        for name in first:
+            if other[name]["a"].shape != first[name]["a"].shape:
+                raise ValueError(
+                    f"adapter rank/shape mismatch on {name}: "
+                    f"{other[name]['a'].shape} vs {first[name]['a'].shape}"
+                )
+    return {
+        name: {
+            factor: jnp.stack([ad[name][factor] for ad in adapters], axis=1)
+            for factor in ("a", "b")
+        }
+        for name in first
+    }
+
+
+def save_lora(adapters: LoraParams, path: str) -> None:
+    """Write an adapter as one safetensors file (``lora.{target}.{a|b}``)."""
+    import os
+
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    flat = {}
+    for name, factors in adapters.items():
+        for factor, value in factors.items():
+            flat[f"lora.{name}.{factor}"] = np.asarray(value)
+    save_file(flat, path)
+
+
+def load_lora(path: str, dtype: jnp.dtype = jnp.bfloat16) -> LoraParams:
+    from safetensors.numpy import load_file
+
+    adapters: LoraParams = {}
+    for key, value in load_file(path).items():
+        parts = key.split(".")
+        if len(parts) != 3 or parts[0] != "lora" or parts[2] not in ("a", "b"):
+            raise ValueError(f"not a LoRA adapter file: unexpected key {key!r}")
+        adapters.setdefault(parts[1], {})[parts[2]] = jnp.asarray(value, dtype)
+    for name, factors in adapters.items():
+        if set(factors) != {"a", "b"}:
+            raise ValueError(f"adapter target {name!r} is missing a factor")
+    return adapters
+
+
 def lora_specs(config: ModelConfig, targets: Sequence[str]) -> Any:
     """PartitionSpecs for adapter factors, DERIVED from each base matrix's
     spec (mesh.param_specs): A takes the base fan-in axis, B the base
@@ -201,9 +282,13 @@ __all__ = [
     "LoraParams",
     "apply_lora",
     "init_lora",
+    "load_lora",
     "lora_param_count",
     "lora_shardings",
     "lora_specs",
     "make_lora_train_step",
     "merge_lora",
+    "save_lora",
+    "stack_adapters",
+    "zero_lora",
 ]

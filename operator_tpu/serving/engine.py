@@ -51,6 +51,10 @@ class SamplingParams:
     temperature: float = 0.3  # reference default, aiprovider-crd.yaml:56-58
     top_p: float = 0.95
     stop_on_eos: bool = True
+    #: LoRA adapter name for this request (multi-LoRA serving: every slot
+    #: picks its own adapter from the generator's stacked registry; None =
+    #: base model).  Unknown names are rejected at admission.
+    adapter: Optional[str] = None
 
 
 @dataclass
@@ -144,6 +148,8 @@ class BatchedGenerator:
         decode_block: int = 1,
         sample_top_k: Optional[int] = None,
         pipeline_depth: int = 1,
+        lora_adapters: Optional[dict[str, Any]] = None,
+        lora_alpha: float = 16.0,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -182,6 +188,30 @@ class BatchedGenerator:
         #: Called from the decode worker thread; must not block.
         self.partial_hook: Optional[Any] = None
         self._inflight_blocks: list[tuple[Any, dict]] = []
+
+        # ---- multi-LoRA serving: adapters stacked [n_layers, n_adapters+1,
+        # ...] with the all-zeros base at index 0; every request picks its
+        # adapter per slot inside ONE compiled program (models/llama.py
+        # _lora_path).  Passed as ARGUMENTS to the jitted fns — closure
+        # capture would embed tens of MB as program constants.
+        self.lora_alpha = lora_alpha
+        if lora_adapters:
+            from ..parallel.lora import stack_adapters, zero_lora
+
+            names = sorted(lora_adapters)
+            first = lora_adapters[names[0]]
+            first_a = first[next(iter(first))]["a"]
+            zero = zero_lora(
+                config, rank=first_a.shape[-1], targets=tuple(first),
+                dtype=first_a.dtype,
+            )
+            self.lora = stack_adapters([zero] + [lora_adapters[n] for n in names])
+            self._adapter_ids: dict[Optional[str], int] = {
+                None: 0, **{n: i + 1 for i, n in enumerate(names)}
+            }
+        else:
+            self.lora = None
+            self._adapter_ids = {None: 0}
 
         # ---- sharded serving (BASELINE configs 3/5): params TP on heads /
         # MLP columns, slots DP over the batch axis; one jitted program per
@@ -225,6 +255,7 @@ class BatchedGenerator:
                     in_shardings=(
                         self._param_shardings, s["paged"], s["tokens"],
                         s["repl"], s["batch"], s["batch"], s["batch"],
+                        s["repl"], s["batch"],  # stacked lora (small), idx
                     ),
                     out_shardings=(s["paged"], block_tokens, s["tokens"], s["repl"]),
                     donate_argnums=(1,),  # page pool: update in place, no copy
@@ -244,6 +275,7 @@ class BatchedGenerator:
                     in_shardings=(
                         self._param_shardings, s["cache"], s["tokens"],
                         s["batch"], s["repl"], s["batch"], s["batch"], s["batch"],
+                        s["repl"], s["batch"],  # stacked lora (small), idx
                     ),
                     out_shardings=(
                         s["cache"], block_tokens, s["tokens"], s["batch"], s["repl"]
@@ -310,12 +342,14 @@ class BatchedGenerator:
     # jitted bodies
     # ------------------------------------------------------------------
 
-    def _decode_step(self, params, cache, tokens, offsets, rng, temp, top_p, active):
+    def _decode_step(self, params, cache, tokens, offsets, rng, temp, top_p, active,
+                     lora=None, lora_idx=None):
         """[B,1] tokens at per-slot offsets -> next token per slot."""
         jnp = self._jnp
         positions = offsets[:, None]
         logits, cache = forward(
-            params, self.config, tokens, positions, cache=cache, cache_offset=offsets
+            params, self.config, tokens, positions, cache=cache, cache_offset=offsets,
+            lora=lora, lora_alpha=self.lora_alpha, lora_indices=lora_idx,
         )
         next_tokens, rng = self._sample(logits[:, -1, :], rng, temp, top_p)
         # inactive slots keep decoding garbage into their own slot space;
@@ -323,14 +357,18 @@ class BatchedGenerator:
         offsets = jnp.where(active, offsets + 1, offsets)
         return cache, next_tokens, offsets, rng
 
-    def _decode_step_paged(self, params, paged, tokens, rng, temp, top_p, active):
+    def _decode_step_paged(self, params, paged, tokens, rng, temp, top_p, active,
+                           lora=None, lora_idx=None):
         """Paged twin of :meth:`_decode_step` (released slots write to the
         trash page via their zeroed page-table row; their lengths stay put)."""
         from ..models.llama import decode_step_paged
         from ..ops.paged_attention import PagedKVCache
 
         jnp = self._jnp
-        logits, new_paged = decode_step_paged(params, self.config, tokens, paged)
+        logits, new_paged = decode_step_paged(
+            params, self.config, tokens, paged,
+            lora=lora, lora_alpha=self.lora_alpha, lora_indices=lora_idx,
+        )
         next_tokens, rng = self._sample(logits, rng, temp, top_p)
         lengths = jnp.where(active, new_paged.lengths, paged.lengths)
         new_paged = PagedKVCache(
@@ -346,7 +384,8 @@ class BatchedGenerator:
     #: (scripts/tpu_experiments.sh); compile time grows ~K-fold.
     DECODE_UNROLL = os.environ.get("OPERATOR_TPU_DECODE_UNROLL", "0") == "1"
 
-    def _decode_block(self, params, cache, tokens, offsets, rng, temp, top_p, active):
+    def _decode_block(self, params, cache, tokens, offsets, rng, temp, top_p, active,
+                      lora=None, lora_idx=None):
         """K chained decode steps in one program; returns the [K, B] token
         matrix plus final carry state.  lax.scan by default, straight-line
         unrolled under OPERATOR_TPU_DECODE_UNROLL=1 (see DECODE_UNROLL)."""
@@ -356,7 +395,8 @@ class BatchedGenerator:
             toks = []
             for _ in range(self.decode_block):
                 cache, next_tokens, offsets, rng = self._decode_step(
-                    params, cache, tokens, offsets, rng, temp, top_p, active
+                    params, cache, tokens, offsets, rng, temp, top_p, active,
+                    lora, lora_idx,
                 )
                 tokens = next_tokens[:, None]
                 toks.append(next_tokens)
@@ -365,7 +405,8 @@ class BatchedGenerator:
         def body(carry, _):
             cache, tokens, offsets, rng = carry
             cache, next_tokens, offsets, rng = self._decode_step(
-                params, cache, tokens, offsets, rng, temp, top_p, active
+                params, cache, tokens, offsets, rng, temp, top_p, active,
+                lora, lora_idx,
             )
             return (cache, next_tokens[:, None], offsets, rng), next_tokens
 
@@ -374,14 +415,16 @@ class BatchedGenerator:
         )
         return cache, toks, last, offsets, rng
 
-    def _decode_block_paged(self, params, paged, tokens, rng, temp, top_p, active):
+    def _decode_block_paged(self, params, paged, tokens, rng, temp, top_p, active,
+                            lora=None, lora_idx=None):
         jax, jnp = self._jax, self._jnp
 
         if self.DECODE_UNROLL:
             toks = []
             for _ in range(self.decode_block):
                 paged, next_tokens, rng = self._decode_step_paged(
-                    params, paged, tokens, rng, temp, top_p, active
+                    params, paged, tokens, rng, temp, top_p, active,
+                    lora, lora_idx,
                 )
                 tokens = next_tokens[:, None]
                 toks.append(next_tokens)
@@ -390,7 +433,8 @@ class BatchedGenerator:
         def body(carry, _):
             paged, tokens, rng = carry
             paged, next_tokens, rng = self._decode_step_paged(
-                params, paged, tokens, rng, temp, top_p, active
+                params, paged, tokens, rng, temp, top_p, active,
+                lora, lora_idx,
             )
             return (paged, next_tokens[:, None], rng), next_tokens
 
@@ -458,7 +502,8 @@ class BatchedGenerator:
         config = self.config
         score_shards = self._prefill_score_shards()
 
-        def prefill_fn(params, cache, token_ids, lengths, slot_ids, rng, temp, top_p):
+        def prefill_fn(params, cache, token_ids, lengths, slot_ids, rng, temp, top_p,
+                       lora=None, lora_idx=None):
             # fresh contiguous mini-cache for the prompt tokens
             mini = KVCache.create(config, n_pad, t_pad, dtype=cache.k.dtype)
             positions = jnp.broadcast_to(
@@ -471,6 +516,7 @@ class BatchedGenerator:
                 params, config, token_ids, positions, cache=mini,
                 cache_offset=0, kv_valid=kv_valid, score_shards=score_shards,
                 prefill_lengths=lengths,
+                lora=lora, lora_alpha=self.lora_alpha, lora_indices=lora_idx,
             )
             # scatter the prompt KV into the big cache rows for these slots
             # (slot axis is axis 1 of [L, B, S, KH, D])
@@ -490,7 +536,7 @@ class BatchedGenerator:
             prefill_fn,
             in_shardings=(
                 self._param_shardings, s["cache"], rows, vec, vec,
-                s["repl"], vec, vec,
+                s["repl"], vec, vec, s["repl"], vec,
             ),
             out_shardings=(s["cache"], vec, s["repl"]),
         )
@@ -503,7 +549,8 @@ class BatchedGenerator:
         config = self.config
         score_shards = self._prefill_score_shards()
 
-        def prefill_fn(params, paged, token_ids, lengths, row_tables, rng, temp, top_p):
+        def prefill_fn(params, paged, token_ids, lengths, row_tables, rng, temp, top_p,
+                       lora=None, lora_idx=None):
             from ..ops.paged_attention import PagedKVCache, write_tokens
 
             mini = KVCache.create(config, n_pad, t_pad, dtype=paged.k_pages.dtype)
@@ -515,6 +562,7 @@ class BatchedGenerator:
                 params, config, token_ids, positions, cache=mini,
                 cache_offset=0, kv_valid=kv_valid, score_shards=score_shards,
                 prefill_lengths=lengths,
+                lora=lora, lora_alpha=self.lora_alpha, lora_indices=lora_idx,
             )
             zero = jnp.zeros((n_pad,), jnp.int32)
             scatter = jax.vmap(write_tokens, in_axes=(0, None, 0, None, None))
@@ -538,7 +586,7 @@ class BatchedGenerator:
             prefill_fn,
             in_shardings=(
                 self._param_shardings, s["paged"], rows, vec, rows,
-                s["repl"], vec, vec,
+                s["repl"], vec, vec, s["repl"], vec,
             ),
             out_shardings=(s["paged"], vec, s["repl"]),
         )
@@ -546,6 +594,11 @@ class BatchedGenerator:
     # ------------------------------------------------------------------
     # host-side API
     # ------------------------------------------------------------------
+
+    @property
+    def adapter_names(self) -> list[str]:
+        """Registered LoRA adapter names (multi-LoRA serving)."""
+        return sorted(name for name in self._adapter_ids if name is not None)
 
     def free_slots(self) -> list[int]:
         return [i for i, s in enumerate(self.slots) if not s.active]
@@ -638,6 +691,7 @@ class BatchedGenerator:
         temp = np.zeros((n_pad,), np.float32)
         top_p = np.ones((n_pad,), np.float32)
         slot_ids = np.zeros((n_pad,), np.int32)
+        adapter_idx = np.zeros((n_pad,), np.int32)
         taken = free[:n]
         for row, (toks, sampling) in enumerate(zip(token_lists, params_list)):
             ids[row, : len(toks)] = toks
@@ -645,6 +699,12 @@ class BatchedGenerator:
             temp[row] = sampling.temperature
             top_p[row] = sampling.top_p
             slot_ids[row] = taken[row]
+            if sampling.adapter is not None and sampling.adapter not in self._adapter_ids:
+                raise ValueError(
+                    f"unknown LoRA adapter {sampling.adapter!r}; registered: "
+                    f"{sorted(n for n in self._adapter_ids if n)}"
+                )
+            adapter_idx[row] = self._adapter_ids[sampling.adapter]
         # padding rows duplicate row 0 verbatim (tokens, length, AND slot):
         # the scatter then writes identical values to one slot from several
         # rows, which is order-independent — no scratch slot needed, no
@@ -653,6 +713,7 @@ class BatchedGenerator:
             ids[row] = ids[0]
             lengths[row] = lengths[0]
             slot_ids[row] = slot_ids[0]
+            adapter_idx[row] = adapter_idx[0]
 
         key = (n_pad, t_pad)
         if key not in self._prefill_fns:
@@ -688,12 +749,15 @@ class BatchedGenerator:
             self.paged_cache, first_tokens, self._rng = self._prefill_fns[key](
                 self.params, paged, jnp.asarray(ids), jnp.asarray(lengths),
                 jnp.asarray(row_tables), self._rng, jnp.asarray(temp),
-                jnp.asarray(top_p),
+                jnp.asarray(top_p), self.lora,
+                jnp.asarray(adapter_idx) if self.lora is not None else None,
             )
         else:
             self.cache, first_tokens, self._rng = self._prefill_fns[key](
                 self.params, self.cache, jnp.asarray(ids), jnp.asarray(lengths),
                 jnp.asarray(slot_ids), self._rng, jnp.asarray(temp), jnp.asarray(top_p),
+                self.lora,
+                jnp.asarray(adapter_idx) if self.lora is not None else None,
             )
         first_np = np.asarray(first_tokens)
         prefill_ms = (time.perf_counter() - started) * 1e3
@@ -737,11 +801,18 @@ class BatchedGenerator:
             top_p = np.array(
                 [s.params.top_p if s.active else 1.0 for s in self.slots], np.float32
             )
+            adapter_idx = np.array(
+                [self._adapter_ids[s.params.adapter] if s.active else 0
+                 for s in self.slots],
+                np.int32,
+            )
             if self.mesh is not None:
                 put = lambda a: self._jax.device_put(a, self._shardings["batch"])  # noqa: E731
             else:
                 put = jnp.asarray
-            self._sampling_cache = (active, put(temp), put(top_p), put(active))
+            self._sampling_cache = (
+                active, put(temp), put(top_p), put(active), put(adapter_idx)
+            )
         return self._sampling_cache
 
     def step(self) -> list[tuple[int, GenerationResult]]:
@@ -784,16 +855,18 @@ class BatchedGenerator:
     def _dispatch_block(self) -> None:
         """Launch one decode block; tokens stay on device until processed."""
         block = self.decode_block
-        active, temp_dev, top_p_dev, active_dev = self._sampling_tensors()
+        active, temp_dev, top_p_dev, active_dev, idx_dev = self._sampling_tensors()
         if self.paged:
             self.paged_cache, toks, last, self._rng = self._decode_fn(
                 self.params, self.paged_cache, self.last_tokens, self._rng,
-                temp_dev, top_p_dev, active_dev,
+                temp_dev, top_p_dev, active_dev, self.lora,
+                idx_dev if self.lora is not None else None,
             )
         else:
             self.cache, toks, last, self.offsets, self._rng = self._decode_fn(
                 self.params, self.cache, self.last_tokens, self.offsets, self._rng,
-                temp_dev, top_p_dev, active_dev,
+                temp_dev, top_p_dev, active_dev, self.lora,
+                idx_dev if self.lora is not None else None,
             )
         self.last_tokens = last
         # snapshot which generation of each slot this block belongs to and
@@ -1046,6 +1119,18 @@ class ServingEngine:
             raise RuntimeError("serving engine is closed")
         if self._error is not None:
             raise RuntimeError("serving engine loop died") from self._error
+        # reject unknown adapters at SUBMIT time: a bad name surfacing as a
+        # ValueError inside the serve loop's admit would fail the whole
+        # co-batched wave and kill the loop — one misconfigured AIProvider CR
+        # must never take down serving for everyone
+        adapter = (params.adapter if params is not None else None)
+        if adapter is not None and adapter not in getattr(
+            self.generator, "_adapter_ids", {}
+        ):
+            raise ValueError(
+                f"unknown LoRA adapter {adapter!r}; registered: "
+                f"{getattr(self.generator, 'adapter_names', [])}"
+            )
         if self._task is None:
             await self.start()
         future: asyncio.Future = asyncio.get_running_loop().create_future()

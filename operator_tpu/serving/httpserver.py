@@ -262,6 +262,17 @@ class CompletionServer:
                 "created": int(self._started),
                 "owned_by": "operator-tpu",
             }]
+            # LoRA adapters are addressable models (the vLLM convention):
+            # model=<adapter> routes the request through that adapter on
+            # the shared base — one batch, per-slot adapters
+            for adapter in self._adapter_names():
+                models.append({
+                    "id": adapter,
+                    "object": "model",
+                    "created": int(self._started),
+                    "owned_by": "operator-tpu",
+                    "parent": self.model_id,
+                })
             if self.embedder is not None:
                 models.append({
                     "id": self.embedding_model_id,
@@ -290,6 +301,25 @@ class CompletionServer:
 
     # -- completion handling -------------------------------------------------
 
+    def _adapter_names(self) -> list[str]:
+        generator = getattr(self.engine, "generator", None)
+        return list(getattr(generator, "adapter_names", []) or [])
+
+    def _resolve_adapter(self, req: dict) -> Optional[str]:
+        """``model`` naming a registered adapter selects it; the base model
+        id (or absent model) selects none; anything else is a 404."""
+        model = req.get("model")
+        if model is None or model == self.model_id:
+            return None
+        if model in self._adapter_names():
+            return model
+        raise ApiError(
+            404,
+            f"model {model!r} not found; available: "
+            f"{[self.model_id, *self._adapter_names()]}",
+            "invalid_request_error",
+        )
+
     def _sampling(self, req: dict) -> tuple[SamplingParams, list[str]]:
         max_tokens = req.get("max_tokens", 256)
         if not isinstance(max_tokens, int) or max_tokens < 1:
@@ -306,7 +336,8 @@ class CompletionServer:
         if not isinstance(stop, list) or not all(isinstance(s, str) for s in stop):
             raise ApiError(400, "stop must be a string or list of strings")
         params = SamplingParams(
-            max_tokens=max_tokens, temperature=float(temperature), top_p=float(top_p)
+            max_tokens=max_tokens, temperature=float(temperature),
+            top_p=float(top_p), adapter=self._resolve_adapter(req),
         )
         return params, stop
 

@@ -74,11 +74,16 @@ class TPUNativeProvider:
     async def generate(self, request: AnalysisRequest) -> AIResponse:
         config = request.provider_config
         prompt = build_prompt(request)
+        # per-CR LoRA adapter (multi-LoRA serving): AIProvider
+        # spec.additionalConfig.lora_adapter names a registered adapter;
+        # different CRs then share one batch with different adapters
+        adapter = (config.additional_config.get("lora_adapter") or None) if config else None
         params = SamplingParams(
             max_tokens=(config.max_tokens if config and config.max_tokens else 500),
             temperature=(
                 config.temperature if config and config.temperature is not None else 0.3
             ),
+            adapter=adapter,
         )
         try:
             # priority 10: pod-failure explanations admit ahead of external
@@ -169,6 +174,48 @@ def build_serving_engine(
         mesh = make_mesh(plan, devices)
         log.info("sharded serving: %s", mesh_summary(mesh))
 
+    # multi-LoRA registry: every `<name>.safetensors` under lora_dir becomes
+    # a selectable adapter; a bad file disables ONLY that adapter
+    lora_adapters = None
+    if config.lora_dir and os.path.isdir(config.lora_dir):
+        from ..parallel.lora import load_lora
+
+        lora_adapters = {}
+        for fname in sorted(os.listdir(config.lora_dir)):
+            if not fname.endswith(".safetensors"):
+                continue
+            name = fname[: -len(".safetensors")]
+            try:
+                lora_adapters[name] = load_lora(os.path.join(config.lora_dir, fname))
+            except Exception:  # noqa: BLE001 - optional per-adapter surface
+                log.warning("LoRA adapter %s unusable; skipping", fname, exc_info=True)
+        # one compiled program serves the whole set, so every adapter must
+        # share targets and rank (stack_adapters); drop mismatches instead
+        # of letting the stack abort engine startup
+        signature = None
+        for name in sorted(lora_adapters):
+            adapter = lora_adapters[name]
+            sig = (
+                tuple(sorted(adapter)),
+                adapter[next(iter(adapter))]["a"].shape[-1],
+            )
+            if signature is None:
+                signature = sig
+            elif sig != signature:
+                log.warning(
+                    "LoRA adapter %r has targets/rank %s != %s of the first "
+                    "adapter; skipping (adapters must match to share one "
+                    "compiled program)", name, sig, signature,
+                )
+                del lora_adapters[name]
+        log.info("multi-LoRA serving: %s", sorted(lora_adapters) or "none loaded")
+        lora_adapters = lora_adapters or None
+    elif config.lora_dir:
+        log.warning(
+            "lora_dir %r does not exist or is not a directory; "
+            "multi-LoRA serving disabled", config.lora_dir,
+        )
+
     generator = BatchedGenerator(
         params,
         model_config,
@@ -182,6 +229,8 @@ def build_serving_engine(
         decode_block=config.decode_block,
         pipeline_depth=config.pipeline_depth,
         sample_top_k=config.sample_top_k,
+        lora_adapters=lora_adapters,
+        lora_alpha=config.lora_alpha,
     )
     return ServingEngine(generator), model_id
 

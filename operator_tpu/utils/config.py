@@ -83,6 +83,12 @@ class OperatorConfig:
     # noise from random weights; the provider factory refuses unless this
     # is set (tests/benches opt in explicitly)
     allow_random_weights: bool = False
+    # multi-LoRA serving: a directory of `<name>.safetensors` adapter files
+    # (parallel/lora.py save_lora) loaded into the stacked registry at
+    # engine build; requests select by name (SamplingParams.adapter /
+    # AIProvider additionalConfig.lora_adapter / API model field)
+    lora_dir: Optional[str] = None
+    lora_alpha: float = 16.0
     # OpenAI-compatible completion API (serving/httpserver.py) served from
     # the operator process on the SAME engine the tpu-native provider uses;
     # -1 = disabled (default), 0 = ephemeral port (tests)
