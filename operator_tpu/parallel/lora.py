@@ -148,11 +148,13 @@ def stack_adapters(adapters: Sequence[LoraParams]) -> LoraParams:
                 f"adapters must share targets: {sorted(other)} vs {sorted(first)}"
             )
         for name in first:
-            if other[name]["a"].shape != first[name]["a"].shape:
-                raise ValueError(
-                    f"adapter rank/shape mismatch on {name}: "
-                    f"{other[name]['a'].shape} vs {first[name]['a'].shape}"
-                )
+            for factor in ("a", "b"):
+                if other[name][factor].shape != first[name][factor].shape:
+                    raise ValueError(
+                        f"adapter rank/shape mismatch on {name}.{factor}: "
+                        f"{other[name][factor].shape} vs "
+                        f"{first[name][factor].shape}"
+                    )
     return {
         name: {
             factor: jnp.stack([ad[name][factor] for ad in adapters], axis=1)

@@ -197,10 +197,13 @@ class CompletionServer:
                 pass
             return
         try:
-            data = json.dumps(payload).encode()
+            if isinstance(payload, bytes):  # /metrics Prometheus exposition
+                data, ctype = payload, "text/plain; version=0.0.4"
+            else:
+                data, ctype = json.dumps(payload).encode(), "application/json"
             writer.write(
                 f"HTTP/1.1 {status} {'OK' if status < 400 else 'Error'}\r\n"
-                f"Content-Type: application/json\r\n"
+                f"Content-Type: {ctype}\r\n"
                 f"Content-Length: {len(data)}\r\n"
                 f"Connection: close\r\n\r\n".encode() + data
             )
@@ -255,6 +258,13 @@ class CompletionServer:
         path = path.split("?", 1)[0]
         if method == "GET" and path == "/healthz":
             return 200, {"status": "ok", "uptime_s": round(time.time() - self._started, 1)}
+        if method == "GET" and path == "/metrics.json":
+            # per-stage latency percentiles (prefill, decode_step, ...) from
+            # the engine's registry — the operator endpoint's twin for the
+            # standalone server
+            return 200, self.engine.generator.metrics.snapshot()
+        if method == "GET" and path == "/metrics":
+            return 200, self.engine.generator.metrics.prometheus().encode()
         if method == "GET" and path == "/v1/models":
             models = [{
                 "id": self.model_id,

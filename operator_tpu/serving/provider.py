@@ -190,20 +190,30 @@ def build_serving_engine(
             except Exception:  # noqa: BLE001 - optional per-adapter surface
                 log.warning("LoRA adapter %s unusable; skipping", fname, exc_info=True)
         # one compiled program serves the whole set, so every adapter must
-        # share targets and rank (stack_adapters); drop mismatches instead
-        # of letting the stack abort engine startup
+        # share targets and FULL factor shapes (stack_adapters); drop
+        # empty/mismatched/name-colliding ones instead of letting the stack
+        # (or API routing) break
         signature = None
         for name in sorted(lora_adapters):
             adapter = lora_adapters[name]
-            sig = (
-                tuple(sorted(adapter)),
-                adapter[next(iter(adapter))]["a"].shape[-1],
+            sig = tuple(
+                (target, adapter[target]["a"].shape, adapter[target]["b"].shape)
+                for target in sorted(adapter)
             )
-            if signature is None:
+            if not sig:
+                log.warning("LoRA adapter %r is empty; skipping", name)
+                del lora_adapters[name]
+            elif name == model_id:
+                log.warning(
+                    "LoRA adapter %r collides with the base model id and "
+                    "would be unroutable over the API; skipping", name,
+                )
+                del lora_adapters[name]
+            elif signature is None:
                 signature = sig
             elif sig != signature:
                 log.warning(
-                    "LoRA adapter %r has targets/rank %s != %s of the first "
+                    "LoRA adapter %r has targets/shapes %s != %s of the first "
                     "adapter; skipping (adapters must match to share one "
                     "compiled program)", name, sig, signature,
                 )

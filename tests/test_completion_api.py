@@ -286,6 +286,41 @@ def test_streaming_rejects_fanout(server_port):
     assert status == 400
 
 
+def test_metrics_endpoints(server_port):
+    """/metrics.json snapshots the engine's per-stage registry; /metrics is
+    Prometheus text (both behind auth, unlike /healthz)."""
+
+    async def raw_get(path):
+        reader, writer = await asyncio.open_connection("127.0.0.1", server_port)
+        writer.write(
+            f"GET {path} HTTP/1.1\r\nHost: t\r\n"
+            f"Authorization: Bearer sekrit\r\n\r\n".encode())
+        await writer.drain()
+        raw = await asyncio.wait_for(reader.read(), timeout=60)
+        writer.close()
+        head, _, body = raw.partition(b"\r\n\r\n")
+        return int(head.split()[1]), head, body
+
+    # generate once so the per-stage registry has data regardless of which
+    # other tests ran first
+    status, _ = _request(
+        server_port, "POST", "/v1/completions",
+        {"prompt": "warm metrics", "max_tokens": 2})
+    assert status == 200
+
+    status, body = _request(server_port, "GET", "/metrics.json")
+    assert status == 200
+    assert "prefill" in body["stages"]
+
+    status, head, text = asyncio.run(raw_get("/metrics"))
+    assert status == 200
+    assert b"text/plain" in head
+    assert b"prefill" in text
+
+    status, _, _ = asyncio.run(raw_get("/metrics.json"))
+    assert status == 200
+
+
 def test_embeddings(server_port):
     status, body = _request(
         server_port, "POST", "/v1/embeddings",

@@ -242,3 +242,54 @@ def test_unknown_adapter_fails_only_that_request(params, adapters):
             await engine.close()
 
     asyncio.run(scenario())
+
+
+def test_lora_dir_loader_isolates_bad_adapters(tmp_path, params, adapters, monkeypatch):
+    """build_serving_engine survives a LORA_DIR containing: a valid adapter,
+    an empty file, a rank-mismatched adapter, a corrupt file, and one whose
+    name collides with the base model id — only the valid one registers."""
+    from safetensors.numpy import save_file
+
+    from operator_tpu.serving.provider import build_serving_engine
+    from operator_tpu.utils.config import OperatorConfig
+
+    lora_dir = tmp_path / "loras"
+    lora_dir.mkdir()
+    save_lora(adapters["incident"], str(lora_dir / "good.safetensors"))
+    save_file({}, str(lora_dir / "empty.safetensors"))
+    other_rank = init_lora(CONFIG, jax.random.PRNGKey(9), rank=RANK * 2,
+                           dtype=jnp.float32)
+    save_lora(other_rank, str(lora_dir / "rank8.safetensors"))
+    (lora_dir / "corrupt.safetensors").write_bytes(b"not a safetensors file")
+    save_lora(adapters["verbose"], str(lora_dir / "tiny-test.safetensors"))
+
+    config = OperatorConfig(
+        model_id="tiny-test", allow_random_weights=True,
+        max_batch_size=2, decode_block=2, lora_dir=str(lora_dir),
+    )
+    engine, model_id = build_serving_engine(config)
+    try:
+        assert model_id == "tiny-test"
+        assert engine.generator.adapter_names == ["good"]
+    finally:
+        engine._executor.shutdown(wait=False)
+
+
+def test_lora_dir_missing_warns_not_crashes(tmp_path, caplog):
+    from operator_tpu.serving.provider import build_serving_engine
+    from operator_tpu.utils.config import OperatorConfig
+
+    config = OperatorConfig(
+        model_id="tiny-test", allow_random_weights=True,
+        max_batch_size=2, decode_block=2,
+        lora_dir=str(tmp_path / "does-not-exist"),
+    )
+    import logging
+
+    with caplog.at_level(logging.WARNING):
+        engine, _ = build_serving_engine(config)
+    try:
+        assert engine.generator.adapter_names == []
+        assert any("lora_dir" in r.message for r in caplog.records)
+    finally:
+        engine._executor.shutdown(wait=False)
