@@ -12,8 +12,9 @@ operator/httpserver.py):
 - ``POST /v1/completions``       — prompt (str or list), n, max_tokens,
   temperature, top_p, stop; every prompt/replica joins the shared
   continuous batch and decodes concurrently
-- ``POST /v1/chat/completions``  — messages flattened with a minimal
-  chat template (the operator's own prompts live in serving/prompts.py)
+- ``POST /v1/chat/completions``  — messages rendered with the loaded
+  model family's published conversation format (serving/templates.py:
+  llama3 headers, ChatML, Mistral [INST], Zephyr; neutral fallback)
 - ``POST /v1/embeddings``        — the pattern-matching embedder (MiniLM
   when an encoder checkpoint is mounted, lexical hashing otherwise)
   exposed OpenAI-style for log-similarity tooling
@@ -43,6 +44,7 @@ import uuid
 from typing import Any, Optional
 
 from .engine import GenerationResult, SamplingParams, ServingEngine
+from .templates import template_for
 
 log = logging.getLogger(__name__)
 
@@ -70,20 +72,17 @@ def _content_text(content: Any) -> str:
     raise ValueError("message content must be a string or list of text parts")
 
 
-def _chat_prompt(messages: list) -> str:
-    """Minimal role-tagged chat template.
-
-    The engine serves base/instruct checkpoints whose canonical template
-    lives with the tokenizer upstream; without egress we use a neutral
-    plain-text convention rather than guessing a model-specific one.
-    """
-    parts = []
+def _flatten_messages(messages: list) -> list[dict]:
+    """Validate + flatten content-parts; raises ValueError on bad shape."""
+    flat = []
     for msg in messages:
         if not isinstance(msg, dict) or "content" not in msg:
             raise ValueError("each message needs 'role' and 'content'")
-        parts.append(f"{msg.get('role', 'user')}: {_content_text(msg['content'])}")
-    parts.append("assistant:")
-    return "\n".join(parts)
+        flat.append({
+            "role": msg.get("role", "user"),
+            "content": _content_text(msg["content"]),
+        })
+    return flat
 
 
 def _earliest_stop(text: str, stop: list[str]) -> Optional[int]:
@@ -362,7 +361,9 @@ class CompletionServer:
             if not isinstance(messages, list) or not messages:
                 raise ApiError(400, "messages must be a non-empty list")
             try:
-                prompts = [_chat_prompt(messages)]
+                # the loaded model family's published conversation format —
+                # instruct checkpoints degrade badly on anything else
+                prompts = [template_for(self.model_id)(_flatten_messages(messages))]
             except ValueError as exc:
                 raise ApiError(400, str(exc)) from None
         else:

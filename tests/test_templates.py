@@ -1,0 +1,57 @@
+"""Chat templates match the published conversation formats per family."""
+
+from __future__ import annotations
+
+from operator_tpu.serving.templates import template_for
+
+MESSAGES = [
+    {"role": "system", "content": "analyse pod failures"},
+    {"role": "user", "content": "why OOMKilled?"},
+]
+
+
+def test_llama3_format():
+    text = template_for("llama-3-8b")(MESSAGES)
+    # no BOS string: the engine's tokenizer prepends bos_id at admission
+    assert not text.startswith("<|begin_of_text|>")
+    assert "<|start_header_id|>system<|end_header_id|>\n\nanalyse pod failures<|eot_id|>" in text
+    assert text.endswith("<|start_header_id|>assistant<|end_header_id|>\n\n")
+    # llama-3.1/3.2 share the format
+    assert template_for("llama-3.2-1b")(MESSAGES) == text
+
+
+def test_chatml_format_for_qwen():
+    text = template_for("qwen2.5-7b")(MESSAGES)
+    assert "<|im_start|>system\nanalyse pod failures<|im_end|>" in text
+    assert text.endswith("<|im_start|>assistant\n")
+
+
+def test_mistral_folds_system_into_first_user_turn():
+    text = template_for("mistral-7b")(MESSAGES)
+    assert text == "[INST] analyse pod failures\n\nwhy OOMKilled? [/INST]"
+    # multi-turn: assistant replies close with </s>
+    multi = MESSAGES + [
+        {"role": "assistant", "content": "memory limit hit"},
+        {"role": "user", "content": "fix?"},
+    ]
+    text = template_for("mistral-7b")(multi)
+    assert " memory limit hit</s>" in text
+    assert text.endswith("[INST] fix? [/INST]")
+
+
+def test_zephyr_for_tinyllama():
+    text = template_for("tinyllama-1.1b")(MESSAGES)
+    assert text.startswith("<|system|>\nanalyse pod failures</s>\n")
+    assert text.endswith("<|assistant|>\n")
+
+
+def test_unknown_model_gets_plain():
+    text = template_for("tiny-test")(MESSAGES)
+    assert text == "system: analyse pod failures\nuser: why OOMKilled?\nassistant:"
+    assert template_for("")(MESSAGES) == text
+
+
+def test_mistral_system_only_not_dropped():
+    text = template_for("mistral-7b")([
+        {"role": "system", "content": "analyse pod failures"}])
+    assert text == "[INST] analyse pod failures [/INST]"
