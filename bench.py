@@ -246,10 +246,14 @@ def main() -> None:
     # processes the previous block's tokens — hides the host<->device round
     # trip, which dominates block time over a tunneled TPU backend
     pipeline_depth = int(os.environ.get("BENCH_PIPELINE", "2"))
+    # chunked prefill: bound the decode stall per admission wave
+    # (BENCH_PREFILL_CHUNK=256 is the interesting open-loop comparison row)
+    prefill_chunk = int(os.environ.get("BENCH_PREFILL_CHUNK", "0")) or None
     generator = BatchedGenerator(
         params, config, tokenizer, max_slots=slots, max_seq=max_seq,
         paged=paged, page_size=int(os.environ.get("BENCH_PAGE_SIZE", "64")),
         decode_block=decode_block, pipeline_depth=pipeline_depth,
+        prefill_chunk=prefill_chunk,
     )
     prompts = [build_prompt(r) for r in build_requests(n_requests)]
     sampling = SamplingParams(max_tokens=max_tokens, temperature=0.3, stop_on_eos=False)

@@ -83,6 +83,32 @@ class _Slot:
     pages: list[int] = field(default_factory=list)  # paged mode only
 
 
+@dataclass
+class _PrefillJob:
+    """An in-progress chunked prefill (engine.prefill_chunk).
+
+    Device state (the bucket mini cache and the running last-token logits)
+    carries across chunk calls; host arrays describe the admitted wave the
+    same way _admit_batch's one-shot path does."""
+
+    key: tuple  # (n_pad, t_pad)
+    ids: Any  # [n_pad, t_pad] device tokens
+    lengths_np: Any
+    lengths: Any  # device
+    temp: Any
+    top_p: Any
+    slot_ids_np: Any  # padded rows duplicate row 0
+    taken: list
+    params_list: list
+    page_grants: list
+    row_tables_np: Any  # paged only
+    adapter_idx: Any  # device or None
+    mini: Any  # KVCache carry
+    last_logits: Any  # [n_pad, vocab] carry
+    written: int
+    started: float
+
+
 class OversizedRequest(ValueError):
     """A single request needs more KV pages than the whole cache holds."""
 
@@ -150,6 +176,7 @@ class BatchedGenerator:
         pipeline_depth: int = 1,
         lora_adapters: Optional[dict[str, Any]] = None,
         lora_alpha: float = 16.0,
+        prefill_chunk: Optional[int] = None,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -188,6 +215,26 @@ class BatchedGenerator:
         #: Called from the decode worker thread; must not block.
         self.partial_hook: Optional[Any] = None
         self._inflight_blocks: list[tuple[Any, dict]] = []
+
+        # ---- chunked prefill (Sarathi-style interleaving): a long prompt
+        # is prefilled ``prefill_chunk`` tokens per engine round instead of
+        # one shot, so in-flight decodes stall for at most one chunk's wall
+        # time per round rather than the whole prompt's.  One job at a time;
+        # its slots are RESERVED (not yet decoding) until the finish step
+        # scatters the mini cache and samples the first token.  None = off.
+        if prefill_chunk is not None:
+            if prefill_chunk < 1:
+                raise ValueError(f"prefill_chunk={prefill_chunk} must be >= 1")
+            if mesh is not None:
+                raise ValueError(
+                    "prefill_chunk is not supported with a serving mesh yet; "
+                    "use one-shot prefill (dp-aware admission) on meshes"
+                )
+        self.prefill_chunk = prefill_chunk
+        self._prefill_job: Optional[_PrefillJob] = None
+        self._reserved: set[int] = set()
+        self._chunk_fns: dict[tuple[int, int, int], Any] = {}
+        self._finish_fns: dict[tuple[int, int], Any] = {}
 
         # ---- multi-LoRA serving: adapters stacked [n_layers, n_adapters+1,
         # ...] with the all-zeros base at index 0; every request picks its
@@ -601,10 +648,19 @@ class BatchedGenerator:
         return sorted(name for name in self._adapter_ids if name is not None)
 
     def free_slots(self) -> list[int]:
-        return [i for i, s in enumerate(self.slots) if not s.active]
+        return [
+            i for i, s in enumerate(self.slots)
+            if not s.active and i not in self._reserved
+        ]
 
     @property
     def num_active(self) -> int:
+        # reserved (chunk-prefilling) slots count: they occupy capacity and
+        # need step() calls to make progress even before decoding starts
+        return sum(s.active for s in self.slots) + len(self._reserved)
+
+    @property
+    def num_decoding(self) -> int:
         return sum(s.active for s in self.slots)
 
     def admit(
@@ -716,6 +772,15 @@ class BatchedGenerator:
             adapter_idx[row] = adapter_idx[0]
 
         key = (n_pad, t_pad)
+        if (
+            self.prefill_chunk is not None
+            and t_pad > self.prefill_chunk
+            and self._prefill_job is None
+        ):
+            return self._start_prefill_job(
+                key, ids, lengths, temp, top_p, slot_ids, adapter_idx,
+                token_lists, params_list, page_grants, taken, started,
+            )
         if key not in self._prefill_fns:
             log.info("compiling prefill bucket n=%d t=%d (paged=%s)", n_pad, t_pad, self.paged)
             self._prefill_fns[key] = (
@@ -725,29 +790,14 @@ class BatchedGenerator:
             )
 
         if self.paged:
-            from ..ops.paged_attention import PagedKVCache
-
             # install each admitted row's page list + prompt length in the
             # device table BEFORE prefill; padding rows reuse row 0's table
             # (identical duplicate writes — see the comment above)
-            row_tables = np.zeros((n_pad, self.pages_per_seq), np.int32)
-            for row, grant in enumerate(page_grants):
-                row_tables[row, : len(grant)] = grant
-            for row in range(n, n_pad):
-                row_tables[row] = row_tables[0]
-            paged = self.paged_cache
-            table = paged.page_table.at[jnp.asarray(slot_ids[:n])].set(
-                jnp.asarray(row_tables[:n])
-            )
-            lens = paged.lengths.at[jnp.asarray(slot_ids[:n])].set(
-                jnp.asarray(lengths[:n])
-            )
-            paged = PagedKVCache(
-                k_pages=paged.k_pages, v_pages=paged.v_pages,
-                page_table=table, lengths=lens,
+            row_tables = self._install_page_tables(
+                n, n_pad, slot_ids, page_grants, lengths
             )
             self.paged_cache, first_tokens, self._rng = self._prefill_fns[key](
-                self.params, paged, jnp.asarray(ids), jnp.asarray(lengths),
+                self.params, self.paged_cache, jnp.asarray(ids), jnp.asarray(lengths),
                 jnp.asarray(row_tables), self._rng, jnp.asarray(temp),
                 jnp.asarray(top_p), self.lora,
                 jnp.asarray(adapter_idx) if self.lora is not None else None,
@@ -759,10 +809,20 @@ class BatchedGenerator:
                 self.lora,
                 jnp.asarray(adapter_idx) if self.lora is not None else None,
             )
-        first_np = np.asarray(first_tokens)
+        return self._activate_slots(
+            np.asarray(first_tokens), lengths, taken, params_list,
+            page_grants, started,
+        )
+
+    def _activate_slots(
+        self, first_np, lengths, taken, params_list, page_grants, started
+    ) -> list[int]:
+        """Prompt KV is in the big cache and first tokens are sampled:
+        flip the slots live (shared by one-shot and chunked prefill)."""
+        jnp = self._jnp
         prefill_ms = (time.perf_counter() - started) * 1e3
         self.metrics.record("prefill", prefill_ms)
-        self.metrics.record("prefill_batch", float(n))
+        self.metrics.record("prefill_batch", float(len(taken)))
 
         # paged mode tracks positions in _host_offsets + paged_cache.lengths
         # only; the device offsets array belongs to the contiguous path
@@ -787,6 +847,205 @@ class BatchedGenerator:
         self.last_tokens = jnp.asarray(last)
         self._sampling_cache = None  # slot set changed
         return list(taken)
+
+    def _install_page_tables(
+        self, n: int, n_pad: int, slot_ids, page_grants, lengths
+    ):
+        """Write each admitted row's page list + prompt length into the
+        device page table (shared by one-shot and chunked prefill); padding
+        rows duplicate row 0 (identical duplicate writes are
+        order-independent).  Returns the host row_tables array."""
+        from ..ops.paged_attention import PagedKVCache
+
+        jnp = self._jnp
+        row_tables = np.zeros((n_pad, self.pages_per_seq), np.int32)
+        for row, grant in enumerate(page_grants):
+            row_tables[row, : len(grant)] = grant
+        for row in range(n, n_pad):
+            row_tables[row] = row_tables[0]
+        paged = self.paged_cache
+        table = paged.page_table.at[jnp.asarray(slot_ids[:n])].set(
+            jnp.asarray(row_tables[:n])
+        )
+        lens = paged.lengths.at[jnp.asarray(slot_ids[:n])].set(
+            jnp.asarray(lengths[:n])
+        )
+        self.paged_cache = PagedKVCache(
+            k_pages=paged.k_pages, v_pages=paged.v_pages,
+            page_table=table, lengths=lens,
+        )
+        return row_tables
+
+    # ------------------------------------------------------------------
+    # chunked prefill (Sarathi-style interleaving; prefill_chunk knob)
+    # ------------------------------------------------------------------
+
+    def _start_prefill_job(
+        self, key, ids, lengths, temp, top_p, slot_ids, adapter_idx,
+        token_lists, params_list, page_grants, taken, started,
+    ) -> list[int]:
+        """Reserve the wave's slots and stage device state; chunks run one
+        per step() call so in-flight decodes interleave."""
+        jnp = self._jnp
+        n_pad, t_pad = key
+        row_tables = None
+        if self.paged:
+            # install page tables + prompt lengths now (same as one-shot);
+            # the slots stay reserved so decode never touches them early
+            row_tables = self._install_page_tables(
+                len(token_lists), n_pad, slot_ids, page_grants, lengths
+            )
+        cache_ref = self.paged_cache.k_pages if self.paged else self.cache.k
+        self._prefill_job = _PrefillJob(
+            key=key,
+            ids=jnp.asarray(ids),
+            lengths_np=lengths,
+            lengths=jnp.asarray(lengths),
+            temp=jnp.asarray(temp),
+            top_p=jnp.asarray(top_p),
+            slot_ids_np=slot_ids,
+            taken=list(taken),
+            params_list=list(params_list),
+            page_grants=list(page_grants),
+            row_tables_np=row_tables,
+            adapter_idx=(
+                jnp.asarray(adapter_idx) if self.lora is not None else None
+            ),
+            mini=KVCache.create(
+                self.config, n_pad, t_pad, dtype=cache_ref.dtype
+            ),
+            last_logits=jnp.zeros(
+                (n_pad, self.config.vocab_size), jnp.float32
+            ),
+            written=0,
+            started=started,
+        )
+        self._reserved.update(taken)
+        return list(taken)
+
+    def _make_chunk_fn(self, n_pad: int, t_pad: int, chunk: int):
+        """One prefill chunk: forward ``chunk`` tokens at a dynamic offset
+        into the job's mini cache, carrying last-token logits for rows whose
+        prompt ends inside this chunk."""
+        jax, jnp = self._jax, self._jnp
+        config = self.config
+
+        def chunk_fn(params, mini, ids_chunk, lengths, offset, last_logits,
+                     lora=None, lora_idx=None):
+            positions = offset + jnp.broadcast_to(
+                jnp.arange(chunk, dtype=jnp.int32)[None], (n_pad, chunk)
+            )
+            kv_positions = jnp.broadcast_to(
+                jnp.arange(t_pad, dtype=jnp.int32)[None], (n_pad, t_pad)
+            )
+            # valid cache slots: written so far (incl. this chunk) AND real
+            kv_valid = kv_positions < jnp.minimum(lengths, offset + chunk)[:, None]
+            logits, mini = forward(
+                params, config, ids_chunk, positions, cache=mini,
+                cache_offset=jnp.broadcast_to(offset, (n_pad,)),
+                kv_valid=kv_valid,
+                lora=lora, lora_alpha=self.lora_alpha, lora_indices=lora_idx,
+            )
+            rel = lengths - 1 - offset  # last-token position, chunk-relative
+            in_chunk = (rel >= 0) & (rel < chunk)
+            gathered = jnp.take_along_axis(
+                logits, jnp.clip(rel, 0, chunk - 1)[:, None, None].astype(jnp.int32),
+                axis=1,
+            )[:, 0, :]
+            last_logits = jnp.where(in_chunk[:, None], gathered, last_logits)
+            return mini, last_logits
+
+        return jax.jit(chunk_fn)
+
+    def _make_finish_fn(self, n_pad: int, t_pad: int):
+        """Scatter the completed mini cache into the big cache / pages and
+        sample each row's first token from the carried last logits."""
+        jax, jnp = self._jax, self._jnp
+
+        if self.paged:
+            def finish_fn(paged, mini, lengths, row_tables, last_logits,
+                          rng, temp, top_p):
+                from ..ops.paged_attention import PagedKVCache, write_tokens
+
+                zero = jnp.zeros((n_pad,), jnp.int32)
+                scatter = jax.vmap(write_tokens, in_axes=(0, None, 0, None, None))
+                k_pages = scatter(paged.k_pages, row_tables, mini.k, zero, lengths)
+                v_pages = scatter(paged.v_pages, row_tables, mini.v, zero, lengths)
+                first_tokens, rng = self._sample(last_logits, rng, temp, top_p)
+                return (
+                    PagedKVCache(
+                        k_pages=k_pages, v_pages=v_pages,
+                        page_table=paged.page_table, lengths=paged.lengths,
+                    ),
+                    first_tokens, rng,
+                )
+        else:
+            def finish_fn(cache, mini, lengths, slot_ids, last_logits,
+                          rng, temp, top_p):
+                k = cache.k.at[:, slot_ids, :t_pad].set(mini.k.astype(cache.k.dtype))
+                v = cache.v.at[:, slot_ids, :t_pad].set(mini.v.astype(cache.v.dtype))
+                first_tokens, rng = self._sample(last_logits, rng, temp, top_p)
+                return KVCache(k=k, v=v), first_tokens, rng
+
+        return jax.jit(finish_fn)
+
+    def _advance_prefill(self) -> None:
+        """Run ONE chunk of the pending job (or its finish step)."""
+        job = self._prefill_job
+        assert job is not None
+        jnp = self._jnp
+        n_pad, t_pad = job.key
+        t0 = time.perf_counter()
+
+        if job.written < t_pad:
+            # the last chunk may be PARTIAL: t_pad buckets clamp to max_seq,
+            # which need not divide the chunk size — a fixed-width slice
+            # there would clamp its start and silently re-forward tokens at
+            # wrong positions (jax dynamic_slice semantics)
+            step_chunk = min(self.prefill_chunk, t_pad - job.written)
+            fn_key = (n_pad, t_pad, step_chunk)
+            if fn_key not in self._chunk_fns:
+                log.info("compiling prefill chunk n=%d t=%d chunk=%d",
+                         n_pad, t_pad, step_chunk)
+                self._chunk_fns[fn_key] = self._make_chunk_fn(
+                    n_pad, t_pad, step_chunk
+                )
+            ids_chunk = self._jax.lax.dynamic_slice_in_dim(
+                job.ids, job.written, step_chunk, axis=1
+            )
+            job.mini, job.last_logits = self._chunk_fns[fn_key](
+                self.params, job.mini, ids_chunk, job.lengths,
+                jnp.int32(job.written), job.last_logits,
+                self.lora, job.adapter_idx,
+            )
+            job.written += step_chunk
+            self.metrics.record(
+                "prefill_chunk", (time.perf_counter() - t0) * 1e3
+            )
+            if job.written < t_pad:
+                return
+        # all chunks written: scatter + sample, then activate
+        fn_key2 = job.key
+        if fn_key2 not in self._finish_fns:
+            self._finish_fns[fn_key2] = self._make_finish_fn(n_pad, t_pad)
+        if self.paged:
+            self.paged_cache, first_tokens, self._rng = self._finish_fns[fn_key2](
+                self.paged_cache, job.mini, job.lengths,
+                jnp.asarray(job.row_tables_np), job.last_logits,
+                self._rng, job.temp, job.top_p,
+            )
+        else:
+            self.cache, first_tokens, self._rng = self._finish_fns[fn_key2](
+                self.cache, job.mini, job.lengths,
+                jnp.asarray(job.slot_ids_np), job.last_logits,
+                self._rng, job.temp, job.top_p,
+            )
+        self._prefill_job = None
+        self._reserved.difference_update(job.taken)
+        self._activate_slots(
+            np.asarray(first_tokens), job.lengths_np, job.taken,
+            job.params_list, job.page_grants, job.started,
+        )
 
     def _sampling_tensors(self):
         """(active_np, temp_dev, top_p_dev, active_dev), rebuilt only when
@@ -831,9 +1090,13 @@ class BatchedGenerator:
         """
         if self.num_active == 0 and not self._inflight_blocks:
             return []
+        if self._prefill_job is not None:
+            # one chunk per round: in-flight decodes stall for at most one
+            # chunk's wall time before their next block dispatches
+            self._advance_prefill()
         started = time.perf_counter()
         block = self.decode_block
-        if self.num_active:
+        if self.num_decoding:
             self._dispatch_block()
         finished: list[tuple[int, GenerationResult]] = []
         # keep at most depth-1 blocks in flight; once nothing is active the

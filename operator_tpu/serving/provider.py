@@ -226,6 +226,15 @@ def build_serving_engine(
             "multi-LoRA serving disabled", config.lora_dir,
         )
 
+    prefill_chunk = config.prefill_chunk or None
+    if prefill_chunk and mesh is not None:
+        log.warning(
+            "prefill_chunk=%d is not supported with a serving mesh yet; "
+            "falling back to one-shot prefill (long prompts will stall "
+            "in-flight decodes for their full prefill time)", prefill_chunk,
+        )
+        prefill_chunk = None
+
     generator = BatchedGenerator(
         params,
         model_config,
@@ -241,6 +250,7 @@ def build_serving_engine(
         sample_top_k=config.sample_top_k,
         lora_adapters=lora_adapters,
         lora_alpha=config.lora_alpha,
+        prefill_chunk=prefill_chunk,
     )
     return ServingEngine(generator), model_id
 

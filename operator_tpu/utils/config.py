@@ -69,6 +69,10 @@ class OperatorConfig:
     # flight while the host processes older tokens; 2 hides the per-block
     # host<->device round trip, 1 = synchronous
     pipeline_depth: int = 2
+    # chunked prefill (Sarathi-style): prefill at most this many prompt
+    # tokens per engine round so long prefills don't stall in-flight
+    # decodes; 0 = one-shot prefill (power of two when set)
+    prefill_chunk: int = 0
     # nucleus-sampling candidate set (engine SAMPLE_TOP_K): top-p filtering
     # runs inside the top-k — raise for high-temperature diversity
     sample_top_k: int = 64

@@ -1,0 +1,152 @@
+"""Chunked prefill (Sarathi-style interleaving) token parity.
+
+A prompt prefilled ``chunk`` tokens per engine round must produce exactly
+the cache and first token the one-shot prefill produces — causal
+attention over previously written chunks is mathematically identical.
+The test prompts span multiple buckets and chunk counts, and continuous
+batching must keep decoding earlier waves between chunks.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from operator_tpu.models import TINY_TEST, init_params
+from operator_tpu.models.tokenizer import ByteTokenizer
+from operator_tpu.serving.engine import BatchedGenerator, SamplingParams
+
+CONFIG = TINY_TEST
+GREEDY = SamplingParams(max_tokens=6, temperature=0.0, stop_on_eos=False)
+
+# byte tokenizer: ~1 token per char (+BOS).  128 -> several 16-token chunks
+PROMPTS = [
+    "pod was OOMKilled " * 7,           # ~126 tokens -> t_pad 128
+    "short prompt",                      # ~12 tokens  -> t_pad 64 bucket
+    "disk pressure eviction event " * 4, # ~116 tokens
+]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CONFIG, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def _generator(params, *, paged, prefill_chunk=None):
+    return BatchedGenerator(
+        params, CONFIG, ByteTokenizer(), max_slots=4, max_seq=160,
+        cache_dtype=jnp.float32, paged=paged, page_size=16, decode_block=2,
+        prefill_chunk=prefill_chunk,
+    )
+
+
+def _drain(generator, prompts, sampling=None):
+    slots = generator.admit(prompts, [sampling or GREEDY] * len(prompts))
+    results = {}
+    while generator.num_active:
+        for slot_id, result in generator.step():
+            results[slot_id] = result
+    return [results[s].token_ids for s in slots]
+
+
+@pytest.mark.parametrize("paged", [True, False])
+@pytest.mark.parametrize("chunk", [16, 64])
+def test_chunked_matches_oneshot(params, paged, chunk):
+    chunked = _drain(_generator(params, paged=paged, prefill_chunk=chunk), PROMPTS)
+    oneshot = _drain(_generator(params, paged=paged), PROMPTS)
+    assert chunked == oneshot
+
+
+def test_short_bucket_takes_oneshot_path(params):
+    """Prompts whose bucket fits one chunk skip the job machinery."""
+    generator = _generator(params, paged=True, prefill_chunk=64)
+    tokens = _drain(generator, ["tiny"])  # bucket 64 == chunk
+    assert generator._prefill_job is None
+    assert tokens == _drain(_generator(params, paged=True), ["tiny"])
+
+
+def test_decode_interleaves_with_chunks(params):
+    """A wave admitted BEFORE a long chunked prefill keeps decoding while
+    the chunks run: its tokens accumulate between chunk rounds."""
+    # chunk 64: the short early wave (bucket 64) takes the one-shot path
+    # and starts decoding; the long prompt (bucket 128) runs as 2 chunks
+    generator = _generator(params, paged=True, prefill_chunk=64)
+    long_sampling = SamplingParams(max_tokens=4, temperature=0.0,
+                                   stop_on_eos=False)
+    [first] = generator.admit(
+        ["early wave"], [SamplingParams(max_tokens=30, temperature=0.0,
+                                        stop_on_eos=False)],
+    )
+    assert generator._prefill_job is None  # one-shot: decoding immediately
+    generator.step()  # first decode block for the early wave
+    before = len(generator.slots[first].generated)
+    assert before > 0
+
+    [late] = generator.admit([PROMPTS[0]], [long_sampling])
+    assert generator._prefill_job is not None  # multi-chunk job pending
+    # one engine round: advances ONE chunk and still decodes the early wave
+    generator.step()
+    assert len(generator.slots[first].generated) > before
+    assert generator._prefill_job is not None  # job spans several rounds
+
+    results = {}
+    while generator.num_active:
+        for slot_id, result in generator.step():
+            results[slot_id] = result
+    assert set(results) == {first, late}
+
+    # parity: the late request's tokens equal a fresh one-shot run
+    expected = _drain(_generator(params, paged=True), [PROMPTS[0]], long_sampling)
+    assert [results[late].token_ids] == expected
+
+
+def test_reserved_slots_not_reallocated(params):
+    """While a job is pending its slots are neither free nor decoding."""
+    generator = _generator(params, paged=True, prefill_chunk=16)
+    [slot] = generator.admit([PROMPTS[0]], [GREEDY])
+    assert generator._prefill_job is not None
+    assert slot not in generator.free_slots()
+    assert generator.num_decoding == 0
+    assert generator.num_active == 1
+    while generator.num_active:
+        generator.step()
+
+
+def test_generate_sync_with_chunking(params):
+    result = _generator(params, paged=False, prefill_chunk=16).generate(
+        PROMPTS[0], GREEDY
+    )
+    expected = _generator(params, paged=False).generate(PROMPTS[0], GREEDY)
+    assert result.token_ids == expected.token_ids
+
+
+def test_mesh_rejects_chunking(params):
+    from operator_tpu.parallel import MeshPlan, make_mesh
+
+    mesh = make_mesh(MeshPlan(dp=2, fsdp=2, tp=2), jax.devices("cpu"))
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        BatchedGenerator(
+            params, CONFIG, ByteTokenizer(), max_slots=4, max_seq=160,
+            cache_dtype=jnp.float32, paged=True, page_size=16, mesh=mesh,
+            prefill_chunk=64,
+        )
+
+
+def test_partial_final_chunk_parity(params):
+    """t_pad clamped to a non-multiple of the chunk (max_seq=160, chunk=64
+    -> chunks 64+64+32): a fixed-width final slice would silently clamp its
+    start and re-forward tokens at wrong positions."""
+    prompt = "container exceeded its memory limit and was evicted by kubelet " * 3
+    # ~190 chars -> >128 tokens -> bucket clamps to max_seq=160 (not pow2-divisible)
+    sampling = SamplingParams(max_tokens=4, temperature=0.0, stop_on_eos=False)
+    chunked_gen = _generator(params, paged=True, prefill_chunk=64)
+    chunked = _drain(chunked_gen, [prompt], sampling)
+    assert (1, 160, 32) in chunked_gen._chunk_fns  # the partial chunk ran
+    oneshot = _drain(_generator(params, paged=True), [prompt], sampling)
+    assert chunked == oneshot
+
+
+def test_bad_chunk_value_rejected(params):
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        _generator(params, paged=True, prefill_chunk=0)
