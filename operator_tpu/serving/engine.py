@@ -101,12 +101,12 @@ class _PrefillJob:
     taken: list
     params_list: list
     page_grants: list
-    row_tables_np: Any  # paged only
     adapter_idx: Any  # device or None
     mini: Any  # KVCache carry
     last_logits: Any  # [n_pad, vocab] carry
     written: int
     started: float
+    chunk_ms: float = 0.0  # accumulated chunk compute (not interleaved wall)
 
 
 class OversizedRequest(ValueError):
@@ -189,6 +189,7 @@ class BatchedGenerator:
         self.max_seq = min(max_seq or config.max_seq_len, config.max_seq_len)
         self.metrics = metrics or METRICS
         cache_dtype = cache_dtype or jnp.bfloat16
+        self.cache_dtype = cache_dtype
         # decode in blocks of K steps per host round-trip (lax.scan): one
         # dispatch + one token fetch per K tokens hides host latency for
         # K-1 of every K steps.  Finished slots may decode up to K-1 junk
@@ -286,14 +287,10 @@ class BatchedGenerator:
             # list instead of reserving max_seq per slot up front)
             num_pages = kv_pages or (max_slots * self.pages_per_seq + 1)
             self.allocator = PageAllocator(num_pages)
-            self.paged_cache = PagedKVCache.create(
-                config.num_layers, num_pages, page_size, config.num_kv_heads,
-                config.head_dim, max_slots, self.pages_per_seq, dtype=cache_dtype,
-            )
             self.cache = None
+            self._alloc_decode_state()
             if mesh is not None:
                 s = self._shardings
-                self.paged_cache = jax.device_put(self.paged_cache, s["paged"])
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
                 block_tokens = NamedSharding(mesh, P(None, ("dp", "fsdp")))
@@ -310,10 +307,9 @@ class BatchedGenerator:
             else:
                 self._decode_fn = jax.jit(self._decode_block_paged, donate_argnums=(1,))
         else:
-            self.cache = KVCache.create(config, max_slots, self.max_seq, dtype=cache_dtype)
+            self._alloc_decode_state()
             if mesh is not None:
                 s = self._shardings
-                self.cache = jax.device_put(self.cache, s["cache"])
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
                 block_tokens = NamedSharding(mesh, P(None, ("dp", "fsdp")))
@@ -331,8 +327,6 @@ class BatchedGenerator:
                 )
             else:
                 self._decode_fn = jax.jit(self._decode_block, donate_argnums=(1,))
-        self.offsets = jnp.zeros((max_slots,), jnp.int32)  # tokens held per slot
-        self.last_tokens = jnp.zeros((max_slots, 1), jnp.int32)
         self.slots: list[_Slot] = [_Slot() for _ in range(max_slots)]
         # per-slot generation counter: an in-flight decode block carries the
         # epoch it was dispatched under, so tokens from a block dispatched
@@ -647,6 +641,58 @@ class BatchedGenerator:
         """Registered LoRA adapter names (multi-LoRA serving)."""
         return sorted(name for name in self._adapter_ids if name is not None)
 
+    def _alloc_decode_state(self) -> None:
+        """Fresh zeroed decode state: KV cache / page pool (+ mesh
+        placement) and the per-slot device vectors.  Used at construction
+        and by :meth:`reset` — one code path, so post-recovery state can
+        never diverge from fresh-start state."""
+        jnp = self._jnp
+        if self.paged:
+            from ..ops.paged_attention import PagedKVCache
+
+            self.paged_cache = PagedKVCache.create(
+                self.config.num_layers, self.allocator.num_pages,
+                self.page_size, self.config.num_kv_heads,
+                self.config.head_dim, self.max_slots, self.pages_per_seq,
+                dtype=self.cache_dtype,
+            )
+            if self.mesh is not None:
+                self.paged_cache = self._jax.device_put(
+                    self.paged_cache, self._shardings["paged"]
+                )
+        else:
+            self.cache = KVCache.create(
+                self.config, self.max_slots, self.max_seq, dtype=self.cache_dtype
+            )
+            if self.mesh is not None:
+                self.cache = self._jax.device_put(
+                    self.cache, self._shardings["cache"]
+                )
+        self.offsets = jnp.zeros((self.max_slots,), jnp.int32)
+        self.last_tokens = jnp.zeros((self.max_slots, 1), jnp.int32)
+
+    def reset(self) -> None:
+        """Drop every sequence and rebuild the device decode state.
+
+        The recovery path after a device/tunnel error mid-step: donated
+        buffers (KV cache / page pool) may be invalid, so fresh zeroed
+        caches are allocated, all pages freed, and every slot emptied —
+        the WEIGHTS are reused (never donated, still resident).  In-flight
+        generations are lost; their futures were already failed by the
+        ServingEngine before it calls this.
+        """
+        self._inflight_blocks.clear()
+        self._prefill_job = None
+        self._reserved.clear()
+        if self.paged:
+            self.allocator = PageAllocator(self.allocator.num_pages)
+        self._alloc_decode_state()
+        for i in range(self.max_slots):
+            self._slot_epoch[i] += 1  # orphan any in-flight device tokens
+            self.slots[i] = _Slot()
+        self._host_offsets[:] = 0
+        self._sampling_cache = None
+
     def free_slots(self) -> list[int]:
         return [
             i for i, s in enumerate(self.slots)
@@ -790,14 +836,11 @@ class BatchedGenerator:
             )
 
         if self.paged:
-            # install each admitted row's page list + prompt length in the
-            # device table BEFORE prefill; padding rows reuse row 0's table
-            # (identical duplicate writes — see the comment above)
-            row_tables = self._install_page_tables(
+            staged, row_tables = self._stage_page_tables(
                 n, n_pad, slot_ids, page_grants, lengths
             )
             self.paged_cache, first_tokens, self._rng = self._prefill_fns[key](
-                self.params, self.paged_cache, jnp.asarray(ids), jnp.asarray(lengths),
+                self.params, staged, jnp.asarray(ids), jnp.asarray(lengths),
                 jnp.asarray(row_tables), self._rng, jnp.asarray(temp),
                 jnp.asarray(top_p), self.lora,
                 jnp.asarray(adapter_idx) if self.lora is not None else None,
@@ -811,16 +854,17 @@ class BatchedGenerator:
             )
         return self._activate_slots(
             np.asarray(first_tokens), lengths, taken, params_list,
-            page_grants, started,
+            page_grants, (time.perf_counter() - started) * 1e3,
         )
 
     def _activate_slots(
-        self, first_np, lengths, taken, params_list, page_grants, started
+        self, first_np, lengths, taken, params_list, page_grants, prefill_ms
     ) -> list[int]:
         """Prompt KV is in the big cache and first tokens are sampled:
-        flip the slots live (shared by one-shot and chunked prefill)."""
+        flip the slots live (shared by one-shot and chunked prefill).
+        ``prefill_ms`` is prefill COMPUTE time: the chunked path passes its
+        accumulated chunk+finish time, not the interleaved wall span."""
         jnp = self._jnp
-        prefill_ms = (time.perf_counter() - started) * 1e3
         self.metrics.record("prefill", prefill_ms)
         self.metrics.record("prefill_batch", float(len(taken)))
 
@@ -848,13 +892,20 @@ class BatchedGenerator:
         self._sampling_cache = None  # slot set changed
         return list(taken)
 
-    def _install_page_tables(
+    def _stage_page_tables(
         self, n: int, n_pad: int, slot_ids, page_grants, lengths
     ):
-        """Write each admitted row's page list + prompt length into the
-        device page table (shared by one-shot and chunked prefill); padding
-        rows duplicate row 0 (identical duplicate writes are
-        order-independent).  Returns the host row_tables array."""
+        """Build the wave's page-table rows and a STAGED cache carrying
+        them (shared by one-shot and chunked prefill); padding rows
+        duplicate row 0 (identical duplicate writes are order-independent).
+
+        The staged cache is NOT committed to ``self.paged_cache`` — the
+        caller assigns only from its prefill/finish program's return value,
+        so a failed prefill leaves the device state untouched (inactive
+        slots keep their zeroed table rows pointing at the trash page while
+        the failed wave's grants go back to the allocator).
+
+        Returns ``(staged_cache, row_tables)``."""
         from ..ops.paged_attention import PagedKVCache
 
         jnp = self._jnp
@@ -870,11 +921,11 @@ class BatchedGenerator:
         lens = paged.lengths.at[jnp.asarray(slot_ids[:n])].set(
             jnp.asarray(lengths[:n])
         )
-        self.paged_cache = PagedKVCache(
+        staged = PagedKVCache(
             k_pages=paged.k_pages, v_pages=paged.v_pages,
             page_table=table, lengths=lens,
         )
-        return row_tables
+        return staged, row_tables
 
     # ------------------------------------------------------------------
     # chunked prefill (Sarathi-style interleaving; prefill_chunk knob)
@@ -888,13 +939,10 @@ class BatchedGenerator:
         per step() call so in-flight decodes interleave."""
         jnp = self._jnp
         n_pad, t_pad = key
-        row_tables = None
-        if self.paged:
-            # install page tables + prompt lengths now (same as one-shot);
-            # the slots stay reserved so decode never touches them early
-            row_tables = self._install_page_tables(
-                len(token_lists), n_pad, slot_ids, page_grants, lengths
-            )
+        # NOTE: the device page table is NOT touched here — chunks run in
+        # the job's mini cache only; tables commit atomically with the
+        # finish program's successful return (_advance_prefill), so a
+        # failure at any chunk leaves the device state untouched
         cache_ref = self.paged_cache.k_pages if self.paged else self.cache.k
         self._prefill_job = _PrefillJob(
             key=key,
@@ -907,7 +955,6 @@ class BatchedGenerator:
             taken=list(taken),
             params_list=list(params_list),
             page_grants=list(page_grants),
-            row_tables_np=row_tables,
             adapter_idx=(
                 jnp.asarray(adapter_idx) if self.lora is not None else None
             ),
@@ -1019,9 +1066,9 @@ class BatchedGenerator:
                 self.lora, job.adapter_idx,
             )
             job.written += step_chunk
-            self.metrics.record(
-                "prefill_chunk", (time.perf_counter() - t0) * 1e3
-            )
+            elapsed_ms = (time.perf_counter() - t0) * 1e3
+            job.chunk_ms += elapsed_ms
+            self.metrics.record("prefill_chunk", elapsed_ms)
             if job.written < t_pad:
                 return
         # all chunks written: scatter + sample, then activate
@@ -1029,9 +1076,13 @@ class BatchedGenerator:
         if fn_key2 not in self._finish_fns:
             self._finish_fns[fn_key2] = self._make_finish_fn(n_pad, t_pad)
         if self.paged:
+            staged, row_tables = self._stage_page_tables(
+                len(job.taken), n_pad, job.slot_ids_np, job.page_grants,
+                job.lengths_np,
+            )
             self.paged_cache, first_tokens, self._rng = self._finish_fns[fn_key2](
-                self.paged_cache, job.mini, job.lengths,
-                jnp.asarray(job.row_tables_np), job.last_logits,
+                staged, job.mini, job.lengths,
+                jnp.asarray(row_tables), job.last_logits,
                 self._rng, job.temp, job.top_p,
             )
         else:
@@ -1042,9 +1093,10 @@ class BatchedGenerator:
             )
         self._prefill_job = None
         self._reserved.difference_update(job.taken)
+        finish_ms = (time.perf_counter() - t0) * 1e3
         self._activate_slots(
             np.asarray(first_tokens), job.lengths_np, job.taken,
-            job.params_list, job.page_grants, job.started,
+            job.params_list, job.page_grants, job.chunk_ms + finish_ms,
         )
 
     def _sampling_tensors(self):
@@ -1296,6 +1348,10 @@ class ServingEngine:
         self._task: Optional[asyncio.Task] = None
         self._closed = False
         self._error: Optional[BaseException] = None
+        # auto-recovery after a loop death (transient device/tunnel errors):
+        # bounded resets per window, so a persistent fault still surfaces
+        self._reset_times: list[float] = []
+        self._reset_lock = asyncio.Lock()
 
     def _unwrap(self, item: tuple) -> tuple:
         """Pop bookkeeping for a queue entry: low-lane slots free on pop."""
@@ -1317,6 +1373,47 @@ class ServingEngine:
             self._stalled_avail = None
             return False
         return True
+
+    #: auto-recovery budget: at most this many loop restarts per window —
+    #: a persistent device fault must still surface instead of silently
+    #: thrashing (reference-equivalent discipline: the watch loop's 5s
+    #: auto-restart is likewise unconditional but visible in events)
+    MAX_RESETS_PER_WINDOW = 3
+    RESET_WINDOW_S = 600.0
+
+    async def _try_recover(self) -> None:
+        """One bounded attempt to revive a dead serve loop.
+
+        A transient device/tunnel error mid-step may have invalidated the
+        DONATED buffers (KV cache / page pool), so the generator rebuilds
+        its decode state from scratch (weights survive); in-flight requests
+        were already failed when the loop died.  Leaves ``_error`` set when
+        the reset budget is exhausted or the rebuild itself fails.
+        """
+        async with self._reset_lock:
+            if self._error is None or self._closed:  # raced another caller
+                return
+            now = time.monotonic()
+            self._reset_times = [
+                t for t in self._reset_times if now - t < self.RESET_WINDOW_S
+            ]
+            if len(self._reset_times) >= self.MAX_RESETS_PER_WINDOW:
+                return
+            self._reset_times.append(now)
+            log.warning(
+                "serving engine loop died (%s); resetting device state and "
+                "restarting (%d/%d resets in window)",
+                self._error, len(self._reset_times), self.MAX_RESETS_PER_WINDOW,
+            )
+            loop = asyncio.get_running_loop()
+            try:
+                await loop.run_in_executor(self._executor, self.generator.reset)
+            except Exception as exc:  # noqa: BLE001 - rebuild failed: stay dead
+                log.exception("engine reset failed; staying down")
+                self._error = exc
+                return
+            self._error = None
+            self._task = None  # the caller's generate() starts a fresh loop
 
     def _on_partial_from_worker(self, slot_id: int, token_ids: list) -> None:
         """Generator hook (decode worker thread) -> event-loop callback."""
@@ -1380,6 +1477,8 @@ class ServingEngine:
         and backpressured-in-hand requests are not preempted."""
         if self._closed:
             raise RuntimeError("serving engine is closed")
+        if self._error is not None:
+            await self._try_recover()
         if self._error is not None:
             raise RuntimeError("serving engine loop died") from self._error
         # reject unknown adapters at SUBMIT time: a bad name surfacing as a

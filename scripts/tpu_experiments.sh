@@ -65,6 +65,9 @@ run bench_8b     2400 env BENCH_OPEN=0 BENCH_MODEL=llama-3-8b BENCH_QUANT=1 \
 run bench_unroll 900 env BENCH_OPEN=0 OPERATOR_TPU_LAYER_UNROLL=22 python bench.py
 # decode-block straight-lining: does the scan CARRY (cache) get copied?
 run bench_block_unroll 900 env BENCH_OPEN=0 OPERATOR_TPU_DECODE_UNROLL=1 python bench.py
+# chunked prefill: bounded decode stalls under open-loop arrivals (the
+# interesting comparison is open-loop p50/p99 vs bench_main)
+run bench_chunked 1500 env BENCH_OPEN_SECONDS=60 BENCH_PREFILL_CHUNK=256 python bench.py
 # xplane trace of the timed region for the remaining-gap attribution
 run bench_profile 900 env BENCH_OPEN=0 BENCH_PROFILE=$OUT/xplane python bench.py
 run trace_summary 300 python scripts/analyze_xplane.py "$OUT/xplane" 40

@@ -310,7 +310,14 @@ class TestServingEngine:
                 engine = ServingEngine(generator)
                 with pytest.raises(ValueError):
                     await engine.generate("pod failed", SamplingParams(max_tokens=2))
-                with pytest.raises(RuntimeError):
+                # auto-recovery retries the loop (bounded): the persistent
+                # fault re-surfaces to each caller...
+                for _ in range(ServingEngine.MAX_RESETS_PER_WINDOW):
+                    with pytest.raises(ValueError):
+                        await engine.generate(
+                            "next request", SamplingParams(max_tokens=2))
+                # ...until the reset budget is exhausted: permanent fast-fail
+                with pytest.raises(RuntimeError, match="loop died"):
                     await engine.generate("next request")
 
             asyncio.run(main())
@@ -524,3 +531,67 @@ class TestPriorityAdmission:
 
         asyncio.run(scenario())
         assert order == ["a", "b", "c", "d"]
+
+
+class TestEngineRecovery:
+    def _engine(self):
+        params = init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+        generator = BatchedGenerator(
+            params, TINY_TEST, ByteTokenizer(), max_slots=2, max_seq=128,
+            cache_dtype=jnp.float32, paged=True, page_size=16, decode_block=2,
+        )
+        return generator, ServingEngine(generator, admission_wait_s=0.005)
+
+    def test_transient_step_error_recovers(self):
+        """One poisoned decode step kills the loop; the NEXT request resets
+        the device state and succeeds (in-flight requests failed fast)."""
+        generator, engine = self._engine()
+        original_step = generator.step
+        fail_once = {"armed": True}
+
+        def flaky_step():
+            if fail_once["armed"]:
+                fail_once["armed"] = False
+                raise RuntimeError("synthetic device error")
+            return original_step()
+
+        generator.step = flaky_step
+        sampling = SamplingParams(max_tokens=4, temperature=0.0,
+                                  stop_on_eos=False)
+
+        async def scenario():
+            await engine.start()
+            with pytest.raises(RuntimeError):
+                await engine.generate("first", sampling)  # loop dies mid-decode
+            # next request auto-recovers: fresh caches, fresh loop
+            result = await engine.generate("second", sampling)
+            assert result.completion_tokens >= 1
+            # all pages were freed by the reset
+            assert generator.allocator.available == generator.allocator.num_pages - 1
+            await engine.close()
+
+        asyncio.run(scenario())
+
+    def test_persistent_fault_exhausts_reset_budget(self):
+        generator, engine = self._engine()
+
+        def always_fail():
+            raise RuntimeError("persistent device fault")
+
+        generator.step = always_fail
+        sampling = SamplingParams(max_tokens=2, stop_on_eos=False)
+
+        async def scenario():
+            await engine.start()
+            failures = 0
+            for _ in range(ServingEngine.MAX_RESETS_PER_WINDOW + 2):
+                with pytest.raises(RuntimeError):
+                    await engine.generate("x", sampling)
+                failures += 1
+            # budget exhausted: the error is now permanent without thrash
+            assert len(engine._reset_times) == ServingEngine.MAX_RESETS_PER_WINDOW
+            with pytest.raises(RuntimeError, match="loop died"):
+                await engine.generate("x", sampling)
+            await engine.close()
+
+        asyncio.run(scenario())
