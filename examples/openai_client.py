@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Drive the completion API with the OpenAI python SDK (or stdlib fallback).
+
+Start a server first, e.g.:
+
+    OPERATOR_TPU_MODEL=tiny-test ALLOW_RANDOM_WEIGHTS=true \
+        python -m operator_tpu.serving --port 8000
+
+then:
+
+    python examples/openai_client.py [base_url]
+
+With the `openai` package installed the script uses the real SDK —
+demonstrating that the surface is drop-in; otherwise it speaks the wire
+format with stdlib http.client, so the demo runs in this repo's
+zero-extra-deps environment too.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def via_openai_sdk(base_url: str, token: str) -> None:
+    from openai import OpenAI
+
+    client = OpenAI(base_url=f"{base_url}/v1", api_key=token or "unused")
+    print("models:", [m.id for m in client.models.list()])
+    completion = client.completions.create(
+        model="tiny-test", prompt="pod failed with exit code 137",
+        max_tokens=16, temperature=0.3,
+    )
+    print("completion:", repr(completion.choices[0].text))
+    chat = client.chat.completions.create(
+        model="tiny-test",
+        messages=[{"role": "user", "content": "why was the pod OOMKilled?"}],
+        max_tokens=16,
+    )
+    print("chat:", repr(chat.choices[0].message.content))
+    stream = client.completions.create(
+        model="tiny-test", prompt="stream this", max_tokens=8, stream=True,
+    )
+    print("stream:", "".join(chunk.choices[0].text for chunk in stream))
+
+
+def via_stdlib(base_url: str, token: str) -> None:
+    import http.client
+    from urllib.parse import urlparse
+
+    parsed = urlparse(base_url)
+    headers = {"Content-Type": "application/json"}
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+
+    def request(method: str, path: str, body: dict | None = None):
+        conn = http.client.HTTPConnection(parsed.hostname, parsed.port, timeout=120)
+        conn.request(method, path, json.dumps(body) if body else None, headers)
+        response = conn.getresponse()
+        data = response.read()
+        conn.close()
+        return response.status, json.loads(data)
+
+    status, models = request("GET", "/v1/models")
+    assert status == 200, models
+    print("models:", [m["id"] for m in models["data"]])
+
+    status, completion = request("POST", "/v1/completions", {
+        "prompt": "pod failed with exit code 137", "max_tokens": 16,
+        "temperature": 0.3,
+    })
+    assert status == 200, completion
+    print("completion:", repr(completion["choices"][0]["text"]),
+          completion["usage"])
+
+    status, chat = request("POST", "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "why was the pod OOMKilled?"}],
+        "max_tokens": 16,
+    })
+    assert status == 200, chat
+    print("chat:", repr(chat["choices"][0]["message"]["content"]))
+
+    status, embeddings = request("POST", "/v1/embeddings", {
+        "input": ["OOMKilled exit 137", "ImagePullBackOff"],
+    })
+    assert status == 200, embeddings
+    print("embeddings:", len(embeddings["data"]), "vectors of dim",
+          len(embeddings["data"][0]["embedding"]))
+
+
+def main() -> None:
+    base_url = sys.argv[1] if len(sys.argv) > 1 else "http://127.0.0.1:8000"
+    token = os.environ.get("OPERATOR_TPU_API_TOKEN", "")
+    try:
+        import openai  # noqa: F401
+    except ImportError:
+        print("(openai package not installed; using stdlib client)")
+        via_stdlib(base_url, token)
+    else:
+        via_openai_sdk(base_url, token)
+
+
+if __name__ == "__main__":
+    main()

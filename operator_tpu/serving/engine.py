@@ -105,7 +105,6 @@ class _PrefillJob:
     mini: Any  # KVCache carry
     last_logits: Any  # [n_pad, vocab] carry
     written: int
-    started: float
     chunk_ms: float = 0.0  # accumulated chunk compute (not interleaved wall)
 
 
@@ -825,7 +824,7 @@ class BatchedGenerator:
         ):
             return self._start_prefill_job(
                 key, ids, lengths, temp, top_p, slot_ids, adapter_idx,
-                token_lists, params_list, page_grants, taken, started,
+                token_lists, params_list, page_grants, taken,
             )
         if key not in self._prefill_fns:
             log.info("compiling prefill bucket n=%d t=%d (paged=%s)", n_pad, t_pad, self.paged)
@@ -933,7 +932,7 @@ class BatchedGenerator:
 
     def _start_prefill_job(
         self, key, ids, lengths, temp, top_p, slot_ids, adapter_idx,
-        token_lists, params_list, page_grants, taken, started,
+        token_lists, params_list, page_grants, taken,
     ) -> list[int]:
         """Reserve the wave's slots and stage device state; chunks run one
         per step() call so in-flight decodes interleave."""
@@ -965,7 +964,6 @@ class BatchedGenerator:
                 (n_pad, self.config.vocab_size), jnp.float32
             ),
             written=0,
-            started=started,
         )
         self._reserved.update(taken)
         return list(taken)
@@ -1071,6 +1069,7 @@ class BatchedGenerator:
             self.metrics.record("prefill_chunk", elapsed_ms)
             if job.written < t_pad:
                 return
+            t0 = time.perf_counter()  # finish timed separately (no double count)
         # all chunks written: scatter + sample, then activate
         fn_key2 = job.key
         if fn_key2 not in self._finish_fns:
