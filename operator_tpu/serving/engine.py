@@ -670,6 +670,21 @@ class BatchedGenerator:
         self.offsets = jnp.zeros((self.max_slots,), jnp.int32)
         self.last_tokens = jnp.zeros((self.max_slots, 1), jnp.int32)
 
+    def cancel(self, slot_id: int) -> bool:
+        """Abort a DECODING sequence and reclaim its slot/pages now.
+
+        The capacity lever for client disconnects: without it an abandoned
+        request decodes to max_tokens, holding its slot and KV pages the
+        whole time.  The epoch bump orphans any in-flight decode-ahead
+        blocks carrying the dead sequence.  Chunk-prefilling (reserved)
+        slots can't be cancelled mid-job — their wave finishes first and a
+        sweep catches them next round.  Returns True if a slot was freed.
+        """
+        if 0 <= slot_id < self.max_slots and self.slots[slot_id].active:
+            self._finish(slot_id, reason="cancelled")
+            return True
+        return False
+
     def reset(self) -> None:
         """Drop every sequence and rebuild the device decode state.
 
@@ -1547,6 +1562,16 @@ class ServingEngine:
                 await asyncio.sleep(self.admission_wait_s)
                 while len(batch) < total_free and not self._queue.empty():
                     batch.append(self._unwrap(self._queue.get_nowait()))
+            if batch:
+                # drop requests whose callers vanished while QUEUED — no
+                # point tokenizing, granting pages, and prefilling a dead
+                # request ahead of live ones (in-place: batch IS _inflight)
+                live = [entry for entry in batch if not entry[2].done()]
+                if len(live) != len(batch):
+                    for entry in batch:
+                        if entry[2].done():
+                            self._partial_by_future.pop(entry[2], None)
+                    batch[:] = live
             if batch and not stalled:
                 admitted = await self._admit(batch)
                 # paged backpressure: requests beyond the KV free list stay
@@ -1563,6 +1588,26 @@ class ServingEngine:
                     else None
                 )
 
+            if self.generator.num_active:
+                # reclaim slots whose callers are gone (disconnects /
+                # timeouts): an abandoned request must not decode to
+                # max_tokens holding a slot and its KV pages
+                cancelled = [
+                    slot_id for slot_id, future in self._pending.items()
+                    if future.cancelled()
+                ]
+                if cancelled:
+                    freed = await loop.run_in_executor(
+                        self._executor,
+                        lambda: [self.generator.cancel(s) for s in cancelled],
+                    )
+                    for slot_id, reclaimed in zip(cancelled, freed):
+                        # a chunk-prefilling (reserved) slot can't be
+                        # cancelled mid-job: KEEP its future so the sweep
+                        # catches it once the wave activates
+                        if reclaimed:
+                            self._pending.pop(slot_id, None)
+                            self._partial_cbs.pop(slot_id, None)
             if self.generator.num_active:
                 finished = await loop.run_in_executor(
                     self._executor, self.generator.step

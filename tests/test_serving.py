@@ -595,3 +595,106 @@ class TestEngineRecovery:
             await engine.close()
 
         asyncio.run(scenario())
+
+
+class TestCancellation:
+    def test_cancelled_request_frees_slot_and_pages(self):
+        """Cancelling a caller's task mid-decode reclaims the slot and its
+        KV pages within a round; a co-batched request is unaffected."""
+        params = init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+        generator = BatchedGenerator(
+            params, TINY_TEST, ByteTokenizer(), max_slots=2, max_seq=128,
+            cache_dtype=jnp.float32, paged=True, page_size=16, decode_block=2,
+        )
+        engine = ServingEngine(generator, admission_wait_s=0.005)
+
+        async def scenario():
+            await engine.start()
+            long = asyncio.ensure_future(engine.generate(
+                "doomed request",
+                SamplingParams(max_tokens=60, temperature=0.0,
+                               stop_on_eos=False)))
+            short_task = asyncio.ensure_future(engine.generate(
+                "survivor",
+                SamplingParams(max_tokens=25, temperature=0.0,
+                               stop_on_eos=False)))
+            for _ in range(600):  # wait out the first prefill compile
+                if generator.num_decoding == 2:
+                    break
+                await asyncio.sleep(0.05)
+            assert generator.num_decoding == 2
+            pages_before = generator.allocator.available
+            long.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await long
+            # reclaim must land WHILE the survivor is still decoding —
+            # otherwise the survivor's own release would mask a leak
+            for _ in range(200):
+                if (generator.allocator.available > pages_before
+                        and generator.num_decoding == 1):
+                    break
+                await asyncio.sleep(0.02)
+            assert generator.allocator.available > pages_before
+            assert generator.num_decoding == 1  # survivor only
+            survivor = await short_task  # unaffected co-batched request
+            assert survivor.completion_tokens == 25
+            assert generator.num_decoding == 0
+            assert len(generator.free_slots()) == 2
+            # slot is immediately reusable with correct greedy output
+            again = await engine.generate(
+                "survivor", SamplingParams(max_tokens=25, temperature=0.0,
+                                           stop_on_eos=False))
+            assert again.token_ids == survivor.token_ids
+            await engine.close()
+
+        asyncio.run(scenario())
+
+    def test_cancel_api_ignores_inactive(self):
+        params = init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+        generator = BatchedGenerator(
+            params, TINY_TEST, ByteTokenizer(), max_slots=2, max_seq=128,
+            cache_dtype=jnp.float32,
+        )
+        assert generator.cancel(0) is False
+        assert generator.cancel(99) is False
+
+    def test_cancelled_while_queued_never_prefills(self):
+        """A request abandoned while waiting in the queue is dropped before
+        tokenization/prefill — it must never consume a prefill wave."""
+        params = init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+        generator = BatchedGenerator(
+            params, TINY_TEST, ByteTokenizer(), max_slots=1, max_seq=128,
+            cache_dtype=jnp.float32, paged=True, page_size=16, decode_block=2,
+        )
+        admitted_prompts: list[str] = []
+        original_admit = generator.admit
+
+        def spy_admit(prompts, sampling):
+            admitted_prompts.extend(prompts)
+            return original_admit(prompts, sampling)
+
+        generator.admit = spy_admit
+        engine = ServingEngine(generator, admission_wait_s=0.005)
+
+        async def scenario():
+            await engine.start()
+            occupant = asyncio.ensure_future(engine.generate(
+                "occupant", SamplingParams(max_tokens=30, temperature=0.0,
+                                           stop_on_eos=False)))
+            for _ in range(600):
+                if generator.num_decoding == 1:
+                    break
+                await asyncio.sleep(0.05)
+            doomed = asyncio.ensure_future(engine.generate(
+                "queued dead request", SamplingParams(max_tokens=10)))
+            await asyncio.sleep(0.1)  # queued behind the full batch
+            doomed.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await doomed
+            await occupant
+            # give the loop a round to drain the queue
+            await asyncio.sleep(0.2)
+            assert "queued dead request" not in admitted_prompts
+            await engine.close()
+
+        asyncio.run(scenario())
