@@ -55,6 +55,10 @@ class SamplingParams:
     #: picks its own adapter from the generator's stacked registry; None =
     #: base model).  Unknown names are rejected at admission.
     adapter: Optional[str] = None
+    #: constrain the output to one of these strings (serving/guided.py):
+    #: a token-trie automaton rides the decode scan as device state and
+    #: masks the sampler every step.  None = unconstrained.
+    guided_choice: Optional[tuple] = None
 
 
 @dataclass
@@ -236,6 +240,18 @@ class BatchedGenerator:
         self._chunk_fns: dict[tuple[int, int, int], Any] = {}
         self._finish_fns: dict[tuple[int, int], Any] = {}
 
+        # ---- guided decoding (serving/guided.py): automaton tables stacked
+        # [A_pad, S_pad, vocab] on device, per-slot (automaton, state)
+        # vectors carried through the decode scan.  None = no guided slot
+        # active; the unguided programs keep compiling/running untouched.
+        self._guided_cache: dict[tuple, Any] = {}   # choices -> ChoiceAutomaton
+        self._guided_tables = None                  # device stack, or None
+        self._guided_index: dict[tuple, int] = {}   # choices -> stacked idx
+        self._guided_aut_np = np.zeros((max_slots,), np.int32)
+        self.guided_aut = None                      # device [B] automaton ids
+        self.guided_state = None                    # device [B] DFA states
+        self._decode_fn_guided = None
+
         # ---- multi-LoRA serving: adapters stacked [n_layers, n_adapters+1,
         # ...] with the all-zeros base at index 0; every request picks its
         # adapter per slot inside ONE compiled program (models/llama.py
@@ -340,7 +356,7 @@ class BatchedGenerator:
         # device copies so steady-state decode transfers nothing but tokens
         self._sampling_cache: Optional[tuple] = None
 
-        self._prefill_fns: dict[tuple[int, int], Any] = {}
+        self._prefill_fns: dict[tuple, Any] = {}  # (n_pad, t_pad, guided)
 
     def _init_shardings(self, mesh: Any, *, quantized: bool = False) -> None:
         """Validate the mesh against the model and build the sharding table."""
@@ -383,7 +399,8 @@ class BatchedGenerator:
     # ------------------------------------------------------------------
 
     def _decode_step(self, params, cache, tokens, offsets, rng, temp, top_p, active,
-                     lora=None, lora_idx=None):
+                     lora=None, lora_idx=None,
+                     gtables=None, gaut=None, gstate=None):
         """[B,1] tokens at per-slot offsets -> next token per slot."""
         jnp = self._jnp
         positions = offsets[:, None]
@@ -391,16 +408,27 @@ class BatchedGenerator:
             params, self.config, tokens, positions, cache=cache, cache_offset=offsets,
             lora=lora, lora_alpha=self.lora_alpha, lora_indices=lora_idx,
         )
-        next_tokens, rng = self._sample(logits[:, -1, :], rng, temp, top_p)
+        last = logits[:, -1, :]
+        if gtables is not None:
+            row = gtables[gaut, gstate]
+            last = jnp.where(row >= 0, last, -jnp.inf)
+        next_tokens, rng = self._sample(last, rng, temp, top_p)
         # inactive slots keep decoding garbage into their own slot space;
         # offsets only advance for active ones so their state is untouched
         offsets = jnp.where(active, offsets + 1, offsets)
-        return cache, next_tokens, offsets, rng
+        if gtables is None:
+            return cache, next_tokens, offsets, rng
+        stepped = jnp.take_along_axis(row, next_tokens[:, None], axis=1)[:, 0]
+        gstate = jnp.where(active & (stepped >= 0), stepped, gstate)
+        return cache, next_tokens, offsets, rng, gstate
 
     def _decode_step_paged(self, params, paged, tokens, rng, temp, top_p, active,
-                           lora=None, lora_idx=None):
+                           lora=None, lora_idx=None,
+                           gtables=None, gaut=None, gstate=None):
         """Paged twin of :meth:`_decode_step` (released slots write to the
-        trash page via their zeroed page-table row; their lengths stay put)."""
+        trash page via their zeroed page-table row; their lengths stay put).
+        With guided args, the sampler is masked by the automaton row and the
+        per-slot DFA state advances — returned as an extra carry."""
         from ..models.llama import decode_step_paged
         from ..ops.paged_attention import PagedKVCache
 
@@ -409,13 +437,20 @@ class BatchedGenerator:
             params, self.config, tokens, paged,
             lora=lora, lora_alpha=self.lora_alpha, lora_indices=lora_idx,
         )
+        if gtables is not None:
+            row = gtables[gaut, gstate]  # [B, vocab] allowed-transition rows
+            logits = jnp.where(row >= 0, logits, -jnp.inf)
         next_tokens, rng = self._sample(logits, rng, temp, top_p)
         lengths = jnp.where(active, new_paged.lengths, paged.lengths)
         new_paged = PagedKVCache(
             k_pages=new_paged.k_pages, v_pages=new_paged.v_pages,
             page_table=new_paged.page_table, lengths=lengths,
         )
-        return new_paged, next_tokens, rng
+        if gtables is None:
+            return new_paged, next_tokens, rng
+        stepped = jnp.take_along_axis(row, next_tokens[:, None], axis=1)[:, 0]
+        gstate = jnp.where(active & (stepped >= 0), stepped, gstate)
+        return new_paged, next_tokens, rng, gstate
 
     #: unroll the K-step decode block into straight-line XLA instead of a
     #: lax.scan: a scan CARRIES the whole KV cache/page pool, and XLA's
@@ -483,6 +518,155 @@ class BatchedGenerator:
         )
         return paged, toks, last, rng
 
+    def _decode_block_guided(self, params, cache, tokens, offsets, rng, temp,
+                             top_p, active, lora, lora_idx,
+                             gtables, gaut, gstate):
+        """Guided twin of :meth:`_decode_block`: the DFA state joins the
+        scan carry, so masking and stepping never leave the device."""
+        jax, jnp = self._jax, self._jnp
+
+        if self.DECODE_UNROLL:
+            toks = []
+            for _ in range(self.decode_block):
+                cache, next_tokens, offsets, rng, gstate = self._decode_step(
+                    params, cache, tokens, offsets, rng, temp, top_p, active,
+                    lora, lora_idx, gtables, gaut, gstate,
+                )
+                tokens = next_tokens[:, None]
+                toks.append(next_tokens)
+            return cache, jnp.stack(toks), tokens, offsets, rng, gstate
+
+        def body(carry, _):
+            cache, tokens, offsets, rng, gstate = carry
+            cache, next_tokens, offsets, rng, gstate = self._decode_step(
+                params, cache, tokens, offsets, rng, temp, top_p, active,
+                lora, lora_idx, gtables, gaut, gstate,
+            )
+            return (cache, next_tokens[:, None], offsets, rng, gstate), next_tokens
+
+        (cache, last, offsets, rng, gstate), toks = jax.lax.scan(
+            body, (cache, tokens, offsets, rng, gstate), None,
+            length=self.decode_block,
+        )
+        return cache, toks, last, offsets, rng, gstate
+
+    def _decode_block_paged_guided(self, params, paged, tokens, rng, temp,
+                                   top_p, active, lora, lora_idx,
+                                   gtables, gaut, gstate):
+        jax, jnp = self._jax, self._jnp
+
+        if self.DECODE_UNROLL:
+            toks = []
+            for _ in range(self.decode_block):
+                paged, next_tokens, rng, gstate = self._decode_step_paged(
+                    params, paged, tokens, rng, temp, top_p, active,
+                    lora, lora_idx, gtables, gaut, gstate,
+                )
+                tokens = next_tokens[:, None]
+                toks.append(next_tokens)
+            return paged, jnp.stack(toks), tokens, rng, gstate
+
+        def body(carry, _):
+            paged, tokens, rng, gstate = carry
+            paged, next_tokens, rng, gstate = self._decode_step_paged(
+                params, paged, tokens, rng, temp, top_p, active,
+                lora, lora_idx, gtables, gaut, gstate,
+            )
+            return (paged, next_tokens[:, None], rng, gstate), next_tokens
+
+        (paged, last, rng, gstate), toks = jax.lax.scan(
+            body, (paged, tokens, rng, gstate), None, length=self.decode_block
+        )
+        return paged, toks, last, rng, gstate
+
+    def _get_guided_decode_fn(self):
+        if self._decode_fn_guided is None:
+            body = (
+                self._decode_block_paged_guided if self.paged
+                else self._decode_block_guided
+            )
+            self._decode_fn_guided = self._jax.jit(body, donate_argnums=(1,))
+        return self._decode_fn_guided
+
+    # ------------------------------------------------------------------
+    # guided decoding registry (serving/guided.py)
+    # ------------------------------------------------------------------
+
+    #: trie-state cap: bounds the [A_pad, S_pad, vocab] table (int32) the
+    #: guided programs carry; matches _refresh_guided_tables' s_pad clamp so
+    #: an oversized request is rejected at SUBMIT time, never at admission
+    MAX_GUIDED_STATES = 1 << 14
+
+    def validate_guided(self, choices: tuple) -> None:
+        """Build (and cache) the automaton for ``choices``; raises
+        ValueError on anything v1 cannot serve — called at SUBMIT time so a
+        bad request can never fail a co-batched wave."""
+        from .guided import build_choice_automaton
+
+        if self.mesh is not None:
+            raise ValueError("guided decoding is not supported on a serving mesh yet")
+        if self.prefill_chunk is not None:
+            raise ValueError(
+                "guided decoding is not supported with chunked prefill yet"
+            )
+        key = tuple(choices)
+        if key not in self._guided_cache:
+            automaton = build_choice_automaton(
+                key, self.tokenizer, self.config.vocab_size
+            )
+            if automaton.num_states > self.MAX_GUIDED_STATES:
+                raise ValueError(
+                    f"guided_choice automaton needs {automaton.num_states} "
+                    f"states, above the {self.MAX_GUIDED_STATES} cap — use "
+                    f"fewer/shorter choices"
+                )
+            self._guided_cache[key] = automaton
+
+    def _refresh_guided_tables(self, wave_specs: "list[tuple | None]") -> None:
+        """(Re)stack the automata needed by active + newly admitted guided
+        slots; None when no guided slot remains (fast unguided path)."""
+        from .guided import identity_automaton, stack_automata
+
+        jnp = self._jnp
+        specs = {
+            slot.params.guided_choice
+            for slot in self.slots
+            if slot.active and slot.params.guided_choice
+        }
+        specs.update(spec for spec in wave_specs if spec)
+        if not specs:
+            self._guided_tables = None
+            self._guided_index = {}
+            self.guided_aut = None
+            self.guided_state = None
+            return
+        for spec in specs:
+            self.validate_guided(spec)  # ensures the automaton is cached
+        ordered = sorted(specs)
+        new_index = {spec: i + 1 for i, spec in enumerate(ordered)}
+        if self._guided_tables is not None and new_index == self._guided_index:
+            return  # byte-identical stack: skip the rebuild + upload
+        automata = [identity_automaton(self.config.vocab_size)]
+        automata += [self._guided_cache[spec] for spec in ordered]
+        self._guided_index = new_index
+        a_pad = _bucket(len(automata), 2, 64)
+        s_pad = _bucket(
+            max(a.num_states for a in automata), 8, self.MAX_GUIDED_STATES
+        )
+        while len(automata) < a_pad:
+            automata.append(identity_automaton(self.config.vocab_size))
+        stacked = stack_automata(automata, self.config.vocab_size, state_pad=s_pad)
+        self._guided_tables = jnp.asarray(stacked)
+        # remap every ACTIVE slot's automaton id under the new ordering
+        for i, slot in enumerate(self.slots):
+            if slot.active and slot.params.guided_choice:
+                self._guided_aut_np[i] = self._guided_index[slot.params.guided_choice]
+            elif i not in self._reserved:
+                self._guided_aut_np[i] = 0
+        self.guided_aut = jnp.asarray(self._guided_aut_np)
+        if self.guided_state is None:
+            self.guided_state = jnp.zeros((self.max_slots,), jnp.int32)
+
     #: nucleus-sampling candidate-set size (constructor: ``sample_top_k``).
     #: A full-vocab ``top_k`` is a 32k-128k element sort on the TPU vector
     #: units EVERY decode step, so sampling is truncated to the top-k
@@ -536,14 +720,14 @@ class BatchedGenerator:
         chunked-attention budget is per-device (models/llama.py)."""
         return self._dp_total if self.mesh is not None else 1
 
-    def _make_prefill(self, n_pad: int, t_pad: int):
+    def _make_prefill(self, n_pad: int, t_pad: int, guided: bool = False):
         """Compile a prefill program for the (n_pad, t_pad) bucket."""
         jax, jnp = self._jax, self._jnp
         config = self.config
         score_shards = self._prefill_score_shards()
 
         def prefill_fn(params, cache, token_ids, lengths, slot_ids, rng, temp, top_p,
-                       lora=None, lora_idx=None):
+                       lora=None, lora_idx=None, gtables=None, gaut=None):
             # fresh contiguous mini-cache for the prompt tokens
             mini = KVCache.create(config, n_pad, t_pad, dtype=cache.k.dtype)
             positions = jnp.broadcast_to(
@@ -565,7 +749,15 @@ class BatchedGenerator:
             last = jnp.take_along_axis(
                 logits, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
             )[:, 0, :]
+            if guided:
+                row = gtables[gaut, jnp.zeros_like(gaut)]  # DFA start state
+                last = jnp.where(row >= 0, last, -jnp.inf)
             first_tokens, rng = self._sample(last, rng, temp, top_p)
+            if guided:
+                first_state = jnp.take_along_axis(
+                    row, first_tokens[:, None], axis=1
+                )[:, 0]
+                return KVCache(k=k, v=v), first_tokens, rng, jnp.maximum(first_state, 0)
             return KVCache(k=k, v=v), first_tokens, rng
 
         if self.mesh is None:
@@ -581,7 +773,7 @@ class BatchedGenerator:
             out_shardings=(s["cache"], vec, s["repl"]),
         )
 
-    def _make_prefill_paged(self, n_pad: int, t_pad: int):
+    def _make_prefill_paged(self, n_pad: int, t_pad: int, guided: bool = False):
         """Prefill for the paged cache: same mini-cache forward, then the
         prompt KV scatters into each sequence's pages (write_tokens with
         valid_len so padded rows land in the trash page)."""
@@ -590,7 +782,7 @@ class BatchedGenerator:
         score_shards = self._prefill_score_shards()
 
         def prefill_fn(params, paged, token_ids, lengths, row_tables, rng, temp, top_p,
-                       lora=None, lora_idx=None):
+                       lora=None, lora_idx=None, gtables=None, gaut=None):
             from ..ops.paged_attention import PagedKVCache, write_tokens
 
             mini = KVCache.create(config, n_pad, t_pad, dtype=paged.k_pages.dtype)
@@ -611,11 +803,19 @@ class BatchedGenerator:
             last = jnp.take_along_axis(
                 logits, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
             )[:, 0, :]
+            if guided:
+                row = gtables[gaut, jnp.zeros_like(gaut)]  # DFA start state
+                last = jnp.where(row >= 0, last, -jnp.inf)
             first_tokens, rng = self._sample(last, rng, temp, top_p)
             new_paged = PagedKVCache(
                 k_pages=k_pages, v_pages=v_pages,
                 page_table=paged.page_table, lengths=paged.lengths,
             )
+            if guided:
+                first_state = jnp.take_along_axis(
+                    row, first_tokens[:, None], axis=1
+                )[:, 0]
+                return new_paged, first_tokens, rng, jnp.maximum(first_state, 0)
             return new_paged, first_tokens, rng
 
         if self.mesh is None:
@@ -698,6 +898,11 @@ class BatchedGenerator:
         self._inflight_blocks.clear()
         self._prefill_job = None
         self._reserved.clear()
+        self._guided_tables = None
+        self._guided_index = {}
+        self._guided_aut_np[:] = 0
+        self.guided_aut = None
+        self.guided_state = None
         if self.paged:
             self.allocator = PageAllocator(self.allocator.num_pages)
         self._alloc_decode_state()
@@ -831,6 +1036,25 @@ class BatchedGenerator:
             slot_ids[row] = slot_ids[0]
             adapter_idx[row] = adapter_idx[0]
 
+        # guided decoding: stack the automata this wave + active slots need
+        wave_specs = [p.guided_choice for p in params_list]
+        if any(wave_specs) and (
+            self.prefill_chunk is not None or self.mesh is not None
+        ):
+            raise ValueError(
+                "guided decoding is not supported with chunked prefill or "
+                "a serving mesh yet"
+            )
+        if any(wave_specs) or self._guided_tables is not None:
+            self._refresh_guided_tables(wave_specs)
+        guided = self._guided_tables is not None
+        row_aut = np.zeros((n_pad,), np.int32)
+        if guided:
+            for row, p in enumerate(params_list):
+                row_aut[row] = self._guided_index.get(p.guided_choice, 0)
+            for row in range(n, n_pad):
+                row_aut[row] = row_aut[0]
+
         key = (n_pad, t_pad)
         if (
             self.prefill_chunk is not None
@@ -841,35 +1065,55 @@ class BatchedGenerator:
                 key, ids, lengths, temp, top_p, slot_ids, adapter_idx,
                 token_lists, params_list, page_grants, taken,
             )
+        key = (n_pad, t_pad, guided)
         if key not in self._prefill_fns:
-            log.info("compiling prefill bucket n=%d t=%d (paged=%s)", n_pad, t_pad, self.paged)
+            log.info("compiling prefill bucket n=%d t=%d (paged=%s guided=%s)",
+                     n_pad, t_pad, self.paged, guided)
             self._prefill_fns[key] = (
-                self._make_prefill_paged(n_pad, t_pad)
+                self._make_prefill_paged(n_pad, t_pad, guided)
                 if self.paged
-                else self._make_prefill(n_pad, t_pad)
+                else self._make_prefill(n_pad, t_pad, guided)
             )
 
         if self.paged:
             staged, row_tables = self._stage_page_tables(
                 n, n_pad, slot_ids, page_grants, lengths
             )
-            self.paged_cache, first_tokens, self._rng = self._prefill_fns[key](
+            outs = self._prefill_fns[key](
                 self.params, staged, jnp.asarray(ids), jnp.asarray(lengths),
                 jnp.asarray(row_tables), self._rng, jnp.asarray(temp),
                 jnp.asarray(top_p), self.lora,
                 jnp.asarray(adapter_idx) if self.lora is not None else None,
+                *((self._guided_tables, jnp.asarray(row_aut)) if guided else ()),
             )
+            if guided:
+                self.paged_cache, first_tokens, self._rng, first_state = outs
+            else:
+                self.paged_cache, first_tokens, self._rng = outs
         else:
-            self.cache, first_tokens, self._rng = self._prefill_fns[key](
+            outs = self._prefill_fns[key](
                 self.params, self.cache, jnp.asarray(ids), jnp.asarray(lengths),
                 jnp.asarray(slot_ids), self._rng, jnp.asarray(temp), jnp.asarray(top_p),
                 self.lora,
                 jnp.asarray(adapter_idx) if self.lora is not None else None,
+                *((self._guided_tables, jnp.asarray(row_aut)) if guided else ()),
             )
-        return self._activate_slots(
+            if guided:
+                self.cache, first_tokens, self._rng, first_state = outs
+            else:
+                self.cache, first_tokens, self._rng = outs
+        result = self._activate_slots(
             np.asarray(first_tokens), lengths, taken, params_list,
             page_grants, (time.perf_counter() - started) * 1e3,
         )
+        if guided:
+            for row, slot_id in enumerate(taken):
+                self._guided_aut_np[slot_id] = row_aut[row]
+            self.guided_aut = jnp.asarray(self._guided_aut_np)
+            self.guided_state = self.guided_state.at[
+                jnp.asarray(np.asarray(taken, np.int32))
+            ].set(first_state[: len(taken)])
+        return result
 
     def _activate_slots(
         self, first_np, lengths, taken, params_list, page_grants, prefill_ms
@@ -1185,17 +1429,33 @@ class BatchedGenerator:
         """Launch one decode block; tokens stay on device until processed."""
         block = self.decode_block
         active, temp_dev, top_p_dev, active_dev, idx_dev = self._sampling_tensors()
-        if self.paged:
+        lora_idx = idx_dev if self.lora is not None else None
+        if self._guided_tables is not None:
+            fn = self._get_guided_decode_fn()
+            if self.paged:
+                (self.paged_cache, toks, last, self._rng,
+                 self.guided_state) = fn(
+                    self.params, self.paged_cache, self.last_tokens, self._rng,
+                    temp_dev, top_p_dev, active_dev, self.lora, lora_idx,
+                    self._guided_tables, self.guided_aut, self.guided_state,
+                )
+            else:
+                (self.cache, toks, last, self.offsets, self._rng,
+                 self.guided_state) = fn(
+                    self.params, self.cache, self.last_tokens, self.offsets,
+                    self._rng, temp_dev, top_p_dev, active_dev, self.lora,
+                    lora_idx, self._guided_tables, self.guided_aut,
+                    self.guided_state,
+                )
+        elif self.paged:
             self.paged_cache, toks, last, self._rng = self._decode_fn(
                 self.params, self.paged_cache, self.last_tokens, self._rng,
-                temp_dev, top_p_dev, active_dev, self.lora,
-                idx_dev if self.lora is not None else None,
+                temp_dev, top_p_dev, active_dev, self.lora, lora_idx,
             )
         else:
             self.cache, toks, last, self.offsets, self._rng = self._decode_fn(
                 self.params, self.cache, self.last_tokens, self.offsets, self._rng,
-                temp_dev, top_p_dev, active_dev, self.lora,
-                idx_dev if self.lora is not None else None,
+                temp_dev, top_p_dev, active_dev, self.lora, lora_idx,
             )
         self.last_tokens = last
         # snapshot which generation of each slot this block belongs to and
@@ -1276,6 +1536,19 @@ class BatchedGenerator:
         self._slot_epoch[slot_id] += 1  # stale in-flight tokens now orphaned
         self._host_offsets[slot_id] = 0
         self._sampling_cache = None  # slot set changed
+        if self._guided_tables is not None:
+            if self._guided_aut_np[slot_id]:
+                self._guided_aut_np[slot_id] = 0
+                self.guided_aut = self._jnp.asarray(self._guided_aut_np)
+            if not self._guided_aut_np.any() and not any(
+                s.active and s.params.guided_choice
+                for i, s in enumerate(self.slots)
+                if i != slot_id  # this slot is finishing right now
+            ):
+                self._guided_tables = None  # back to the unguided programs
+                self._guided_index = {}
+                self.guided_aut = None
+                self.guided_state = None
         eos = self.tokenizer.eos_id
         ids = [t for t in slot.generated if t != eos]
         text = self.tokenizer.decode(ids)
@@ -1507,6 +1780,10 @@ class ServingEngine:
                 f"unknown LoRA adapter {adapter!r}; registered: "
                 f"{getattr(self.generator, 'adapter_names', [])}"
             )
+        if params is not None and params.guided_choice is not None:
+            # builds+caches the automaton; raises ValueError here (to THIS
+            # caller) on bad choices or unsupported engine configs
+            self.generator.validate_guided(tuple(params.guided_choice))
         if self._task is None:
             await self.start()
         future: asyncio.Future = asyncio.get_running_loop().create_future()

@@ -344,9 +344,28 @@ class CompletionServer:
             stop = [stop]
         if not isinstance(stop, list) or not all(isinstance(s, str) for s in stop):
             raise ApiError(400, "stop must be a string or list of strings")
+        guided = req.get("guided_choice")
+        if guided is not None:
+            if (
+                not isinstance(guided, list)
+                or not guided
+                or not all(isinstance(c, str) and c for c in guided)
+                or len(guided) > 256
+            ):
+                raise ApiError(
+                    400, "guided_choice must be a non-empty list of <=256 strings"
+                )
+            try:
+                # surfaces bad choice sets (oversized automata, unservable
+                # configs) as a 400 HERE — engine-internal ValueErrors later
+                # must stay 5xx, so no blanket mapping at the gather
+                self.engine.generator.validate_guided(tuple(guided))
+            except ValueError as exc:
+                raise ApiError(400, str(exc)) from None
         params = SamplingParams(
             max_tokens=max_tokens, temperature=float(temperature),
             top_p=float(top_p), adapter=self._resolve_adapter(req),
+            guided_choice=tuple(guided) if guided is not None else None,
         )
         return params, stop
 
