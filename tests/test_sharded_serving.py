@@ -95,7 +95,7 @@ def test_partial_bucket_pads_to_dp(params, reference_tokens, paged):
     generator = make_generator(params, mesh=mesh, paged=paged)
     [out] = generate_all(generator, PROMPTS[:1])  # n=1 -> n_pad=4
     assert out == reference_tokens[paged][0]
-    assert all(n % 4 == 0 for n, _ in generator._prefill_fns)
+    assert all(n % 4 == 0 for n, *_ in generator._prefill_fns)
 
 
 def test_continuous_batching_across_waves_sharded(params, reference_tokens):
@@ -137,7 +137,7 @@ def test_dp_aware_admission_no_replicated_prefill(params):
     assert len(out) == 3 and all(len(t) == 12 for t in out)
     # every compiled prefill bucket divides dp*fsdp (4)
     assert generator._prefill_fns, "no prefill compiled?"
-    for (n_pad, _t_pad) in generator._prefill_fns:
+    for (n_pad, *_rest) in generator._prefill_fns:
         assert n_pad % 4 == 0, f"bucket n_pad={n_pad} not dp-divisible"
     # and the bucket's sharding is the sharded (non-replicated) one
     rows, vec = generator._prefill_shardings(4)
