@@ -579,13 +579,61 @@ class BatchedGenerator:
         )
         return paged, toks, last, rng, gstate
 
+    def _put_batch_vec(self, array):
+        """Place a per-slot [B] vector: batch sharding under a mesh (one
+        host->mesh transfer), plain device array otherwise.  The one
+        placement helper for guided aut/state AND the sampling tensors."""
+        if self.mesh is not None:
+            return self._jax.device_put(array, self._shardings["batch"])
+        return self._jnp.asarray(array)
+
     def _get_guided_decode_fn(self):
         if self._decode_fn_guided is None:
+            jax = self._jax
             body = (
                 self._decode_block_paged_guided if self.paged
                 else self._decode_block_guided
             )
-            self._decode_fn_guided = self._jax.jit(body, donate_argnums=(1,))
+            if self.mesh is None:
+                self._decode_fn_guided = jax.jit(body, donate_argnums=(1,))
+            else:
+                # mirrors the unguided mesh programs: automaton tables
+                # replicate (tens of MB, read-only), per-slot aut/state
+                # shard over the data axes with the other [B] vectors
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                s = self._shardings
+                block_tokens = NamedSharding(self.mesh, P(None, ("dp", "fsdp")))
+                if self.paged:
+                    self._decode_fn_guided = jax.jit(
+                        body,
+                        in_shardings=(
+                            self._param_shardings, s["paged"], s["tokens"],
+                            s["repl"], s["batch"], s["batch"], s["batch"],
+                            s["repl"], s["batch"],  # lora stack, idx
+                            s["repl"], s["batch"], s["batch"],  # tables, aut, state
+                        ),
+                        out_shardings=(
+                            s["paged"], block_tokens, s["tokens"], s["repl"],
+                            s["batch"],
+                        ),
+                        donate_argnums=(1,),
+                    )
+                else:
+                    self._decode_fn_guided = jax.jit(
+                        body,
+                        in_shardings=(
+                            self._param_shardings, s["cache"], s["tokens"],
+                            s["batch"], s["repl"], s["batch"], s["batch"],
+                            s["batch"], s["repl"], s["batch"],
+                            s["repl"], s["batch"], s["batch"],
+                        ),
+                        out_shardings=(
+                            s["cache"], block_tokens, s["tokens"], s["batch"],
+                            s["repl"], s["batch"],
+                        ),
+                        donate_argnums=(1,),
+                    )
         return self._decode_fn_guided
 
     # ------------------------------------------------------------------
@@ -603,8 +651,6 @@ class BatchedGenerator:
         bad request can never fail a co-batched wave."""
         from .guided import build_choice_automaton
 
-        if self.mesh is not None:
-            raise ValueError("guided decoding is not supported on a serving mesh yet")
         if self.prefill_chunk is not None:
             raise ValueError(
                 "guided decoding is not supported with chunked prefill yet"
@@ -656,16 +702,25 @@ class BatchedGenerator:
         while len(automata) < a_pad:
             automata.append(identity_automaton(self.config.vocab_size))
         stacked = stack_automata(automata, self.config.vocab_size, state_pad=s_pad)
-        self._guided_tables = jnp.asarray(stacked)
+        if self.mesh is not None:
+            # commit the replication ONCE: an uncommitted table would be
+            # re-broadcast across the mesh on every decode-block dispatch
+            self._guided_tables = self._jax.device_put(
+                stacked, self._shardings["repl"]
+            )
+        else:
+            self._guided_tables = jnp.asarray(stacked)
         # remap every ACTIVE slot's automaton id under the new ordering
         for i, slot in enumerate(self.slots):
             if slot.active and slot.params.guided_choice:
                 self._guided_aut_np[i] = self._guided_index[slot.params.guided_choice]
             elif i not in self._reserved:
                 self._guided_aut_np[i] = 0
-        self.guided_aut = jnp.asarray(self._guided_aut_np)
+        self.guided_aut = self._put_batch_vec(self._guided_aut_np)
         if self.guided_state is None:
-            self.guided_state = jnp.zeros((self.max_slots,), jnp.int32)
+            self.guided_state = self._put_batch_vec(
+                np.zeros((self.max_slots,), np.int32)
+            )
 
     #: nucleus-sampling candidate-set size (constructor: ``sample_top_k``).
     #: A full-vocab ``top_k`` is a 32k-128k element sort on the TPU vector
@@ -764,13 +819,16 @@ class BatchedGenerator:
             return jax.jit(prefill_fn)
         s = self._shardings
         rows, vec = self._prefill_shardings(n_pad)
+        in_shardings = (
+            self._param_shardings, s["cache"], rows, vec, vec,
+            s["repl"], vec, vec, s["repl"], vec,
+        )
+        out_shardings = (s["cache"], vec, s["repl"])
+        if guided:
+            in_shardings += (s["repl"], vec)   # tables, row automaton ids
+            out_shardings += (vec,)            # first DFA state per row
         return jax.jit(
-            prefill_fn,
-            in_shardings=(
-                self._param_shardings, s["cache"], rows, vec, vec,
-                s["repl"], vec, vec, s["repl"], vec,
-            ),
-            out_shardings=(s["cache"], vec, s["repl"]),
+            prefill_fn, in_shardings=in_shardings, out_shardings=out_shardings
         )
 
     def _make_prefill_paged(self, n_pad: int, t_pad: int, guided: bool = False):
@@ -822,13 +880,16 @@ class BatchedGenerator:
             return jax.jit(prefill_fn)
         s = self._shardings
         rows, vec = self._prefill_shardings(n_pad)
+        in_shardings = (
+            self._param_shardings, s["paged"], rows, vec, rows,
+            s["repl"], vec, vec, s["repl"], vec,
+        )
+        out_shardings = (s["paged"], vec, s["repl"])
+        if guided:
+            in_shardings += (s["repl"], vec)
+            out_shardings += (vec,)
         return jax.jit(
-            prefill_fn,
-            in_shardings=(
-                self._param_shardings, s["paged"], rows, vec, rows,
-                s["repl"], vec, vec, s["repl"], vec,
-            ),
-            out_shardings=(s["paged"], vec, s["repl"]),
+            prefill_fn, in_shardings=in_shardings, out_shardings=out_shardings
         )
 
     # ------------------------------------------------------------------
@@ -1038,12 +1099,9 @@ class BatchedGenerator:
 
         # guided decoding: stack the automata this wave + active slots need
         wave_specs = [p.guided_choice for p in params_list]
-        if any(wave_specs) and (
-            self.prefill_chunk is not None or self.mesh is not None
-        ):
+        if any(wave_specs) and self.prefill_chunk is not None:
             raise ValueError(
-                "guided decoding is not supported with chunked prefill or "
-                "a serving mesh yet"
+                "guided decoding is not supported with chunked prefill yet"
             )
         if any(wave_specs) or self._guided_tables is not None:
             self._refresh_guided_tables(wave_specs)
@@ -1109,10 +1167,12 @@ class BatchedGenerator:
         if guided:
             for row, slot_id in enumerate(taken):
                 self._guided_aut_np[slot_id] = row_aut[row]
-            self.guided_aut = jnp.asarray(self._guided_aut_np)
-            self.guided_state = self.guided_state.at[
-                jnp.asarray(np.asarray(taken, np.int32))
-            ].set(first_state[: len(taken)])
+            self.guided_aut = self._put_batch_vec(self._guided_aut_np)
+            self.guided_state = self._put_batch_vec(
+                self.guided_state.at[
+                    jnp.asarray(np.asarray(taken, np.int32))
+                ].set(first_state[: len(taken)])
+            )
         return result
 
     def _activate_slots(
@@ -1375,10 +1435,7 @@ class BatchedGenerator:
                  for s in self.slots],
                 np.int32,
             )
-            if self.mesh is not None:
-                put = lambda a: self._jax.device_put(a, self._shardings["batch"])  # noqa: E731
-            else:
-                put = jnp.asarray
+            put = self._put_batch_vec
             self._sampling_cache = (
                 active, put(temp), put(top_p), put(active), put(adapter_idx)
             )
@@ -1539,7 +1596,7 @@ class BatchedGenerator:
         if self._guided_tables is not None:
             if self._guided_aut_np[slot_id]:
                 self._guided_aut_np[slot_id] = 0
-                self.guided_aut = self._jnp.asarray(self._guided_aut_np)
+                self.guided_aut = self._put_batch_vec(self._guided_aut_np)
             if not self._guided_aut_np.any() and not any(
                 s.active and s.params.guided_choice
                 for i, s in enumerate(self.slots)

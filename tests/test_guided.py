@@ -154,15 +154,40 @@ def test_unsupported_configs_rejected(params):
     chunked = _generator(params, prefill_chunk=16)
     with pytest.raises(ValueError, match="chunked"):
         chunked.validate_guided(("a",))
+
+
+def test_guided_on_mesh(params):
+    """Guided + sharded serving: tables replicate, aut/state shard with the
+    batch; outputs constrained AND an unconstrained neighbour matches its
+    single-device greedy tokens."""
     from operator_tpu.parallel import MeshPlan, make_mesh
 
+    free_sampling = SamplingParams(max_tokens=8, temperature=0.0,
+                                   stop_on_eos=False)
+    solo = _generator(params).generate("free prompt", free_sampling)
+
     mesh = make_mesh(MeshPlan(dp=2, fsdp=2, tp=2), jax.devices("cpu"))
-    meshed = BatchedGenerator(
+    generator = BatchedGenerator(
         params, TINY_TEST, ByteTokenizer(), max_slots=4, max_seq=128,
         cache_dtype=jnp.float32, paged=True, page_size=16, mesh=mesh,
+        decode_block=2,
     )
-    with pytest.raises(ValueError, match="mesh"):
-        meshed.validate_guided(("a",))
+    slots = generator.admit(
+        ["free prompt", "severity?", "pick", "choose"],
+        [free_sampling,
+         SamplingParams(max_tokens=16, temperature=0.9, guided_choice=CHOICES),
+         SamplingParams(max_tokens=16, temperature=1.2,
+                        guided_choice=("yes", "no")),
+         SamplingParams(max_tokens=16, temperature=0.0, guided_choice=CHOICES)],
+    )
+    results = {}
+    while generator.num_active:
+        for slot_id, result in generator.step():
+            results[slot_id] = result
+    assert results[slots[0]].token_ids == solo.token_ids
+    assert results[slots[1]].text in CHOICES
+    assert results[slots[2]].text in ("yes", "no")
+    assert results[slots[3]].text in CHOICES
 
 
 def test_api_guided_choice(params):
