@@ -59,6 +59,10 @@ class SamplingParams:
     #: a token-trie automaton rides the decode scan as device state and
     #: masks the sampler every step.  None = unconstrained.
     guided_choice: Optional[tuple] = None
+    #: constrain the output to match this regex (serving/regex_dfa.py:
+    #: byte-level DFA, token closure, same device-state machinery).
+    #: Mutually exclusive with guided_choice.
+    guided_regex: Optional[str] = None
 
 
 @dataclass
@@ -640,33 +644,63 @@ class BatchedGenerator:
     # guided decoding registry (serving/guided.py)
     # ------------------------------------------------------------------
 
-    #: trie-state cap: bounds the [A_pad, S_pad, vocab] table (int32) the
-    #: guided programs carry; matches _refresh_guided_tables' s_pad clamp so
-    #: an oversized request is rejected at SUBMIT time, never at admission
+    #: automaton-state cap: bounds the [A_pad, S_pad, vocab] table (int32)
+    #: the guided programs carry; matches _refresh_guided_tables' s_pad
+    #: clamp so an oversized request is rejected at SUBMIT time, never at
+    #: admission
     MAX_GUIDED_STATES = 1 << 14
 
-    def validate_guided(self, choices: tuple) -> None:
-        """Build (and cache) the automaton for ``choices``; raises
-        ValueError on anything v1 cannot serve — called at SUBMIT time so a
-        bad request can never fail a co-batched wave."""
-        from .guided import build_choice_automaton
+    @staticmethod
+    def _guided_spec(params: "SamplingParams | None") -> Optional[tuple]:
+        """The hashable automaton key for a request: ("choice", names) or
+        ("regex", pattern); None = unconstrained."""
+        if params is None:
+            return None
+        if params.guided_choice is not None:
+            return ("choice", tuple(params.guided_choice))
+        if params.guided_regex is not None:
+            return ("regex", str(params.guided_regex))
+        return None
 
+    def validate_guided(self, choices: tuple) -> None:
+        self._ensure_automaton(("choice", tuple(choices)))
+
+    def validate_guided_regex(self, pattern: str) -> None:
+        self._ensure_automaton(("regex", str(pattern)))
+
+    def _ensure_automaton(self, spec: tuple) -> None:
+        """Build (and cache) the automaton for a guided spec; raises
+        ValueError on anything unservable — called at SUBMIT time so a bad
+        request can never fail a co-batched wave."""
         if self.prefill_chunk is not None:
             raise ValueError(
                 "guided decoding is not supported with chunked prefill yet"
             )
-        key = tuple(choices)
-        if key not in self._guided_cache:
+        if spec in self._guided_cache:
+            return
+        kind, payload = spec
+        if kind == "choice":
+            from .guided import build_choice_automaton
+
             automaton = build_choice_automaton(
-                key, self.tokenizer, self.config.vocab_size
+                payload, self.tokenizer, self.config.vocab_size
             )
-            if automaton.num_states > self.MAX_GUIDED_STATES:
-                raise ValueError(
-                    f"guided_choice automaton needs {automaton.num_states} "
-                    f"states, above the {self.MAX_GUIDED_STATES} cap — use "
-                    f"fewer/shorter choices"
-                )
-            self._guided_cache[key] = automaton
+        else:
+            from .regex_dfa import compile_regex_automaton
+
+            automaton = compile_regex_automaton(
+                payload, self.tokenizer, self.config.vocab_size,
+                max_states=self.MAX_GUIDED_STATES,
+            )
+        if automaton.num_states > self.MAX_GUIDED_STATES:
+            raise ValueError(
+                f"guided automaton needs {automaton.num_states} states, "
+                f"above the {self.MAX_GUIDED_STATES} cap — simplify the "
+                f"choices/pattern"
+            )
+        while len(self._guided_cache) >= 32:  # bound host memory: LRU-ish
+            self._guided_cache.pop(next(iter(self._guided_cache)))
+        self._guided_cache[spec] = automaton
 
     def _refresh_guided_tables(self, wave_specs: "list[tuple | None]") -> None:
         """(Re)stack the automata needed by active + newly admitted guided
@@ -675,9 +709,9 @@ class BatchedGenerator:
 
         jnp = self._jnp
         specs = {
-            slot.params.guided_choice
+            self._guided_spec(slot.params)
             for slot in self.slots
-            if slot.active and slot.params.guided_choice
+            if slot.active and self._guided_spec(slot.params)
         }
         specs.update(spec for spec in wave_specs if spec)
         if not specs:
@@ -687,7 +721,7 @@ class BatchedGenerator:
             self.guided_state = None
             return
         for spec in specs:
-            self.validate_guided(spec)  # ensures the automaton is cached
+            self._ensure_automaton(spec)
         ordered = sorted(specs)
         new_index = {spec: i + 1 for i, spec in enumerate(ordered)}
         if self._guided_tables is not None and new_index == self._guided_index:
@@ -712,8 +746,9 @@ class BatchedGenerator:
             self._guided_tables = jnp.asarray(stacked)
         # remap every ACTIVE slot's automaton id under the new ordering
         for i, slot in enumerate(self.slots):
-            if slot.active and slot.params.guided_choice:
-                self._guided_aut_np[i] = self._guided_index[slot.params.guided_choice]
+            spec = self._guided_spec(slot.params) if slot.active else None
+            if spec:
+                self._guided_aut_np[i] = self._guided_index[spec]
             elif i not in self._reserved:
                 self._guided_aut_np[i] = 0
         self.guided_aut = self._put_batch_vec(self._guided_aut_np)
@@ -1098,7 +1133,7 @@ class BatchedGenerator:
             adapter_idx[row] = adapter_idx[0]
 
         # guided decoding: stack the automata this wave + active slots need
-        wave_specs = [p.guided_choice for p in params_list]
+        wave_specs = [self._guided_spec(p) for p in params_list]
         if any(wave_specs) and self.prefill_chunk is not None:
             raise ValueError(
                 "guided decoding is not supported with chunked prefill yet"
@@ -1109,7 +1144,7 @@ class BatchedGenerator:
         row_aut = np.zeros((n_pad,), np.int32)
         if guided:
             for row, p in enumerate(params_list):
-                row_aut[row] = self._guided_index.get(p.guided_choice, 0)
+                row_aut[row] = self._guided_index.get(self._guided_spec(p), 0)
             for row in range(n, n_pad):
                 row_aut[row] = row_aut[0]
 
@@ -1598,7 +1633,7 @@ class BatchedGenerator:
                 self._guided_aut_np[slot_id] = 0
                 self.guided_aut = self._put_batch_vec(self._guided_aut_np)
             if not self._guided_aut_np.any() and not any(
-                s.active and s.params.guided_choice
+                s.active and self._guided_spec(s.params)
                 for i, s in enumerate(self.slots)
                 if i != slot_id  # this slot is finishing right now
             ):
@@ -1837,10 +1872,14 @@ class ServingEngine:
                 f"unknown LoRA adapter {adapter!r}; registered: "
                 f"{getattr(self.generator, 'adapter_names', [])}"
             )
-        if params is not None and params.guided_choice is not None:
+        if params is not None and params.guided_choice is not None \
+                and params.guided_regex is not None:
+            raise ValueError("guided_choice and guided_regex are mutually exclusive")
+        guided_spec = self.generator._guided_spec(params)
+        if guided_spec is not None:
             # builds+caches the automaton; raises ValueError here (to THIS
-            # caller) on bad choices or unsupported engine configs
-            self.generator.validate_guided(tuple(params.guided_choice))
+            # caller) on bad specs or unsupported engine configs
+            self.generator._ensure_automaton(guided_spec)
         if self._task is None:
             await self.start()
         future: asyncio.Future = asyncio.get_running_loop().create_future()

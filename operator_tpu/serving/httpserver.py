@@ -362,10 +362,21 @@ class CompletionServer:
                 self.engine.generator.validate_guided(tuple(guided))
             except ValueError as exc:
                 raise ApiError(400, str(exc)) from None
+        regex = req.get("guided_regex")
+        if regex is not None:
+            if guided is not None:
+                raise ApiError(400, "guided_choice and guided_regex are mutually exclusive")
+            if not isinstance(regex, str) or not regex or len(regex) > 1024:
+                raise ApiError(400, "guided_regex must be a non-empty string (<=1024 chars)")
+            try:
+                self.engine.generator.validate_guided_regex(regex)
+            except ValueError as exc:
+                raise ApiError(400, str(exc)) from None
         params = SamplingParams(
             max_tokens=max_tokens, temperature=float(temperature),
             top_p=float(top_p), adapter=self._resolve_adapter(req),
             guided_choice=tuple(guided) if guided is not None else None,
+            guided_regex=regex,
         )
         return params, stop
 

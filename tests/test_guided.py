@@ -240,3 +240,167 @@ def test_oversized_choice_set_rejected_at_submit(params):
     huge = tuple(secrets.token_hex(64) for _ in range(256))  # ~32k states
     with pytest.raises(ValueError, match="cap"):
         generator.validate_guided(huge)
+
+
+# --- guided_regex (serving/regex_dfa.py) -----------------------------------
+
+
+import re as _re
+
+
+class TestRegexAutomaton:
+    def test_dfa_matches_python_re(self):
+        """The byte DFA agrees with python's re on a workload of strings."""
+        from operator_tpu.serving.regex_dfa import _compile_byte_dfa
+
+        cases = {
+            r"(CRITICAL|HIGH|LOW)": ["CRITICAL", "HIGH", "LOW", "MEDIUM", "HI"],
+            r"\d{1,3} errors?": ["7 errors", "42 error", "999 errors",
+                                 "errors", "12  errors", "1234 errors"],
+            r"[a-f0-9]{4}": ["beef", "00ff", "beefy", "xyzw", "abc"],
+            r"pod-\w+(\.\d+)?": ["pod-a", "pod-x7.12", "pod-", "pod-a."],
+            r"a+b*c?": ["a", "aabbc", "b", "aaac", "abcc"],
+        }
+        for pattern, samples in cases.items():
+            transition, accepting = _compile_byte_dfa(pattern, 4096)
+            for sample in samples:
+                state = 0
+                for byte in sample.encode():
+                    state = transition[state, byte] if state >= 0 else -1
+                    if state < 0:
+                        break
+                dfa_match = state >= 0 and bool(accepting[state])
+                assert dfa_match == bool(_re.fullmatch(pattern, sample)), (
+                    pattern, sample)
+
+    def test_rejects_unsupported_syntax(self):
+        from operator_tpu.serving.regex_dfa import _compile_byte_dfa
+
+        for bad in (r"(?i)x", r"a{1,999}", r"a{", r"[z-a]", r"(", r"*a"):
+            with pytest.raises(ValueError):
+                _compile_byte_dfa(bad, 4096)
+
+    def test_unrealisable_pattern_rejected(self):
+        """A pattern needing bytes no token provides must be refused."""
+        from operator_tpu.serving.regex_dfa import compile_regex_automaton
+
+        class AsciiOnly(ByteTokenizer):
+            pass
+
+        tok = AsciiOnly()
+        # vocab capped below the bytes 'x'..'z' need -> no token can emit them
+        with pytest.raises(ValueError, match="cannot be realised"):
+            compile_regex_automaton(
+                r"[x-z]+", tok, vocab_size=tok.SPECIALS + ord("x"),
+                max_states=1024,
+            )
+
+
+@pytest.mark.parametrize("pattern", [r"(yes|no)", r"\d{2,4} errors",
+                                     r"sev-[A-Z]+"])
+def test_regex_output_matches_pattern(params, pattern):
+    generator = _generator(params)
+    for temperature in (0.0, 1.2):
+        result = generator.generate(
+            "classify", SamplingParams(max_tokens=24, temperature=temperature,
+                                       guided_regex=pattern))
+        assert _re.fullmatch(pattern, result.text), (pattern, result.text)
+
+
+def test_regex_and_choice_share_a_batch(params):
+    generator = _generator(params)
+    slots = generator.admit(
+        ["a", "b"],
+        [SamplingParams(max_tokens=20, temperature=1.0,
+                        guided_regex=r"[0-9]{3}ms"),
+         SamplingParams(max_tokens=20, temperature=1.0,
+                        guided_choice=("on", "off"))],
+    )
+    results = {}
+    while generator.num_active:
+        for slot_id, result in generator.step():
+            results[slot_id] = result
+    assert _re.fullmatch(r"[0-9]{3}ms", results[slots[0]].text)
+    assert results[slots[1]].text in ("on", "off")
+
+
+def test_api_guided_regex(params):
+    from operator_tpu.serving.httpserver import CompletionServer
+
+    async def scenario():
+        import json
+
+        engine = ServingEngine(_generator(params), admission_wait_s=0.005)
+        server = CompletionServer(engine, model_id="tiny-test",
+                                  host="127.0.0.1", port=0)
+        await server.start()
+        port = server.bound_port
+
+        async def post(body):
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            payload = json.dumps(body).encode()
+            writer.write(b"POST /v1/completions HTTP/1.1\r\nHost: t\r\n"
+                         + f"Content-Length: {len(payload)}\r\n\r\n".encode()
+                         + payload)
+            await writer.drain()
+            raw = await asyncio.wait_for(reader.read(), timeout=120)
+            writer.close()
+            return int(raw.split()[1]), json.loads(raw.partition(b"\r\n\r\n")[2])
+
+        try:
+            # bounded pattern: the DFA forces completion well inside the
+            # token budget (an unbounded \d+ could ramble digits to
+            # max_tokens and truncate mid-match — documented semantics)
+            status, body = await post({
+                "prompt": "how many errors?", "max_tokens": 24,
+                "temperature": 1.1, "guided_regex": r"\d{1,3} errors",
+            })
+            assert status == 200
+            assert _re.fullmatch(r"\d{1,3} errors", body["choices"][0]["text"])
+            status, body = await post({
+                "prompt": "x", "guided_regex": r"(?i)bad"})
+            assert status == 400
+            status, body = await post({
+                "prompt": "x", "guided_regex": "a",
+                "guided_choice": ["b"]})
+            assert status == 400 and "exclusive" in body["error"]["message"]
+        finally:
+            await server.stop()
+            await engine.close()
+
+    asyncio.run(scenario())
+
+
+class TestRegexParserStrictness:
+    def test_outer_anchors_tolerated_interior_rejected(self):
+        from operator_tpu.serving.regex_dfa import _compile_byte_dfa
+
+        transition, accepting = _compile_byte_dfa(r"^(yes|no)$", 4096)
+        state = 0
+        for byte in b"yes":
+            state = transition[state, byte]
+        assert state >= 0 and accepting[state]  # anchors ignored, not literal
+        with pytest.raises(ValueError, match="anchors"):
+            _compile_byte_dfa(r"a^b", 4096)
+        with pytest.raises(ValueError, match="anchors"):
+            _compile_byte_dfa(r"a$b", 4096)
+
+    def test_lazy_and_stacked_quantifiers_rejected(self):
+        from operator_tpu.serving.regex_dfa import _compile_byte_dfa
+
+        for bad in (r"a+?", r"a*?", r"a??", r"a+*", r"a{2}?"):
+            with pytest.raises(ValueError, match="quantifier"):
+                _compile_byte_dfa(bad, 4096)
+
+    def test_unknown_alnum_escapes_rejected(self):
+        from operator_tpu.serving.regex_dfa import _compile_byte_dfa
+
+        for bad in (r"\bword", r"\x41", r"\A", r"\u0041"):
+            with pytest.raises(ValueError, match="escape"):
+                _compile_byte_dfa(bad, 4096)
+        # punctuation escapes stay literal
+        transition, accepting = _compile_byte_dfa(r"\.\[", 4096)
+        state = 0
+        for byte in b".[":
+            state = transition[state, byte]
+        assert state >= 0 and accepting[state]
