@@ -242,7 +242,7 @@ class BatchedGenerator:
         self._prefill_job: Optional[_PrefillJob] = None
         self._reserved: set[int] = set()
         self._chunk_fns: dict[tuple[int, int, int], Any] = {}
-        self._finish_fns: dict[tuple[int, int], Any] = {}
+        self._finish_fns: dict[tuple, Any] = {}  # (n_pad, t_pad, guided)
 
         # ---- guided decoding (serving/guided.py): automaton tables stacked
         # [A_pad, S_pad, vocab] on device, per-slot (automaton, state)
@@ -591,6 +591,32 @@ class BatchedGenerator:
             return self._jax.device_put(array, self._shardings["batch"])
         return self._jnp.asarray(array)
 
+    def _guided_row_aut(self, specs: list, n_pad: int):
+        """[n_pad] automaton ids for a wave's rows (padding rows duplicate
+        row 0); id 0 = identity for unguided rows."""
+        row_aut = np.zeros((n_pad,), np.int32)
+        for row, spec in enumerate(specs):
+            row_aut[row] = self._guided_index.get(spec, 0)
+        for row in range(len(specs), n_pad):
+            row_aut[row] = row_aut[0]
+        return row_aut
+
+    def _apply_guided_activation(self, row_aut, taken, first_state) -> None:
+        """Post-activation guided bookkeeping, shared by the one-shot and
+        chunked paths: bind each slot's automaton id (0/identity for
+        unguided slots — this RESET matters: a recycled slot may carry a
+        stale accept-state from a previous guided occupant) and scatter the
+        first DFA states."""
+        jnp = self._jnp
+        for row, slot_id in enumerate(taken):
+            self._guided_aut_np[slot_id] = row_aut[row]
+        self.guided_aut = self._put_batch_vec(self._guided_aut_np)
+        self.guided_state = self._put_batch_vec(
+            self.guided_state.at[
+                jnp.asarray(np.asarray(taken, np.int32))
+            ].set(first_state[: len(taken)])
+        )
+
     def _get_guided_decode_fn(self):
         if self._decode_fn_guided is None:
             jax = self._jax
@@ -672,10 +698,6 @@ class BatchedGenerator:
         """Build (and cache) the automaton for a guided spec; raises
         ValueError on anything unservable — called at SUBMIT time so a bad
         request can never fail a co-batched wave."""
-        if self.prefill_chunk is not None:
-            raise ValueError(
-                "guided decoding is not supported with chunked prefill yet"
-            )
         if spec in self._guided_cache:
             return
         kind, payload = spec
@@ -1134,19 +1156,13 @@ class BatchedGenerator:
 
         # guided decoding: stack the automata this wave + active slots need
         wave_specs = [self._guided_spec(p) for p in params_list]
-        if any(wave_specs) and self.prefill_chunk is not None:
-            raise ValueError(
-                "guided decoding is not supported with chunked prefill yet"
-            )
         if any(wave_specs) or self._guided_tables is not None:
             self._refresh_guided_tables(wave_specs)
         guided = self._guided_tables is not None
-        row_aut = np.zeros((n_pad,), np.int32)
-        if guided:
-            for row, p in enumerate(params_list):
-                row_aut[row] = self._guided_index.get(self._guided_spec(p), 0)
-            for row in range(n, n_pad):
-                row_aut[row] = row_aut[0]
+        row_aut = (
+            self._guided_row_aut(wave_specs, n_pad) if guided
+            else np.zeros((n_pad,), np.int32)
+        )
 
         key = (n_pad, t_pad)
         if (
@@ -1200,14 +1216,7 @@ class BatchedGenerator:
             page_grants, (time.perf_counter() - started) * 1e3,
         )
         if guided:
-            for row, slot_id in enumerate(taken):
-                self._guided_aut_np[slot_id] = row_aut[row]
-            self.guided_aut = self._put_batch_vec(self._guided_aut_np)
-            self.guided_state = self._put_batch_vec(
-                self.guided_state.at[
-                    jnp.asarray(np.asarray(taken, np.int32))
-                ].set(first_state[: len(taken)])
-            )
+            self._apply_guided_activation(row_aut, taken, first_state)
         return result
 
     def _activate_slots(
@@ -1356,35 +1365,52 @@ class BatchedGenerator:
 
         return jax.jit(chunk_fn)
 
-    def _make_finish_fn(self, n_pad: int, t_pad: int):
+    def _make_finish_fn(self, n_pad: int, t_pad: int, guided: bool = False):
         """Scatter the completed mini cache into the big cache / pages and
-        sample each row's first token from the carried last logits."""
+        sample each row's first token from the carried last logits (masked
+        by the automaton start-state rows for guided waves)."""
         jax, jnp = self._jax, self._jnp
+
+        def sample_first(last_logits, rng, temp, top_p, gtables, gaut):
+            if guided:
+                row = gtables[gaut, jnp.zeros_like(gaut)]
+                last_logits = jnp.where(row >= 0, last_logits, -jnp.inf)
+            first_tokens, rng = self._sample(last_logits, rng, temp, top_p)
+            if guided:
+                first_state = jnp.take_along_axis(
+                    row, first_tokens[:, None], axis=1
+                )[:, 0]
+                return first_tokens, rng, (jnp.maximum(first_state, 0),)
+            return first_tokens, rng, ()
 
         if self.paged:
             def finish_fn(paged, mini, lengths, row_tables, last_logits,
-                          rng, temp, top_p):
+                          rng, temp, top_p, gtables=None, gaut=None):
                 from ..ops.paged_attention import PagedKVCache, write_tokens
 
                 zero = jnp.zeros((n_pad,), jnp.int32)
                 scatter = jax.vmap(write_tokens, in_axes=(0, None, 0, None, None))
                 k_pages = scatter(paged.k_pages, row_tables, mini.k, zero, lengths)
                 v_pages = scatter(paged.v_pages, row_tables, mini.v, zero, lengths)
-                first_tokens, rng = self._sample(last_logits, rng, temp, top_p)
+                first_tokens, rng, extra = sample_first(
+                    last_logits, rng, temp, top_p, gtables, gaut
+                )
                 return (
                     PagedKVCache(
                         k_pages=k_pages, v_pages=v_pages,
                         page_table=paged.page_table, lengths=paged.lengths,
                     ),
-                    first_tokens, rng,
+                    first_tokens, rng, *extra,
                 )
         else:
             def finish_fn(cache, mini, lengths, slot_ids, last_logits,
-                          rng, temp, top_p):
+                          rng, temp, top_p, gtables=None, gaut=None):
                 k = cache.k.at[:, slot_ids, :t_pad].set(mini.k.astype(cache.k.dtype))
                 v = cache.v.at[:, slot_ids, :t_pad].set(mini.v.astype(cache.v.dtype))
-                first_tokens, rng = self._sample(last_logits, rng, temp, top_p)
-                return KVCache(k=k, v=v), first_tokens, rng
+                first_tokens, rng, extra = sample_first(
+                    last_logits, rng, temp, top_p, gtables, gaut
+                )
+                return KVCache(k=k, v=v), first_tokens, rng, *extra
 
         return jax.jit(finish_fn)
 
@@ -1424,26 +1450,52 @@ class BatchedGenerator:
             if job.written < t_pad:
                 return
             t0 = time.perf_counter()  # finish timed separately (no double count)
-        # all chunks written: scatter + sample, then activate
-        fn_key2 = job.key
+        # all chunks written: scatter + sample, then activate.  Guided
+        # rows mask the first token at the finish step; the automaton
+        # indices are resolved NOW (admissions between this job's chunks
+        # may have restacked the tables)
+        job_specs = [self._guided_spec(p) for p in job.params_list]
+        if any(job_specs) or self._guided_tables is not None:
+            self._refresh_guided_tables(job_specs)
+        # SAME guard as the one-shot path: whenever tables are live, every
+        # activated slot gets its automaton binding (identity for unguided
+        # rows) — a recycled slot may hold a stale accept-state whose
+        # padding row would mask ALL logits for an unguided occupant
+        guided = self._guided_tables is not None
+        row_aut = (
+            self._guided_row_aut(job_specs, n_pad) if guided
+            else np.zeros((n_pad,), np.int32)
+        )
+        guided_args = (
+            (self._guided_tables, jnp.asarray(row_aut)) if guided else ()
+        )
+        fn_key2 = (n_pad, t_pad, guided)
         if fn_key2 not in self._finish_fns:
-            self._finish_fns[fn_key2] = self._make_finish_fn(n_pad, t_pad)
+            self._finish_fns[fn_key2] = self._make_finish_fn(n_pad, t_pad, guided)
         if self.paged:
             staged, row_tables = self._stage_page_tables(
                 len(job.taken), n_pad, job.slot_ids_np, job.page_grants,
                 job.lengths_np,
             )
-            self.paged_cache, first_tokens, self._rng = self._finish_fns[fn_key2](
+            outs = self._finish_fns[fn_key2](
                 staged, job.mini, job.lengths,
                 jnp.asarray(row_tables), job.last_logits,
-                self._rng, job.temp, job.top_p,
+                self._rng, job.temp, job.top_p, *guided_args,
             )
         else:
-            self.cache, first_tokens, self._rng = self._finish_fns[fn_key2](
+            outs = self._finish_fns[fn_key2](
                 self.cache, job.mini, job.lengths,
                 jnp.asarray(job.slot_ids_np), job.last_logits,
-                self._rng, job.temp, job.top_p,
+                self._rng, job.temp, job.top_p, *guided_args,
             )
+        if guided:
+            cache_out, first_tokens, self._rng, first_state = outs
+        else:
+            cache_out, first_tokens, self._rng = outs
+        if self.paged:
+            self.paged_cache = cache_out
+        else:
+            self.cache = cache_out
         self._prefill_job = None
         self._reserved.difference_update(job.taken)
         finish_ms = (time.perf_counter() - t0) * 1e3
@@ -1451,6 +1503,8 @@ class BatchedGenerator:
             np.asarray(first_tokens), job.lengths_np, job.taken,
             job.params_list, job.page_grants, job.chunk_ms + finish_ms,
         )
+        if guided:
+            self._apply_guided_activation(row_aut, job.taken, first_state)
 
     def _sampling_tensors(self):
         """(active_np, temp_dev, top_p_dev, active_dev), rebuilt only when

@@ -150,10 +150,29 @@ def test_validation_surfaces_to_caller(params):
     asyncio.run(scenario())
 
 
-def test_unsupported_configs_rejected(params):
-    chunked = _generator(params, prefill_chunk=16)
-    with pytest.raises(ValueError, match="chunked"):
-        chunked.validate_guided(("a",))
+def test_guided_with_chunked_prefill(params):
+    """Guided requests through multi-chunk prefill: the first token is
+    masked at the finish step, decode stays constrained, and the automaton
+    indices survive table restacks between a job's chunks."""
+    generator = _generator(params, prefill_chunk=16)
+    long_prompt = "classify the severity of this oom killed pod " * 3  # >64 tok
+    sampling = SamplingParams(max_tokens=16, temperature=1.2,
+                              guided_choice=CHOICES)
+    [slot] = generator.admit([long_prompt], [sampling])
+    assert generator._prefill_job is not None  # multi-chunk job
+    results = {}
+    while generator.num_active:
+        for slot_id, result in generator.step():
+            results[slot_id] = result
+    assert results[slot].text in CHOICES
+
+    # regex through the chunked path too
+    result = generator.generate(
+        long_prompt,
+        SamplingParams(max_tokens=20, temperature=1.0,
+                       guided_regex=r"[0-9]{2}ms"),
+    )
+    assert _re.fullmatch(r"[0-9]{2}ms", result.text)
 
 
 def test_guided_on_mesh(params):
@@ -404,3 +423,60 @@ class TestRegexParserStrictness:
         for byte in b".[":
             state = transition[state, byte]
         assert state >= 0 and accepting[state]
+
+
+def test_recycled_slot_after_guided_is_unconstrained_chunked(params):
+    """The stale-state hazard: a guided request finishes in a slot leaving a
+    nonzero DFA state; another guided request stays live (tables stay
+    stacked); a long UNGUIDED prompt recycles the slot through the CHUNKED
+    path — it must decode unconstrained (identity binding resets the
+    state), matching its guided-free greedy tokens."""
+    free = SamplingParams(max_tokens=8, temperature=0.0, stop_on_eos=False)
+    long_prompt = "an unguided long prompt about an evicted pod " * 3
+    solo = _generator(params, prefill_chunk=16).generate(long_prompt, free)
+
+    generator = _generator(params, prefill_chunk=16)
+    # slot gets a guided occupant first (short prompt: one-shot path)
+    done = generator.generate(
+        "pick", SamplingParams(max_tokens=8, temperature=0.8,
+                               guided_choice=("red", "green")))
+    assert done.text in ("red", "green")
+    # keep ANOTHER guided request active so tables stay live
+    [keeper] = generator.admit(
+        ["hold"], [SamplingParams(max_tokens=40, temperature=0.7,
+                                  guided_choice=CHOICES)])
+    # now recycle a slot with the unguided long prompt (chunked job)
+    [recycled] = generator.admit([long_prompt], [free])
+    assert generator._prefill_job is not None
+    results = {}
+    while generator.num_active:
+        for slot_id, result in generator.step():
+            results[slot_id] = result
+    assert results[recycled].token_ids == solo.token_ids  # unconstrained
+    assert results[keeper].text in CHOICES
+
+
+def test_table_restack_between_job_chunks(params):
+    """Automaton indices are resolved at FINISH time: a guided one-shot
+    wave admitted between a guided job's chunks restacks the tables, and
+    the job's rows must still bind the right automaton."""
+    generator = _generator(params, prefill_chunk=16)
+    long_prompt = "classify the severity of this oom killed pod " * 3
+    [job_slot] = generator.admit(
+        [long_prompt],
+        [SamplingParams(max_tokens=16, temperature=1.0,
+                        guided_choice=("zz-last", "zz-least"))])
+    assert generator._prefill_job is not None
+    index_before = dict(generator._guided_index)
+    # short guided wave with an alphabetically EARLIER spec: one-shot
+    # admission mid-job restacks and shifts indices
+    [short] = generator.admit(
+        ["pick"], [SamplingParams(max_tokens=12, temperature=0.9,
+                                  guided_choice=("aa-first", "ab-second"))])
+    assert generator._guided_index != index_before
+    results = {}
+    while generator.num_active:
+        for slot_id, result in generator.step():
+            results[slot_id] = result
+    assert results[short].text in ("aa-first", "ab-second")
+    assert results[job_slot].text in ("zz-last", "zz-least")
