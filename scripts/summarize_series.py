@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Summarize a tpu_experiments.sh output directory into markdown.
+
+Reads every ``bench_*.log`` (the JSON line bench.py prints), the floor
+and attribution logs, and writes a comparison table — the round's
+evidence in one place (``docs/R3_RESULTS.md`` when run by the recovery
+watcher).  No jax import; safe to run anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+
+def bench_rows(out_dir: str) -> list[tuple[str, dict]]:
+    rows = []
+    for name in sorted(os.listdir(out_dir)):
+        if not (name.startswith("bench_") and name.endswith(".log")):
+            continue
+        record = None
+        with open(os.path.join(out_dir, name), errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith("{") and '"metric"' in line:
+                    try:
+                        record = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+        if record:
+            rows.append((name[len("bench_"):-len(".log")], record))
+    return rows
+
+
+def grep(path: str, pattern: str, limit: int = 12) -> list[str]:
+    if not os.path.exists(path):
+        return []
+    matches = []
+    with open(path, errors="replace") as f:
+        for line in f:
+            if re.search(pattern, line):
+                matches.append(line.rstrip())
+                if len(matches) >= limit:
+                    break
+    return matches
+
+
+def main() -> None:
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else "/root/r3_experiments"
+    target = sys.argv[2] if len(sys.argv) > 2 else "-"
+
+    lines = ["# Round-3 on-chip experiment results", ""]
+    series = os.path.join(out_dir, "series.log")
+    if os.path.exists(series):
+        lines += ["## Series timeline", "", "```"]
+        lines += [l.rstrip() for l in open(series, errors="replace")][-40:]
+        lines += ["```", ""]
+
+    floors = grep(os.path.join(out_dir, "floor.log"),
+                  r"HBM|MXU|stream floor|device:")
+    if floors:
+        lines += ["## Hardware floors", "", "```", *floors, "```", ""]
+
+    attr = grep(os.path.join(out_dir, "decode_attr.log"),
+                r"ms/step|device:|block=")
+    if attr:
+        lines += ["## Decode attribution", "", "```", *attr, "```", ""]
+
+    rows = bench_rows(out_dir)
+    if rows:
+        lines += [
+            "## Bench comparison rows", "",
+            "| variant | expl/min | tok/s | p50 s | p99 s | open-loop p50@rate | model | dtype | notes |",
+            "|---|---|---|---|---|---|---|---|---|",
+        ]
+        for variant, r in rows:
+            open_loop = r.get("open_loop") or []
+            ol = (
+                f"{open_loop[0].get('p50_s')}s @{open_loop[0].get('rate_per_min')}/min"
+                if open_loop else "-"
+            )
+            notes = []
+            if r.get("degraded"):
+                notes.append("DEGRADED (cpu fallback)")
+            if r.get("error"):
+                notes.append(f"error: {r['error'][:60]}")
+            lines.append(
+                f"| {variant} | {r.get('value')} | {r.get('decode_tokens_per_s')} "
+                f"| {r.get('p50_latency_s')} | {r.get('p99_latency_s')} | {ol} "
+                f"| {r.get('model')} | {r.get('weight_dtype')} "
+                f"| {' '.join(notes) or '-'} |"
+            )
+        lines.append("")
+
+    trace = grep(os.path.join(out_dir, "trace_summary.log"), r"\S", limit=40)
+    if trace:
+        lines += ["## xplane top ops", "", "```", *trace, "```", ""]
+
+    text = "\n".join(lines) + "\n"
+    if target == "-":
+        print(text)
+    else:
+        with open(target, "w") as f:
+            f.write(text)
+        print(f"wrote {target}")
+
+
+if __name__ == "__main__":
+    main()
