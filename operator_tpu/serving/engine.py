@@ -22,6 +22,15 @@ Two layers: :class:`BatchedGenerator` is the synchronous JAX core (jitted
 prefill / decode-step / sampler); :class:`ServingEngine` is the asyncio
 front the operator talks to (queue, admission, futures).  The split keeps
 the JAX code testable without an event loop.
+
+Grown-in serving subsystems (each opt-in or zero-cost when unused):
+multi-step decode blocks + decode-ahead pipelining; sharded TP/DP serving
+over a mesh; multi-LoRA (per-slot adapters stacked into one program);
+guided decoding (choice/regex automata as scan-carried device state);
+Sarathi-style chunked prefill (``prefill_chunk``); priority admission
+(pipeline explanations outrank external API callers); bounded
+auto-recovery after device errors (:meth:`ServingEngine._try_recover`);
+and slot/page reclamation for cancelled callers.
 """
 
 from __future__ import annotations
