@@ -40,6 +40,7 @@ import functools
 import itertools
 import logging
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Optional, Sequence
@@ -258,6 +259,13 @@ class BatchedGenerator:
         # vectors carried through the decode scan.  None = no guided slot
         # active; the unguided programs keep compiling/running untouched.
         self._guided_cache: dict[tuple, Any] = {}   # choices -> ChoiceAutomaton
+        # submit-time validation mutates the cache from the HTTP event-loop
+        # thread while the serve loop's executor thread reads it; the lock
+        # guards bookkeeping only (builds run unlocked), and
+        # _guided_protect shields an in-flight refresh wave from
+        # submit-thread eviction
+        self._guided_lock = threading.Lock()
+        self._guided_protect: frozenset = frozenset()
         self._guided_tables = None                  # device stack, or None
         self._guided_index: dict[tuple, int] = {}   # choices -> stacked idx
         self._guided_aut_np = np.zeros((max_slots,), np.int32)
@@ -703,12 +711,29 @@ class BatchedGenerator:
     def validate_guided_regex(self, pattern: str) -> None:
         self._ensure_automaton(("regex", str(pattern)))
 
-    def _ensure_automaton(self, spec: tuple) -> None:
+    def _ensure_automaton(self, spec: tuple, protect: frozenset = frozenset()) -> None:
         """Build (and cache) the automaton for a guided spec; raises
         ValueError on anything unservable — called at SUBMIT time so a bad
-        request can never fail a co-batched wave."""
-        if spec in self._guided_cache:
-            return
+        request can never fail a co-batched wave.
+
+        ``protect`` names specs that must survive eviction (the full set a
+        ``_refresh_guided_tables`` pass is about to index) — without it, a
+        pass ensuring >cap distinct specs could evict one it ensured
+        moments earlier and KeyError inside the serve loop.
+
+        Thread safety: submit-time validation runs on the HTTP event-loop
+        thread while the serve loop's executor thread refreshes the
+        stacked tables.  Cache bookkeeping (touch/evict/insert) holds
+        ``_guided_lock`` — the LRU touch is a pop-then-reinsert which,
+        unlocked, opens a transient-absence window for exactly the
+        KeyError the protection exists to prevent.  The automaton BUILD
+        runs outside the lock: DFA compilation can take seconds, and
+        holding the lock through it would stall the decode loop from the
+        event-loop thread (or all HTTP traffic from the executor)."""
+        with self._guided_lock:
+            if spec in self._guided_cache:
+                self._guided_cache[spec] = self._guided_cache.pop(spec)  # LRU
+                return
         kind, payload = spec
         if kind == "choice":
             from .guided import build_choice_automaton
@@ -729,9 +754,27 @@ class BatchedGenerator:
                 f"above the {self.MAX_GUIDED_STATES} cap — simplify the "
                 f"choices/pattern"
             )
-        while len(self._guided_cache) >= 32:  # bound host memory: LRU-ish
-            self._guided_cache.pop(next(iter(self._guided_cache)))
-        self._guided_cache[spec] = automaton
+        with self._guided_lock:
+            if spec in self._guided_cache:  # raced another builder: theirs won
+                self._guided_cache[spec] = self._guided_cache.pop(spec)
+                return
+            # bound host memory (LRU), but never evict a spec bound to an
+            # ACTIVE slot, indexed in the current stacked tables, or in the
+            # refresh pass currently in flight (_guided_protect) — the
+            # serve loop indexes the cache directly for those
+            live = {
+                self._guided_spec(slot.params)
+                for slot in self.slots
+                if slot.active
+            }
+            live.update(self._guided_index)
+            live.update(self._guided_protect)
+            live.update(protect)
+            live.discard(None)
+            evictable = [k for k in self._guided_cache if k not in live]
+            while len(self._guided_cache) >= 32 and evictable:
+                self._guided_cache.pop(evictable.pop(0))
+            self._guided_cache[spec] = automaton
 
     def _refresh_guided_tables(self, wave_specs: "list[tuple | None]") -> None:
         """(Re)stack the automata needed by active + newly admitted guided
@@ -751,15 +794,30 @@ class BatchedGenerator:
             self.guided_aut = None
             self.guided_state = None
             return
-        for spec in specs:
-            self._ensure_automaton(spec)
-        ordered = sorted(specs)
-        new_index = {spec: i + 1 for i, spec in enumerate(ordered)}
-        if self._guided_tables is not None and new_index == self._guided_index:
-            return  # byte-identical stack: skip the rebuild + upload
-        automata = [identity_automaton(self.config.vocab_size)]
-        automata += [self._guided_cache[spec] for spec in ordered]
-        self._guided_index = new_index
+        # advertise the wave to submit-thread evictions BEFORE ensuring:
+        # without the protect window, an eviction between this pass's
+        # ensure loop and the locked cache reads below could drop a wave
+        # spec before it lands in _guided_index.  Builds themselves run
+        # unlocked (inside _ensure_automaton), so a slow DFA compile here
+        # never blocks HTTP submits.
+        with self._guided_lock:
+            self._guided_protect = frozenset(specs)
+        try:
+            for spec in specs:
+                self._ensure_automaton(spec)
+            with self._guided_lock:
+                ordered = sorted(specs)
+                new_index = {spec: i + 1 for i, spec in enumerate(ordered)}
+                if self._guided_tables is not None and new_index == self._guided_index:
+                    return  # byte-identical stack: skip the rebuild + upload
+                automata = [identity_automaton(self.config.vocab_size)]
+                automata += [self._guided_cache[spec] for spec in ordered]
+                self._guided_index = new_index
+        finally:
+            # _guided_index now carries the wave (or we raised); either way
+            # the explicit protect window is over
+            with self._guided_lock:
+                self._guided_protect = frozenset()
         a_pad = _bucket(len(automata), 2, 64)
         s_pad = _bucket(
             max(a.num_states for a in automata), 8, self.MAX_GUIDED_STATES

@@ -20,8 +20,11 @@ Mechanics:
   token indexes the next state.  Shapes are bucketed (automata count,
   state count) so XLA compiles a handful of guided programs.
 
-The engine enforces ``eos_id`` support and rejects guided requests in
-configurations v1 does not cover (mesh, chunked prefill) at SUBMIT time.
+``guided_regex`` rides the same machinery with a DFA in place of the
+trie (serving/regex_dfa.py).  Both work on sharded meshes (the tables
+are committed replicated once, not re-broadcast per block) and with
+chunked prefill (the automaton activates when the final chunk admits
+the slot).  The engine enforces ``eos_id`` support at SUBMIT time.
 """
 
 from __future__ import annotations
@@ -80,6 +83,16 @@ def build_choice_automaton(
         accept[state] = True
 
     num_states = len(nodes)
+    # same product cap as the regex path (regex_dfa.py): the table is
+    # [num_states, vocab] int32 and gets padded/stacked again by the
+    # engine — an unbounded choice set against a 150k vocab would
+    # allocate gigabytes on the host and upload them to device
+    if num_states * vocab_size > 16_000_000:
+        raise ValueError(
+            f"guided_choice automaton table would be {num_states} states x "
+            f"{vocab_size} vocab = {num_states * vocab_size} entries, above "
+            f"the 16M cap — use fewer or shorter choices"
+        )
     transition = np.full((num_states, vocab_size), -1, np.int32)
     for state, edges in enumerate(nodes):
         for token, child in edges.items():

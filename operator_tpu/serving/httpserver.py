@@ -43,7 +43,12 @@ import time
 import uuid
 from typing import Any, Optional
 
-from .engine import GenerationResult, SamplingParams, ServingEngine
+from .engine import (
+    GenerationResult,
+    OversizedRequest,
+    SamplingParams,
+    ServingEngine,
+)
 from .templates import template_for
 
 log = logging.getLogger(__name__)
@@ -146,7 +151,12 @@ class CompletionServer:
 
     async def start(self) -> None:
         await self.engine.start()
-        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        # limit= makes readuntil overrun (-> 431) at exactly the header
+        # budget instead of the 64 KiB StreamReader default; readexactly
+        # for bodies is unaffected by the buffer limit
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port, limit=_MAX_HEADER_BYTES
+        )
         log.info("completion api listening on %s:%s", self.host, self.bound_port)
 
     async def stop(self) -> None:
@@ -217,9 +227,14 @@ class CompletionServer:
                 pass
 
     async def _read_request(self, reader: asyncio.StreamReader):
-        head = await asyncio.wait_for(
-            reader.readuntil(b"\r\n\r\n"), timeout=_READ_TIMEOUT_S
-        )
+        try:
+            head = await asyncio.wait_for(
+                reader.readuntil(b"\r\n\r\n"), timeout=_READ_TIMEOUT_S
+            )
+        except asyncio.LimitOverrunError:
+            # separator not found within the StreamReader buffer limit —
+            # oversized headers are a 431, not an internal error
+            raise ApiError(431, "headers too large") from None
         if len(head) > _MAX_HEADER_BYTES:
             raise ApiError(431, "headers too large")
         request_line, *header_lines = head.decode("latin-1").split("\r\n")
@@ -349,11 +364,15 @@ class CompletionServer:
             if (
                 not isinstance(guided, list)
                 or not guided
-                or not all(isinstance(c, str) and c for c in guided)
+                or not all(
+                    isinstance(c, str) and 0 < len(c) <= 512 for c in guided
+                )
                 or len(guided) > 256
             ):
                 raise ApiError(
-                    400, "guided_choice must be a non-empty list of <=256 strings"
+                    400,
+                    "guided_choice must be a non-empty list of <=256 strings "
+                    "of <=512 chars each",
                 )
             try:
                 # surfaces bad choice sets (oversized automata, unservable
@@ -419,6 +438,11 @@ class CompletionServer:
             results = await asyncio.gather(
                 *(self.engine.generate(p, params) for p in jobs)
             )
+        except OversizedRequest as exc:
+            # admission-time client error (prompt needs more KV pages than
+            # the whole cache) — a 400, not an internal failure; other
+            # engine-internal ValueErrors deliberately stay 5xx
+            raise ApiError(400, str(exc)) from None
         except RuntimeError as exc:
             raise ApiError(503, f"engine unavailable: {exc}", "server_error") from None
 

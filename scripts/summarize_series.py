@@ -3,8 +3,7 @@
 
 Reads every ``bench_*.log`` (the JSON line bench.py prints), the floor
 and attribution logs, and writes a comparison table — the round's
-evidence in one place (``docs/R3_RESULTS.md`` when run by the recovery
-watcher).  No jax import; safe to run anywhere.
+evidence in one place (``docs/R4_RESULTS.md`` when run after each series step).  No jax import; safe to run anywhere.
 """
 
 from __future__ import annotations
@@ -48,10 +47,10 @@ def grep(path: str, pattern: str, limit: int = 12) -> list[str]:
 
 
 def main() -> None:
-    out_dir = sys.argv[1] if len(sys.argv) > 1 else "/root/r3_experiments"
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else "/root/r4_experiments"
     target = sys.argv[2] if len(sys.argv) > 2 else "-"
 
-    lines = ["# Round-3 on-chip experiment results", ""]
+    lines = ["# Round-4 on-chip experiment results", ""]
     series = os.path.join(out_dir, "series.log")
     if os.path.exists(series):
         lines += ["## Series timeline", "", "```"]

@@ -1,13 +1,20 @@
 #!/bin/bash
-# Round-3 TPU experiment series (run on the TPU-attached host).
-# Produces /tmp/r3_experiments/: hardware floors, decode attribution,
-# bench variants (pipeline, page size, quant, config-4 slots=32, 8B int8),
+# Round-4 TPU experiment series (run on the TPU-attached host).
+# Produces $OUT/: hardware floors, decode attribution, bench variants
+# (pipeline, page size, quant, config-4 slots=32, 8B int8, chunked A/B),
 # and an xplane profile. Each step is individually timeboxed so one hang
-# doesn't kill the series.
+# doesn't kill the series, and EVERY completed step commits the refreshed
+# docs/R4_RESULTS.md — a mid-series tunnel death leaves partial evidence
+# in git (round 3 lost everything to an all-or-nothing queue).
 set -u
-OUT=$(realpath -m "${1:-/tmp/r3_experiments}")  # absolute BEFORE the cd below
+OUT=$(realpath -m "${1:-/root/r4_experiments}")  # absolute BEFORE the cd below
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
+# the keep-host-quiet flag must not outlive the series: the EXIT trap
+# covers normal exits + SIGTERM/ctrl-C, and the flag carries this PID so
+# consumers can detect a SIGKILL'd (e.g. OOM-killed) series — treat the
+# flag as stale when `kill -0 $(cat RUNNING)` fails
+trap 'rm -f "$OUT/RUNNING"' EXIT
 
 wait_chip() {  # block until the TPU answers a device probe (a step killed at
   # its timebox can leave the tunnel holding the chip for a while; starting
@@ -24,6 +31,22 @@ wait_chip() {  # block until the TPU answers a device probe (a step killed at
   return 1
 }
 
+capture() {  # refresh the results doc and commit it (index-lock tolerant)
+  python scripts/summarize_series.py "$OUT" docs/R4_RESULTS.md \
+      >> "$OUT/series.log" 2>&1
+  if [ -f docs/R4_RESULTS.md ] && { \
+      ! git ls-files --error-unmatch docs/R4_RESULTS.md > /dev/null 2>&1 \
+      || ! git diff --quiet HEAD -- docs/R4_RESULTS.md 2>/dev/null; }; then
+    for _ in 1 2 3; do
+      git add docs/R4_RESULTS.md 2>/dev/null \
+        && git commit -m "Record on-chip result: $1" \
+            -- docs/R4_RESULTS.md >> "$OUT/series.log" 2>&1 \
+        && break
+      sleep 5  # another process may hold .git/index.lock
+    done
+  fi
+}
+
 run() {  # run <name> <timeout_s> <cmd...>
   local name=$1 tmo=$2; shift 2
   # resumable: a relaunch after a mid-series tunnel death (watcher rc=2
@@ -35,15 +58,21 @@ run() {  # run <name> <timeout_s> <cmd...>
   echo "=== $name ($(date +%H:%M:%S)) ===" | tee -a "$OUT/series.log"
   # a dead tunnel fails every step: abort the series rather than serially
   # burning each step's full wait window (an outer watcher relaunches)
-  wait_chip || { echo "ABORT series at $name (no chip)" | tee -a "$OUT/series.log"; exit 2; }
+  wait_chip || { echo "ABORT series at $name (no chip)" | tee -a "$OUT/series.log"; rm -f "$OUT/RUNNING"; exit 2; }
+  echo $$ > "$OUT/RUNNING"  # keep the host quiet (tunnel dispatch is host-bound)
   timeout --kill-after=30 "$tmo" "$@" > "$OUT/$name.log" 2>&1
-  echo "rc=$? $name" | tee -a "$OUT/series.log"
+  local rc=$?
+  echo "rc=$rc $name" | tee -a "$OUT/series.log"
+  rm -f "$OUT/RUNNING"
+  capture "$name"
 }
 
+# the single probe that settles the roofline question (VERDICT r3 weak #5):
+# the fixed weights-streaming leg of the floor profiler
 run floor        600 python scripts/profile_floor.py
 run decode_attr  900 python scripts/profile_decode.py
-# headline: TinyLlama bf16, paged, pipeline 2, open loop at 100/min
-run bench_main   1500 env BENCH_OPEN_SECONDS=60 python bench.py
+# headline: TinyLlama bf16, paged, pipeline 2, open-loop SLO sweep
+run bench_main   2400 env BENCH_OPEN_SECONDS=60 BENCH_SWEEP=60,100,150 python bench.py
 # decode-ahead off (attribution of the pipelining win)
 run bench_nopipe 900 env BENCH_OPEN=0 BENCH_PIPELINE=1 python bench.py
 # bigger pages: 4x fewer grid steps in the paged kernel
@@ -65,9 +94,11 @@ run bench_8b     2400 env BENCH_OPEN=0 BENCH_MODEL=llama-3-8b BENCH_QUANT=1 \
 run bench_unroll 900 env BENCH_OPEN=0 OPERATOR_TPU_LAYER_UNROLL=22 python bench.py
 # decode-block straight-lining: does the scan CARRY (cache) get copied?
 run bench_block_unroll 900 env BENCH_OPEN=0 OPERATOR_TPU_DECODE_UNROLL=1 python bench.py
-# chunked prefill: bounded decode stalls under open-loop arrivals (the
-# interesting comparison is open-loop p50/p99 vs bench_main)
+# chunked prefill A/B in the regime it was built for (VERDICT r3 item 4):
+# open-loop p50/p99 vs bench_main at 1B, and an 8B closed-batch pair
 run bench_chunked 1500 env BENCH_OPEN_SECONDS=60 BENCH_PREFILL_CHUNK=256 python bench.py
+run bench_8b_chunked 2400 env BENCH_OPEN=0 BENCH_MODEL=llama-3-8b BENCH_QUANT=1 \
+    BENCH_SLOTS=8 BENCH_REQUESTS=16 BENCH_MAX_SEQ=2048 BENCH_PREFILL_CHUNK=512 python bench.py
 # xplane trace of the timed region for the remaining-gap attribution
 run bench_profile 900 env BENCH_OPEN=0 BENCH_PROFILE=$OUT/xplane python bench.py
 run trace_summary 300 python scripts/analyze_xplane.py "$OUT/xplane" 40

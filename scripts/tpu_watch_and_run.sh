@@ -2,9 +2,11 @@
 # Recovery watcher: poll until the TPU tunnel answers, then run the full
 # experiment series once.  Survives tunnel outages that outlast any single
 # step's wait window (scripts/tpu_experiments.sh aborts fast on a dead
-# tunnel; this relaunches it when the chip returns).
+# tunnel; this relaunches it when the chip returns).  The series commits
+# docs/R4_RESULTS.md after every completed step, so this wrapper only
+# needs to relaunch on rc=2 (mid-series tunnel death).
 set -u
-OUT=$(realpath -m "${1:-/root/r3_experiments}")
+OUT=$(realpath -m "${1:-/root/r4_experiments}")
 cd "$(dirname "$0")/.."
 mkdir -p "$OUT"
 echo "watcher start $(date +%H:%M:%S)" >> "$OUT/watcher.log"
@@ -15,20 +17,6 @@ while true; do
     bash scripts/tpu_experiments.sh "$OUT"
     rc=$?
     echo "series rc=$rc $(date +%H:%M:%S)" >> "$OUT/watcher.log"
-    # capture whatever completed so the evidence survives even if nobody
-    # is watching when the tunnel recovers
-    python scripts/summarize_series.py "$OUT" docs/R3_RESULTS.md \
-        >> "$OUT/watcher.log" 2>&1
-    # commit ONLY the results file, only when it exists and differs from
-    # HEAD (a pathless commit would sweep unrelated staged work; diff
-    # against HEAD also catches a staged-but-uncommitted earlier attempt)
-    if [ -f docs/R3_RESULTS.md ] && { \
-        ! git ls-files --error-unmatch docs/R3_RESULTS.md > /dev/null 2>&1 \
-        || ! git diff --quiet HEAD -- docs/R3_RESULTS.md 2>/dev/null; }; then
-      git add docs/R3_RESULTS.md 2>/dev/null
-      git commit -m "Record on-chip experiment series results" \
-          -- docs/R3_RESULTS.md >> "$OUT/watcher.log" 2>&1
-    fi
     # rc=2 means the tunnel died mid-series: go back to polling and rerun
     [ "$rc" != 2 ] && break
   else

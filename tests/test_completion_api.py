@@ -366,3 +366,27 @@ def test_error_surface(server_port):
     # unknown route
     status, body = _request(server_port, "GET", "/v2/oops")
     assert status == 404
+    # per-choice string length cap (guards the automaton table product
+    # before tokenization even starts)
+    status, body = _request(
+        server_port, "POST", "/v1/completions",
+        {"prompt": "x", "guided_choice": ["y" * 600]})
+    assert status == 400 and "512" in body["error"]["message"]
+
+
+def test_oversized_request_maps_to_400():
+    """OversizedRequest escaping submit-time validation is a CLIENT error
+    (prompt bigger than the whole KV cache), not a 500."""
+    from operator_tpu.serving.engine import OversizedRequest
+    from operator_tpu.serving.httpserver import ApiError, CompletionServer
+
+    class _StubEngine:
+        generator = None
+
+        async def generate(self, prompt, params, on_partial=None):
+            raise OversizedRequest("request needs 9 KV pages, cache holds 4")
+
+    server = CompletionServer(_StubEngine(), model_id="tiny-test")
+    with pytest.raises(ApiError) as err:
+        asyncio.run(server._completions({"prompt": "x" * 4096}, chat=False))
+    assert err.value.status == 400 and "KV pages" in str(err.value)

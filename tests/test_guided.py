@@ -71,6 +71,30 @@ class TestAutomaton:
         automaton = identity_automaton(64)
         assert (automaton.transition == 0).all()
 
+    def test_table_product_cap(self):
+        # a production-sized vocab with a long choice set would allocate
+        # gigabytes; the builder must refuse before the np.full
+        tok = ByteTokenizer()
+        with pytest.raises(ValueError, match="16M cap"):
+            build_choice_automaton(("x" * 200,), tok, 200_000)
+
+
+def test_cache_eviction_spares_protected_specs(params):
+    """A refresh pass ensuring more specs than the cache cap must not
+    evict one it ensured moments earlier (the serve loop indexes the
+    cache directly afterwards)."""
+    generator = _generator(params)
+    specs = [("choice", (f"spec-{i:02d}",)) for i in range(40)]
+    protect = frozenset(specs)
+    for spec in specs:
+        generator._ensure_automaton(spec, protect=protect)
+    assert all(spec in generator._guided_cache for spec in specs)
+    # unprotected ensures still evict: the cache stays bounded once the
+    # protected wave is gone
+    for i in range(40, 120):
+        generator._ensure_automaton(("choice", (f"spec-{i}",)))
+    assert len(generator._guided_cache) <= len(protect) + 32
+
 
 @pytest.mark.parametrize("paged", [True, False])
 @pytest.mark.parametrize("temperature", [0.0, 1.3])
