@@ -22,7 +22,14 @@ from ..patterns.engine import PatternEngine
 from ..utils.config import OperatorConfig
 from ..utils.timing import METRICS, MetricsRegistry
 from .events import EventService
-from .health import LivenessCheck, ReadinessCheck
+from .health import (
+    ENGINE_DISABLED,
+    ENGINE_FAILED,
+    ENGINE_LOADING,
+    ENGINE_READY,
+    LivenessCheck,
+    ReadinessCheck,
+)
 from .httpserver import HealthServer
 from .kubeapi import FakeKubeApi, KubeApi
 from .patternsync import GitSyncService, PatternLibraryReconciler
@@ -77,7 +84,14 @@ class Operator:
         self.pattern_reconciler = PatternLibraryReconciler(
             api, GitSyncService(self.config), engine=self.engine, config=self.config
         )
-        self.readiness = ReadinessCheck(api, self.config)
+        # engine warmth starts "disabled": flipped to loading/ready/failed
+        # by _start_completion_api; readiness gates on it (health.py) so a
+        # pod never reports Ready while minutes of weight load + XLA
+        # compile still stand between it and its first sub-2s explanation
+        self.engine_warmth = ENGINE_DISABLED
+        self.readiness = ReadinessCheck(
+            api, self.config, engine_state=lambda: self.engine_warmth
+        )
         self.liveness = LivenessCheck()
         self.health_server: Optional[HealthServer] = None
         if self.config.health_port >= 0:
@@ -129,7 +143,10 @@ class Operator:
         operator control plane.  Runs as its own task so watcher/reconciler
         startup is never serialised behind a multi-second weight load."""
         engine = None
+        server = None
+        self.engine_warmth = ENGINE_LOADING
         try:
+            from ..serving.engine import SamplingParams
             from ..serving.httpserver import CompletionServer
             from ..serving.provider import TPUNativeProvider, build_serving_engine
 
@@ -158,8 +175,23 @@ class Operator:
                 embedder=embedder,
             )
             await server.start()
+            # warmup: one throwaway generation compiles the default-bucket
+            # prefill + decode programs NOW, while readiness still reports
+            # cold — not inside the first real failure's 2 s budget
+            await engine.generate("warmup", SamplingParams(max_tokens=1))
+        except asyncio.CancelledError:
+            # operator stop() mid-load: not a failure, just no engine
+            self.engine_warmth = ENGINE_DISABLED
+            if server is not None:
+                await server.stop()
+            if engine is not None:
+                await engine.close()
+            raise
         except Exception:  # noqa: BLE001 - optional surface, degrade quietly
+            self.engine_warmth = ENGINE_FAILED
             log.warning("completion api disabled", exc_info=True)
+            if server is not None:  # a post-start warmup failure leaks the port
+                await server.stop()
             if engine is not None:  # free the loaded weights, not just leak them
                 await engine.close()
             return
@@ -171,6 +203,7 @@ class Operator:
             "tpu-native", TPUNativeProvider(engine, model_id=model_id)
         )
         self.completion_server = server
+        self.engine_warmth = ENGINE_READY
 
     # ------------------------------------------------------------------
     async def start(self) -> None:
@@ -180,6 +213,10 @@ class Operator:
         if self.health_server is not None:
             await self.health_server.start()
         if self.config.completion_api_port >= 0:
+            # flip warmth BEFORE the task is scheduled: a readiness probe
+            # landing between create_task and the task's first step must
+            # already see the engine as cold
+            self.engine_warmth = ENGINE_LOADING
             self.completion_task = asyncio.create_task(
                 self._start_completion_api(), name="completion-api"
             )

@@ -5,19 +5,36 @@ Readiness parity with reference PatternLibraryReadinessCheck
 PatternLibrary CRs exist; otherwise require at least one pattern YAML in the
 cache; after a 5-minute startup grace period report ready regardless (so a
 broken Git remote can't keep the operator out of rotation forever).
+
+Beyond parity, readiness also gates on serving-engine WARMTH when the
+operator is warming one (weights loaded + default-bucket programs
+compiled).  The reference gates readiness on its heavy dependency being
+usable (the pattern cache, :22-86); this system's heavy dependency is the
+in-process TPU engine — minutes of weight load + XLA compile at 8B scale.
+Without the gate a pod reports Ready cold, and the first failures
+analyzed in that window eat the compile latency inside their 2 s budget.
+The same grace period applies so a permanently broken engine (which the
+operator survives by degrading to pattern-only analyses) cannot keep the
+pod out of rotation forever.
 """
 
 from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from typing import Optional
+from typing import Callable, Optional
 
 from ..patterns.loader import discover_library_files
 from ..utils.config import OperatorConfig
 from .kubeapi import ApiError, KubeApi
 
 STARTUP_GRACE_S = 300.0  # reference :26 (5 minutes)
+
+#: engine warmth states an ``engine_state`` callable may report
+ENGINE_DISABLED = "disabled"   # no engine is being warmed (no gating)
+ENGINE_LOADING = "loading"     # weights/compile in progress (gate)
+ENGINE_READY = "ready"         # warmup generation completed
+ENGINE_FAILED = "failed"       # build failed; operator degrades to pattern-only
 
 
 @dataclass
@@ -33,15 +50,40 @@ class ReadinessCheck:
         config: Optional[OperatorConfig] = None,
         *,
         started_at: Optional[float] = None,
+        engine_state: Optional[Callable[[], str]] = None,
     ) -> None:
         self.api = api
         self.config = config or OperatorConfig()
         self.started_at = time.monotonic() if started_at is None else started_at
+        #: callable reporting ENGINE_* warmth; None = no engine gating
+        self.engine_state = engine_state
 
     def _in_grace(self) -> bool:
         return (time.monotonic() - self.started_at) > STARTUP_GRACE_S
 
     async def check(self) -> HealthStatus:
+        patterns = await self._check_patterns()
+        if not patterns.ready:
+            return patterns
+        state = self.engine_state() if self.engine_state is not None else ENGINE_DISABLED
+        if state == ENGINE_LOADING:
+            if self._in_grace():
+                return HealthStatus(
+                    True, f"{patterns.reason}; engine still warming but grace elapsed"
+                )
+            return HealthStatus(
+                False, "serving engine warming (weight load / XLA compile)"
+            )
+        if state == ENGINE_FAILED:
+            # deliberate: the operator stays in rotation serving
+            # pattern-only analyses (app.py degrades quietly); a dead
+            # optional engine must not unschedule the control plane
+            return HealthStatus(True, f"{patterns.reason}; engine failed (degraded)")
+        if state == ENGINE_READY:
+            return HealthStatus(True, f"{patterns.reason}; engine warm")
+        return patterns
+
+    async def _check_patterns(self) -> HealthStatus:
         try:
             libraries = await self.api.list("PatternLibrary")
         except ApiError as exc:

@@ -711,15 +711,15 @@ class BatchedGenerator:
     def validate_guided_regex(self, pattern: str) -> None:
         self._ensure_automaton(("regex", str(pattern)))
 
-    def _ensure_automaton(self, spec: tuple, protect: frozenset = frozenset()) -> None:
+    def _ensure_automaton(self, spec: tuple) -> None:
         """Build (and cache) the automaton for a guided spec; raises
         ValueError on anything unservable — called at SUBMIT time so a bad
         request can never fail a co-batched wave.
 
-        ``protect`` names specs that must survive eviction (the full set a
-        ``_refresh_guided_tables`` pass is about to index) — without it, a
-        pass ensuring >cap distinct specs could evict one it ensured
-        moments earlier and KeyError inside the serve loop.
+        Eviction never touches specs in ``_guided_protect`` (the full set a
+        ``_refresh_guided_tables`` pass is about to index) — without that
+        window, a pass ensuring >cap distinct specs could evict one it
+        ensured moments earlier and KeyError inside the serve loop.
 
         Thread safety: submit-time validation runs on the HTTP event-loop
         thread while the serve loop's executor thread refreshes the
@@ -769,7 +769,6 @@ class BatchedGenerator:
             }
             live.update(self._guided_index)
             live.update(self._guided_protect)
-            live.update(protect)
             live.discard(None)
             evictable = [k for k in self._guided_cache if k not in live]
             while len(self._guided_cache) >= 32 and evictable:

@@ -434,10 +434,11 @@ class CompletionServer:
 
         # every replica of every prompt joins the shared continuous batch
         jobs = [p for p in prompts for _ in range(n)]
+        tasks = [
+            asyncio.ensure_future(self.engine.generate(p, params)) for p in jobs
+        ]
         try:
-            results = await asyncio.gather(
-                *(self.engine.generate(p, params) for p in jobs)
-            )
+            results = await asyncio.gather(*tasks)
         except OversizedRequest as exc:
             # admission-time client error (prompt needs more KV pages than
             # the whole cache) — a 400, not an internal failure; other
@@ -445,6 +446,13 @@ class CompletionServer:
             raise ApiError(400, str(exc)) from None
         except RuntimeError as exc:
             raise ApiError(503, f"engine unavailable: {exc}", "server_error") from None
+        finally:
+            # one failed job must not leave its siblings decoding on the
+            # shared engine after the response went out — cancellation
+            # triggers the engine's slot/page reclamation
+            for task in tasks:
+                if not task.done():
+                    task.cancel()
 
         choices = []
         usage_prompt = usage_completion = 0

@@ -62,9 +62,12 @@ run() {  # run <name> <timeout_s> <cmd...>
   echo $$ > "$OUT/RUNNING"  # keep the host quiet (tunnel dispatch is host-bound)
   timeout --kill-after=30 "$tmo" "$@" > "$OUT/$name.log" 2>&1
   local rc=$?
-  echo "rc=$rc $name" | tee -a "$OUT/series.log"
   rm -f "$OUT/RUNNING"
+  # capture BEFORE writing the resume marker: a kill between the two just
+  # reruns the step next time, whereas marker-then-capture would resume
+  # PAST a step whose evidence never got committed
   capture "$name"
+  echo "rc=$rc $name" | tee -a "$OUT/series.log"
 }
 
 # the single probe that settles the roofline question (VERDICT r3 weak #5):
@@ -102,4 +105,8 @@ run bench_8b_chunked 2400 env BENCH_OPEN=0 BENCH_MODEL=llama-3-8b BENCH_QUANT=1 
 # xplane trace of the timed region for the remaining-gap attribution
 run bench_profile 900 env BENCH_OPEN=0 BENCH_PROFILE=$OUT/xplane python bench.py
 run trace_summary 300 python scripts/analyze_xplane.py "$OUT/xplane" 40
+# the "sustained" half of the north star: >=10 min open loop at 100/min
+# THROUGH the operator pipeline (fake apiserver -> watcher -> pattern
+# engine -> tpu-native provider -> storage), with a leak audit at drain
+run bench_soak  1800 env SOAK_SECONDS=600 SOAK_RATE=100 python scripts/soak.py
 echo "series done $(date +%H:%M:%S)" | tee -a "$OUT/series.log"

@@ -17,6 +17,17 @@ while true; do
     bash scripts/tpu_experiments.sh "$OUT"
     rc=$?
     echo "series rc=$rc $(date +%H:%M:%S)" >> "$OUT/watcher.log"
+    # belt-and-braces final capture: covers a series killed between a
+    # step's run and its own capture call
+    python scripts/summarize_series.py "$OUT" docs/R4_RESULTS.md \
+        >> "$OUT/watcher.log" 2>&1
+    if [ -f docs/R4_RESULTS.md ] && { \
+        ! git ls-files --error-unmatch docs/R4_RESULTS.md > /dev/null 2>&1 \
+        || ! git diff --quiet HEAD -- docs/R4_RESULTS.md 2>/dev/null; }; then
+      git add docs/R4_RESULTS.md 2>/dev/null
+      git commit -m "Record on-chip experiment series results" \
+          -- docs/R4_RESULTS.md >> "$OUT/watcher.log" 2>&1
+    fi
     # rc=2 means the tunnel died mid-series: go back to polling and rerun
     [ "$rc" != 2 ] && break
   else

@@ -82,18 +82,20 @@ class TestAutomaton:
 def test_cache_eviction_spares_protected_specs(params):
     """A refresh pass ensuring more specs than the cache cap must not
     evict one it ensured moments earlier (the serve loop indexes the
-    cache directly afterwards)."""
+    cache directly afterwards) — the refresh advertises its wave via
+    ``_guided_protect`` before ensuring."""
     generator = _generator(params)
     specs = [("choice", (f"spec-{i:02d}",)) for i in range(40)]
-    protect = frozenset(specs)
+    generator._guided_protect = frozenset(specs)
     for spec in specs:
-        generator._ensure_automaton(spec, protect=protect)
+        generator._ensure_automaton(spec)
     assert all(spec in generator._guided_cache for spec in specs)
-    # unprotected ensures still evict: the cache stays bounded once the
-    # protected wave is gone
+    # once the protect window closes, unprotected ensures evict again and
+    # the cache stays bounded
+    generator._guided_protect = frozenset()
     for i in range(40, 120):
         generator._ensure_automaton(("choice", (f"spec-{i}",)))
-    assert len(generator._guided_cache) <= len(protect) + 32
+    assert len(generator._guided_cache) <= 32
 
 
 @pytest.mark.parametrize("paged", [True, False])

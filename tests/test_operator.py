@@ -342,6 +342,39 @@ def test_readiness_gating():
     run(body())
 
 
+def test_readiness_engine_warmth_gating():
+    """Reference parity gates on the pattern cache; this system's heavy
+    dependency is the in-process engine (weight load + XLA compile), so a
+    warming engine must hold readiness down until grace elapses — while a
+    FAILED engine (operator degrades to pattern-only) must not."""
+
+    async def body():
+        import time
+
+        api = FakeKubeApi()
+        config = OperatorConfig(pattern_cache_directory="/nonexistent-xyz")
+        state = {"value": "loading"}
+        check = ReadinessCheck(api, config, engine_state=lambda: state["value"])
+        status = await check.check()
+        assert not status.ready and "warming" in status.reason
+        state["value"] = "ready"
+        status = await check.check()
+        assert status.ready and "engine warm" in status.reason
+        state["value"] = "failed"
+        status = await check.check()
+        assert status.ready and "degraded" in status.reason
+        state["value"] = "disabled"
+        assert (await check.check()).ready
+        # grace elapses: even a still-warming engine stops gating (a pod
+        # must not be unschedulable forever on a pathological compile)
+        state["value"] = "loading"
+        check.started_at = time.monotonic() - 301
+        status = await check.check()
+        assert status.ready and "grace elapsed" in status.reason
+
+    run(body())
+
+
 def test_readiness_with_cached_patterns(tmp_path):
     async def body():
         api = FakeKubeApi()

@@ -46,10 +46,18 @@ def test_operator_serves_completion_api_on_shared_engine():
         app = Operator(FakeKubeApi(), config=_config(completion_api_host="127.0.0.1"))
         await app.start()
         try:
+            # readiness must gate on engine warmth: cold engine = not ready
+            # (VERDICT r3 weak #7) even though the pattern gate passes
+            if not app.completion_task.done():
+                status = await app.readiness.check()
+                assert not status.ready and "warming" in status.reason
             # the API starts concurrently (weight load must not delay the
             # watcher); wait for its task before asserting
             await asyncio.wait_for(app.completion_task, timeout=120)
             assert app.completion_server is not None
+            assert app.engine_warmth == "ready"
+            status = await app.readiness.check()
+            assert status.ready and "engine warm" in status.reason
             port = app.completion_server.bound_port
             status, body = await _get(port, "/v1/models")
             assert status == 200 and body["data"][0]["id"] == "tiny-test"
@@ -104,6 +112,11 @@ def test_port_collision_degrades_quietly():
             await asyncio.wait_for(app.completion_task, timeout=120)
             assert app.completion_server is None  # degraded, not crashed
             assert app._tasks  # watcher/reconcilers are running
+            # a permanently failed engine must NOT unschedule the pod: the
+            # operator keeps serving pattern-only analyses
+            assert app.engine_warmth == "failed"
+            status = await app.readiness.check()
+            assert status.ready and "degraded" in status.reason
         finally:
             await app.stop()
             blocker.close()
