@@ -391,11 +391,55 @@ class CompletionServer:
                 self.engine.generator.validate_guided_regex(regex)
             except ValueError as exc:
                 raise ApiError(400, str(exc)) from None
+        schema = req.get("guided_json")
+        response_format = req.get("response_format")
+        if schema is None and isinstance(response_format, dict):
+            kind = response_format.get("type")
+            if kind == "json_schema":
+                # OpenAI wire shape: response_format.json_schema.schema
+                wrapper = response_format.get("json_schema")
+                if wrapper is not None and not isinstance(wrapper, dict):
+                    raise ApiError(400, "response_format.json_schema must be an object")
+                schema = (wrapper or {}).get("schema") or response_format.get("schema")
+                if schema is None:
+                    raise ApiError(
+                        400, "response_format json_schema needs a schema"
+                    )
+            elif kind == "json_object":
+                raise ApiError(
+                    400,
+                    "response_format json_object (free-form JSON) is not "
+                    "supported: arbitrary nesting is not a regular language; "
+                    "provide a schema via json_schema or guided_json",
+                )
+            elif kind not in (None, "text"):
+                raise ApiError(400, f"unknown response_format type {kind!r}")
+        if schema is not None:
+            if guided is not None or regex is not None:
+                raise ApiError(
+                    400,
+                    "guided_json is mutually exclusive with guided_choice "
+                    "and guided_regex",
+                )
+            if not isinstance(schema, (dict, str)):
+                raise ApiError(400, "guided_json must be a schema object or JSON string")
+            if len(json.dumps(schema) if isinstance(schema, dict) else schema) > 8192:
+                raise ApiError(400, "guided_json schema too large (>8192 bytes)")
+            from .json_schema import schema_to_regex
+
+            try:
+                # lower the schema onto the regex path: one automaton
+                # machinery end to end, validated here so a bad schema can
+                # never fail a co-batched wave
+                regex = schema_to_regex(schema)
+                self.engine.generator.validate_guided_regex(regex)
+            except ValueError as exc:
+                raise ApiError(400, str(exc)) from None
         params = SamplingParams(
             max_tokens=max_tokens, temperature=float(temperature),
             top_p=float(top_p), adapter=self._resolve_adapter(req),
             guided_choice=tuple(guided) if guided is not None else None,
-            guided_regex=regex,
+            guided_regex=regex,  # guided_json arrives lowered to a regex
         )
         return params, stop
 

@@ -374,6 +374,48 @@ def test_error_surface(server_port):
     assert status == 400 and "512" in body["error"]["message"]
 
 
+def test_guided_json_over_the_wire(server_port):
+    """guided_json (and the OpenAI response_format shape) constrain the
+    output to parse AND validate against the schema."""
+    schema = {
+        "type": "object",
+        "properties": {
+            "severity": {"enum": ["CRITICAL", "HIGH", "MEDIUM", "LOW"]},
+            "confident": {"type": "boolean"},
+        },
+    }
+    status, body = _request(
+        server_port, "POST", "/v1/completions",
+        {"prompt": "classify:", "max_tokens": 48, "guided_json": schema})
+    assert status == 200
+    doc = json.loads(body["choices"][0]["text"])
+    assert doc["severity"] in ("CRITICAL", "HIGH", "MEDIUM", "LOW")
+    assert isinstance(doc["confident"], bool)
+
+    # OpenAI wire shape: response_format.json_schema.schema
+    status, body = _request(
+        server_port, "POST", "/v1/chat/completions",
+        {"messages": [{"role": "user", "content": "classify"}],
+         "max_tokens": 48,
+         "response_format": {"type": "json_schema",
+                             "json_schema": {"name": "sev", "schema": schema}}})
+    assert status == 200
+    doc = json.loads(body["choices"][0]["message"]["content"])
+    assert doc["severity"] in ("CRITICAL", "HIGH", "MEDIUM", "LOW")
+
+    # free-form json_object is NOT a regular language: explicit 400
+    status, body = _request(
+        server_port, "POST", "/v1/completions",
+        {"prompt": "x", "response_format": {"type": "json_object"}})
+    assert status == 400 and "json_schema" in body["error"]["message"]
+
+    # unsupported schema shapes surface as 400s, not 500s
+    status, body = _request(
+        server_port, "POST", "/v1/completions",
+        {"prompt": "x", "guided_json": {"type": "object"}})
+    assert status == 400 and "properties" in body["error"]["message"]
+
+
 def test_oversized_request_maps_to_400():
     """OversizedRequest escaping submit-time validation is a CLIENT error
     (prompt bigger than the whole KV cache), not a 500."""

@@ -1,0 +1,173 @@
+"""JSON-Schema-constrained decoding (guided_json).
+
+A schema with fixed structure is a regular language, so it lowers to one
+regex and rides the existing guided_regex machinery (serving/regex_dfa).
+These tests check the lowering semantically (against Python's re on
+positive/negative documents), through the engine (generated text always
+parses AND validates), and over the HTTP wire including the OpenAI
+``response_format`` shape.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from operator_tpu.models import TINY_TEST, init_params
+from operator_tpu.models.tokenizer import ByteTokenizer
+from operator_tpu.serving.engine import BatchedGenerator, SamplingParams
+from operator_tpu.serving.json_schema import schema_to_regex
+
+SEVERITY = {
+    "type": "object",
+    "properties": {
+        "severity": {"enum": ["CRITICAL", "HIGH", "MEDIUM", "LOW"]},
+        "confident": {"type": "boolean"},
+    },
+}
+
+
+def full_match(schema: dict, text: str) -> bool:
+    return re.fullmatch(schema_to_regex(schema), text, re.DOTALL) is not None
+
+
+class TestLowering:
+    def test_scalars(self):
+        assert full_match({"type": "integer"}, "-42")
+        assert full_match({"type": "integer"}, "0")
+        assert not full_match({"type": "integer"}, "007")
+        assert full_match({"type": "number"}, "3.25e-2")
+        assert full_match({"type": "boolean"}, "false")
+        assert full_match({"type": "null"}, "null")
+        assert full_match({"type": "string"}, '"hi \\n there"')
+        assert not full_match({"type": "string"}, '"unterminated')
+
+    def test_string_bounds_and_escapes(self):
+        schema = {"type": "string", "minLength": 1, "maxLength": 3}
+        assert full_match(schema, '"ab"')
+        assert not full_match(schema, '""')
+        assert not full_match(schema, '"abcd"')
+        assert full_match({"type": "string"}, '"\\u00e9"')
+        # raw control bytes are forbidden inside JSON strings
+        assert not full_match({"type": "string"}, '"a\nb"')
+
+    def test_enum_and_const(self):
+        schema = {"enum": ["a b", 3, True, None]}
+        for doc in ('"a b"', "3", "true", "null"):
+            assert full_match(schema, doc)
+        assert not full_match(schema, '"c"')
+        assert full_match({"const": "x.y"}, '"x.y"')
+        assert not full_match({"const": "x.y"}, '"xzy"')  # dot is literal
+
+    def test_object_required_and_optional(self):
+        docs_ok = [
+            '{"severity":"LOW","confident":true}',
+            '{"severity":"HIGH","confident":false}',
+        ]
+        for doc in docs_ok:
+            assert full_match(SEVERITY, doc)
+        assert not full_match(SEVERITY, '{"severity":"nope","confident":true}')
+        # optional property may be omitted when not required
+        partial = {**SEVERITY, "required": ["severity"]}
+        assert full_match(partial, '{"severity":"LOW"}')
+        assert full_match(partial, '{"severity":"LOW","confident":true}')
+        # all-optional object: every subset (in order) incl. empty
+        allopt = {**SEVERITY, "required": []}
+        for doc in ("{}", '{"severity":"LOW"}', '{"confident":true}',
+                    '{"severity":"LOW","confident":true}'):
+            assert full_match(allopt, doc)
+
+    def test_array_bounds(self):
+        schema = {"type": "array", "items": {"type": "integer"},
+                  "minItems": 1, "maxItems": 3}
+        assert full_match(schema, "[1]")
+        assert full_match(schema, "[1,2,3]")
+        assert not full_match(schema, "[]")
+        assert not full_match(schema, "[1,2,3,4]")
+        empty_ok = {"type": "array", "items": {"type": "boolean"}}
+        assert full_match(empty_ok, "[]")
+
+    def test_nesting_and_alternation(self):
+        schema = {
+            "type": "object",
+            "properties": {
+                "tags": {"type": "array", "items": {"type": "string"},
+                         "maxItems": 2},
+                "code": {"anyOf": [{"type": "integer"}, {"type": "null"}]},
+            },
+        }
+        assert full_match(schema, '{"tags":["a","b"],"code":137}')
+        assert full_match(schema, '{"tags":[],"code":null}')
+        assert not full_match(schema, '{"tags":["a"],"code":"x"}')
+
+    def test_rejections(self):
+        for schema, err in [
+            ({"type": "object"}, "properties"),
+            ({"type": "array"}, "items"),
+            ({"$ref": "#/x"}, "not supported"),
+            ({"type": "object", "properties": {"a": {"type": "string"}},
+              "additionalProperties": True}, "additionalProperties"),
+            ({"type": "string", "maxLength": 500}, "maxLength"),
+            ({"type": "frobnicate"}, "unsupported schema"),
+            ("{not json", "not valid JSON"),
+            # malformed 'required' must be OUR ValueError (the HTTP layer
+            # maps only ValueError to 400), never a TypeError -> 500
+            ({"type": "object", "properties": {"a": {"type": "boolean"}},
+              "required": 5}, "list of property names"),
+            ({"type": "object", "properties": {"a": {"type": "boolean"}},
+              "required": [["a"]]}, "list of property names"),
+            ({"enum": [float("inf")]}, "no JSON representation"),
+            ({"type": "object", "properties": {
+                f"p{i}": {"type": "boolean"} for i in range(33)
+            }}, "at most 32"),
+        ]:
+            with pytest.raises(ValueError, match=err):
+                schema_to_regex(schema)
+
+    def test_lowered_pattern_budget(self):
+        # 32 all-optional properties with fat value schemas: the chain
+        # construction must hit the pattern budget, not stall the DFA
+        schema = {
+            "type": "object",
+            "required": [],
+            "properties": {
+                f"property-number-{i:02d}": {
+                    "enum": [f"value-{i}-{j}" for j in range(8)]
+                }
+                for i in range(32)
+            },
+        }
+        with pytest.raises(ValueError, match="16384 budget"):
+            schema_to_regex(schema)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def test_engine_output_validates_against_schema(params):
+    """Whatever the (random) model wants to say, the constrained output
+    parses as JSON and matches the schema."""
+    generator = BatchedGenerator(
+        params, TINY_TEST, ByteTokenizer(), max_slots=4, max_seq=128,
+        cache_dtype=jnp.float32, paged=True, page_size=16, decode_block=2,
+    )
+    regex = schema_to_regex(SEVERITY)
+    slots = generator.admit(
+        ["classify this oom kill", "and this crashloop"],
+        [SamplingParams(max_tokens=48, temperature=1.0, guided_regex=regex),
+         SamplingParams(max_tokens=48, temperature=0.7, guided_regex=regex)],
+    )
+    results = {}
+    while generator.num_active:
+        for slot_id, result in generator.step():
+            results[slot_id] = result
+    for slot_id in slots:
+        doc = json.loads(results[slot_id].text)
+        assert doc["severity"] in ("CRITICAL", "HIGH", "MEDIUM", "LOW")
+        assert isinstance(doc["confident"], bool)
