@@ -240,14 +240,8 @@ class BatchedGenerator:
         # time per round rather than the whole prompt's.  One job at a time;
         # its slots are RESERVED (not yet decoding) until the finish step
         # scatters the mini cache and samples the first token.  None = off.
-        if prefill_chunk is not None:
-            if prefill_chunk < 1:
-                raise ValueError(f"prefill_chunk={prefill_chunk} must be >= 1")
-            if mesh is not None:
-                raise ValueError(
-                    "prefill_chunk is not supported with a serving mesh yet; "
-                    "use one-shot prefill (dp-aware admission) on meshes"
-                )
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk={prefill_chunk} must be >= 1")
         self.prefill_chunk = prefill_chunk
         self._prefill_job: Optional[_PrefillJob] = None
         self._reserved: set[int] = set()
@@ -1372,6 +1366,15 @@ class BatchedGenerator:
         # finish program's successful return (_advance_prefill), so a
         # failure at any chunk leaves the device state untouched
         cache_ref = self.paged_cache.k_pages if self.paged else self.cache.k
+        mini = KVCache.create(self.config, n_pad, t_pad, dtype=cache_ref.dtype)
+        last_logits = jnp.zeros((n_pad, self.config.vocab_size), jnp.float32)
+        if self.mesh is not None:
+            # commit the carried device state to its program shardings once
+            # at job start; every later chunk keeps it in place (the chunk
+            # programs' in/out shardings match), so no per-chunk resharding
+            rows, _ = self._prefill_shardings(n_pad)
+            mini = self._jax.device_put(mini, self._shardings["cache"])
+            last_logits = self._jax.device_put(last_logits, rows)
         self._prefill_job = _PrefillJob(
             key=key,
             ids=jnp.asarray(ids),
@@ -1386,12 +1389,8 @@ class BatchedGenerator:
             adapter_idx=(
                 jnp.asarray(adapter_idx) if self.lora is not None else None
             ),
-            mini=KVCache.create(
-                self.config, n_pad, t_pad, dtype=cache_ref.dtype
-            ),
-            last_logits=jnp.zeros(
-                (n_pad, self.config.vocab_size), jnp.float32
-            ),
+            mini=mini,
+            last_logits=last_logits,
             written=0,
         )
         self._reserved.update(taken)
@@ -1403,6 +1402,7 @@ class BatchedGenerator:
         prompt ends inside this chunk."""
         jax, jnp = self._jax, self._jnp
         config = self.config
+        score_shards = self._prefill_score_shards()
 
         def chunk_fn(params, mini, ids_chunk, lengths, offset, last_logits,
                      lora=None, lora_idx=None):
@@ -1417,7 +1417,7 @@ class BatchedGenerator:
             logits, mini = forward(
                 params, config, ids_chunk, positions, cache=mini,
                 cache_offset=jnp.broadcast_to(offset, (n_pad,)),
-                kv_valid=kv_valid,
+                kv_valid=kv_valid, score_shards=score_shards,
                 lora=lora, lora_alpha=self.lora_alpha, lora_indices=lora_idx,
             )
             rel = lengths - 1 - offset  # last-token position, chunk-relative
@@ -1429,7 +1429,22 @@ class BatchedGenerator:
             last_logits = jnp.where(in_chunk[:, None], gathered, last_logits)
             return mini, last_logits
 
-        return jax.jit(chunk_fn)
+        if self.mesh is None:
+            return jax.jit(chunk_fn)
+        # mesh: same layout as the one-shot prefill programs — rows shard
+        # over the data axes (dp-aware admission pads the bucket), the
+        # mini cache shards like the big cache (batch over dp, heads over
+        # tp), and the chunk offset is a replicated scalar
+        s = self._shardings
+        rows, vec = self._prefill_shardings(n_pad)
+        return jax.jit(
+            chunk_fn,
+            in_shardings=(
+                self._param_shardings, s["cache"], rows, vec,
+                s["repl"], rows, s["repl"], vec,
+            ),
+            out_shardings=(s["cache"], rows),
+        )
 
     def _make_finish_fn(self, n_pad: int, t_pad: int, guided: bool = False):
         """Scatter the completed mini cache into the big cache / pages and
@@ -1478,7 +1493,30 @@ class BatchedGenerator:
                 )
                 return KVCache(k=k, v=v), first_tokens, rng, *extra
 
-        return jax.jit(finish_fn)
+        if self.mesh is None:
+            return jax.jit(finish_fn)
+        s = self._shardings
+        rows, vec = self._prefill_shardings(n_pad)
+        if self.paged:
+            # (paged, mini, lengths, row_tables, last_logits, rng, temp, top_p)
+            in_shardings = (
+                s["paged"], s["cache"], vec, rows, rows,
+                s["repl"], vec, vec,
+            )
+            out_shardings = (s["paged"], vec, s["repl"])
+        else:
+            # (cache, mini, lengths, slot_ids, last_logits, rng, temp, top_p)
+            in_shardings = (
+                s["cache"], s["cache"], vec, vec, rows,
+                s["repl"], vec, vec,
+            )
+            out_shardings = (s["cache"], vec, s["repl"])
+        if guided:
+            in_shardings += (s["repl"], vec)
+            out_shardings += (vec,)
+        return jax.jit(
+            finish_fn, in_shardings=in_shardings, out_shardings=out_shardings
+        )
 
     def _advance_prefill(self) -> None:
         """Run ONE chunk of the pending job (or its finish step)."""

@@ -17,12 +17,14 @@ never inside a co-batched wave):
   optional member independently carries its own comma; an all-optional
   object enumerates one chain per starting member, which is quadratic in
   the property count — hence the 32-property cap
-- ``type: string`` (optionally ``enum``/``const``, ``minLength``/
-  ``maxLength`` up to 64 — the regex engine's bounded-repeat cap)
+- ``type: string`` (optionally ``enum``/``const``; ``maxLength`` up to 64
+  — the regex engine's bounded-repeat cap — and unbounded when absent,
+  including with a bare ``minLength``)
 - ``type: integer`` / ``number`` (optionally ``enum``/``const``)
 - ``type: boolean`` / ``null``
 - ``enum`` / ``const`` of scalars at any position
-- ``type: array`` with ``items`` and ``minItems``/``maxItems`` <= 64
+- ``type: array`` with ``items``; ``minItems``/``maxItems`` <= 64, and
+  unbounded length when ``maxItems`` is absent
 - ``anyOf`` / ``oneOf`` -> alternation
 - nesting of all of the above
 
@@ -41,8 +43,13 @@ from __future__ import annotations
 import json
 from typing import Any
 
-#: bounded-repeat ceiling shared with regex_dfa.MAX_REPEAT
-_MAX_BOUND = 64
+from .regex_dfa import MAX_REPEAT as _MAX_BOUND  # bounded-repeat ceiling
+
+#: hard budget for the lowered pattern (and, checked at every recursion
+#: level, for any sub-pattern): construction doubles the item regex per
+#: nesting level (seq + repeat tail), so an after-the-fact check would
+#: let a ~2 KB deeply-nested schema build gigabyte strings first
+_PATTERN_BUDGET = 16384
 
 # JSON string body: any char except '"', '\' and control bytes, or an
 # escape sequence.  Byte-level classes, so non-ASCII rides as UTF-8.
@@ -106,6 +113,10 @@ def _bound(schema: dict, key: str, default: int) -> int:
 def _string_regex(schema: dict) -> str:
     if "minLength" in schema or "maxLength" in schema:
         lo = _bound(schema, "minLength", 0)
+        if "maxLength" not in schema:
+            # minLength alone must NOT silently impose a ceiling: emit the
+            # unbounded {m,} repeat (same openness as the default _STRING)
+            return f'"{_STRING_CHAR}{{{lo},}}"'
         hi = _bound(schema, "maxLength", _MAX_BOUND)
         if lo > hi:
             raise ValueError(f"minLength {lo} > maxLength {hi}")
@@ -174,14 +185,18 @@ def _array_regex(schema: dict) -> str:
             "a regular language)"
         )
     lo = _bound(schema, "minItems", 0)
-    hi = _bound(schema, "maxItems", _MAX_BOUND)
-    if lo > hi:
-        raise ValueError(f"minItems {lo} > maxItems {hi}")
     item = _schema_regex(items)
-    if hi == 0:
-        return r"\[\]"
-    # first item + up to hi-1 comma-separated others
-    more = f"(,{item}){{{max(0, lo - 1)},{hi - 1}}}"
+    if "maxItems" not in schema:
+        # no ceiling given: unbounded {m,} tail, not a silent 64 cap
+        more = f"(,{item}){{{max(0, lo - 1)},}}"
+    else:
+        hi = _bound(schema, "maxItems", _MAX_BOUND)
+        if lo > hi:
+            raise ValueError(f"minItems {lo} > maxItems {hi}")
+        if hi == 0:
+            return r"\[\]"
+        # first item + up to hi-1 comma-separated others
+        more = f"(,{item}){{{max(0, lo - 1)},{hi - 1}}}"
     seq = f"{item}{more}"
     if lo == 0:
         seq = f"({seq})?"
@@ -189,6 +204,19 @@ def _array_regex(schema: dict) -> str:
 
 
 def _schema_regex(schema: Any) -> str:
+    """Recursive lowering with the pattern budget enforced at EVERY level:
+    each nesting level embeds its child pattern up to twice, so checking
+    only the final string would first materialise ~2^depth bytes."""
+    regex = _schema_regex_impl(schema)
+    if len(regex) > _PATTERN_BUDGET:
+        raise ValueError(
+            f"schema lowers to a pattern above the {_PATTERN_BUDGET}-char "
+            f"budget — reduce optional properties, bounds, or nesting"
+        )
+    return regex
+
+
+def _schema_regex_impl(schema: Any) -> str:
     if not isinstance(schema, dict):
         raise ValueError(f"schema must be an object, got {type(schema).__name__}")
     for key in ("$ref", "$defs", "definitions", "patternProperties"):
@@ -244,17 +272,11 @@ def schema_to_regex(schema: "dict | str") -> str:
             schema = json.loads(schema)
         except json.JSONDecodeError as exc:
             raise ValueError(f"guided_json is not valid JSON: {exc}") from None
-    regex = _schema_regex(schema)
-    # user-typed guided_regex is capped at 1024 chars by the HTTP layer;
-    # schema-lowered regexes get a larger but still hard budget — NFA +
-    # subset construction run at submit time, and an unbounded expansion
-    # (nested all-optional objects) would stall the serving thread
-    if len(regex) > 16384:
-        raise ValueError(
-            f"schema lowers to a {len(regex)}-char pattern, above the 16384 "
-            f"budget — reduce optional properties, bounds, or nesting"
-        )
-    return regex
+    # the budget is enforced at every recursion level (_schema_regex);
+    # user-typed guided_regex is separately capped at 1024 chars by the
+    # HTTP layer — schema-lowered patterns get this larger budget because
+    # NFA + subset construction run at submit time on the serving thread
+    return _schema_regex(schema)
 
 
 __all__ = ["schema_to_regex"]

@@ -227,13 +227,6 @@ def build_serving_engine(
         )
 
     prefill_chunk = config.prefill_chunk or None
-    if prefill_chunk and mesh is not None:
-        log.warning(
-            "prefill_chunk=%d is not supported with a serving mesh yet; "
-            "falling back to one-shot prefill (long prompts will stall "
-            "in-flight decodes for their full prefill time)", prefill_chunk,
-        )
-        prefill_chunk = None
 
     generator = BatchedGenerator(
         params,

@@ -121,16 +121,52 @@ def test_generate_sync_with_chunking(params):
     assert result.token_ids == expected.token_ids
 
 
-def test_mesh_rejects_chunking(params):
+@pytest.mark.parametrize("paged", [True, False])
+def test_mesh_chunked_matches_mesh_oneshot(params, paged):
+    """Chunked prefill on a sharded dp/fsdp/tp mesh: the chunk and finish
+    programs carry the one-shot programs' shardings, so tokens must match
+    the mesh one-shot path exactly."""
     from operator_tpu.parallel import MeshPlan, make_mesh
 
     mesh = make_mesh(MeshPlan(dp=2, fsdp=2, tp=2), jax.devices("cpu"))
-    with pytest.raises(ValueError, match="prefill_chunk"):
-        BatchedGenerator(
+
+    def mesh_generator(prefill_chunk=None):
+        return BatchedGenerator(
             params, CONFIG, ByteTokenizer(), max_slots=4, max_seq=160,
-            cache_dtype=jnp.float32, paged=True, page_size=16, mesh=mesh,
-            prefill_chunk=64,
+            cache_dtype=jnp.float32, paged=paged, page_size=16,
+            decode_block=2, mesh=mesh, prefill_chunk=prefill_chunk,
         )
+
+    chunked = _drain(mesh_generator(prefill_chunk=64), PROMPTS)
+    oneshot = _drain(mesh_generator(), PROMPTS)
+    assert chunked == oneshot
+
+
+def test_mesh_chunked_interleaves_decodes(params):
+    """An in-flight decode keeps producing between a mesh job's chunks —
+    the Sarathi property the mesh support exists for."""
+    from operator_tpu.parallel import MeshPlan, make_mesh
+
+    mesh = make_mesh(MeshPlan(dp=2, tp=2), jax.devices("cpu")[:4])
+    generator = BatchedGenerator(
+        params, CONFIG, ByteTokenizer(), max_slots=4, max_seq=160,
+        cache_dtype=jnp.float32, paged=True, page_size=16,
+        decode_block=2, mesh=mesh, prefill_chunk=16,
+    )
+    [early] = generator.admit(["short prompt"], [GREEDY])
+    while generator._prefill_job is not None:
+        generator.step()
+    tokens_before = len(generator.slots[early].generated)
+    # long prompt becomes a chunked job; the early slot must advance
+    # while the job is still reserving its slots
+    generator.admit([PROMPTS[0]], [SamplingParams(
+        max_tokens=6, temperature=0.0, stop_on_eos=False)])
+    assert generator._prefill_job is not None
+    generator.step()
+    if generator._prefill_job is not None:  # still mid-job
+        assert len(generator.slots[early].generated) > tokens_before
+    while generator.num_active:
+        generator.step()
 
 
 def test_partial_final_chunk_parity(params):

@@ -506,3 +506,31 @@ def test_table_restack_between_job_chunks(params):
             results[slot_id] = result
     assert results[short].text in ("aa-first", "ab-second")
     assert results[job_slot].text in ("zz-last", "zz-least")
+
+
+def test_guided_chunked_prefill_on_mesh(params):
+    """All three features at once: a guided wave admitted via a chunked
+    prefill JOB on a sharded mesh — the guided finish program's mesh
+    shardings (tables replicated, first-state sharded with the batch)
+    must still land every row on its automaton."""
+    from operator_tpu.parallel import MeshPlan, make_mesh
+
+    mesh = make_mesh(MeshPlan(dp=2, tp=2), jax.devices("cpu")[:4])
+    generator = BatchedGenerator(
+        params, TINY_TEST, ByteTokenizer(), max_slots=4, max_seq=128,
+        cache_dtype=jnp.float32, paged=True, page_size=16, mesh=mesh,
+        decode_block=2, prefill_chunk=16,
+    )
+    long_prompt = "classify the severity of this oom killed pod " * 2
+    slots = generator.admit(
+        [long_prompt, "free " + long_prompt],
+        [SamplingParams(max_tokens=16, temperature=1.0, guided_choice=CHOICES),
+         SamplingParams(max_tokens=8, temperature=0.0, stop_on_eos=False)],
+    )
+    assert generator._prefill_job is not None  # long bucket -> chunked job
+    results = {}
+    while generator.num_active:
+        for slot_id, result in generator.step():
+            results[slot_id] = result
+    assert results[slots[0]].text in CHOICES
+    assert len(results[slots[1]].token_ids) == 8  # unconstrained neighbour

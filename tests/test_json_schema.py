@@ -141,8 +141,32 @@ class TestLowering:
                 for i in range(32)
             },
         }
-        with pytest.raises(ValueError, match="16384 budget"):
+        with pytest.raises(ValueError, match="char budget"):
             schema_to_regex(schema)
+
+    def test_deep_nesting_rejected_fast(self):
+        """Construction doubles the item pattern per nesting level, so the
+        budget must fire DURING recursion: a ~2 KB schema of 45 nested
+        arrays would otherwise materialise a ~2^45-byte string before an
+        after-the-fact check could run."""
+        import time
+
+        schema: dict = {"type": "integer"}
+        for _ in range(45):
+            schema = {"type": "array", "items": schema, "maxItems": 1}
+        t0 = time.perf_counter()
+        with pytest.raises(ValueError, match="char budget"):
+            schema_to_regex(schema)
+        assert time.perf_counter() - t0 < 1.0
+
+    def test_min_without_max_is_unbounded(self):
+        # a lower bound alone must not smuggle in a 64 ceiling
+        schema = {"type": "string", "minLength": 2}
+        assert full_match(schema, '"' + "x" * 200 + '"')
+        assert not full_match(schema, '"x"')
+        arr = {"type": "array", "items": {"type": "boolean"}, "minItems": 2}
+        assert full_match(arr, "[" + ",".join(["true"] * 80) + "]")
+        assert not full_match(arr, "[true]")
 
 
 @pytest.fixture(scope="module")
