@@ -421,17 +421,13 @@ class CompletionServer:
                     "guided_json is mutually exclusive with guided_choice "
                     "and guided_regex",
                 )
-            if not isinstance(schema, (dict, str)):
-                raise ApiError(400, "guided_json must be a schema object or JSON string")
-            if len(json.dumps(schema) if isinstance(schema, dict) else schema) > 8192:
-                raise ApiError(400, "guided_json schema too large (>8192 bytes)")
-            from .json_schema import schema_to_regex
+            from .json_schema import lower_guided_json
 
             try:
                 # lower the schema onto the regex path: one automaton
                 # machinery end to end, validated here so a bad schema can
                 # never fail a co-batched wave
-                regex = schema_to_regex(schema)
+                regex = lower_guided_json(schema)
                 self.engine.generator.validate_guided_regex(regex)
             except ValueError as exc:
                 raise ApiError(400, str(exc)) from None

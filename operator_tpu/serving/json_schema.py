@@ -279,4 +279,27 @@ def schema_to_regex(schema: "dict | str") -> str:
     return _schema_regex(schema)
 
 
-__all__ = ["schema_to_regex"]
+#: input-size ceiling shared by every guided_json entry point
+MAX_SCHEMA_BYTES = 8192
+
+
+def lower_guided_json(schema: Any) -> str:
+    """Validate + lower a user-supplied guided_json value to a regex.
+
+    The ONE front door for both entry points — the HTTP API
+    (serving/httpserver.py) and AIProvider ``additionalConfig``
+    (serving/provider.py) — so input-shape checks and the schema-size cap
+    can never drift between them.  Raises ValueError on anything
+    unservable.
+    """
+    if not isinstance(schema, (dict, str)):
+        raise ValueError("guided_json must be a schema object or JSON string")
+    encoded = json.dumps(schema) if isinstance(schema, dict) else schema
+    if len(encoded) > MAX_SCHEMA_BYTES:
+        raise ValueError(
+            f"guided_json schema too large (>{MAX_SCHEMA_BYTES} bytes)"
+        )
+    return schema_to_regex(schema)
+
+
+__all__ = ["MAX_SCHEMA_BYTES", "lower_guided_json", "schema_to_regex"]

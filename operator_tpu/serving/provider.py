@@ -77,13 +77,41 @@ class TPUNativeProvider:
         # per-CR LoRA adapter (multi-LoRA serving): AIProvider
         # spec.additionalConfig.lora_adapter names a registered adapter;
         # different CRs then share one batch with different adapters
-        adapter = (config.additional_config.get("lora_adapter") or None) if config else None
+        extra = (config.additional_config or {}) if config else {}
+        adapter = extra.get("lora_adapter") or None
+        # per-CR constrained decoding: additionalConfig may carry a
+        # guided_regex pattern or a guided_json schema (JSON text, lowered
+        # onto the same regex automaton) — reference parity: the CR's
+        # additionalConfig flows verbatim to the AI backend
+        # (AIInterfaceClient.java:71-105); here it reaches the sampler.
+        # A bad pattern/schema is a CONFIG error: fail this provider's
+        # generation (pipeline stores the pattern-only result) rather than
+        # silently dropping the constraint the CR asked for.
+        guided_regex = extra.get("guided_regex") or None
+        guided_schema = extra.get("guided_json") or None
+        if guided_schema is not None:
+            if guided_regex is not None:
+                return AIResponse(
+                    error="additionalConfig guided_json and guided_regex are "
+                          "mutually exclusive",
+                    provider_id="tpu-native", model_id=self.model_id,
+                )
+            from .json_schema import lower_guided_json
+
+            try:
+                guided_regex = lower_guided_json(guided_schema)
+            except ValueError as exc:
+                return AIResponse(
+                    error=f"additionalConfig.guided_json: {exc}",
+                    provider_id="tpu-native", model_id=self.model_id,
+                )
         params = SamplingParams(
             max_tokens=(config.max_tokens if config and config.max_tokens else 500),
             temperature=(
                 config.temperature if config and config.temperature is not None else 0.3
             ),
             adapter=adapter,
+            guided_regex=guided_regex,
         )
         try:
             # priority 10: pod-failure explanations admit ahead of external

@@ -361,6 +361,63 @@ class TestTPUNativeProvider:
         assert response.provider_id == "tpu-native"
         assert response.completion_tokens >= 1
 
+    def test_guided_via_additional_config(self, generator):
+        """AIProvider additionalConfig carries guided_json/guided_regex to
+        the sampler (reference parity: additionalConfig flows verbatim to
+        the AI backend) — the explanation is then schema-shaped."""
+        _reset(generator)
+        import json as jsonlib
+
+        from operator_tpu.schema.analysis import (
+            AIProviderConfig,
+            AnalysisRequest,
+            AnalysisResult,
+            AnalysisSummary,
+        )
+        from operator_tpu.serving.provider import TPUNativeProvider
+
+        schema = jsonlib.dumps({
+            "type": "object",
+            "properties": {
+                "severity": {"enum": ["CRITICAL", "HIGH", "MEDIUM", "LOW"]},
+            },
+        })
+
+        def request(extra):
+            return AnalysisRequest(
+                analysis_result=AnalysisResult(
+                    summary=AnalysisSummary(
+                        highest_severity="HIGH", significant_events=1,
+                        total_events=1, score=0.9,
+                    )
+                ),
+                provider_config=AIProviderConfig(
+                    provider_id="tpu-native", max_tokens=64, temperature=0.8,
+                    additional_config=extra,
+                ),
+            )
+
+        async def main():
+            engine = ServingEngine(generator)
+            await engine.start()
+            try:
+                provider = TPUNativeProvider(engine, model_id="tiny-test")
+                good = await provider.generate(request({"guided_json": schema}))
+                bad = await provider.generate(
+                    request({"guided_json": '{"type": "object"}'})
+                )
+                return good, bad
+            finally:
+                await engine.close()
+
+        good, bad = asyncio.run(main())
+        assert good.error is None
+        doc = jsonlib.loads(good.explanation)
+        assert doc["severity"] in ("CRITICAL", "HIGH", "MEDIUM", "LOW")
+        # a bad schema is a CONFIG error surfaced on the response, which
+        # the pipeline turns into a pattern-only degradation
+        assert bad.error is not None and "guided_json" in bad.error
+
 
 class TestDecodeAheadPipelining:
     """pipeline_depth > 1 keeps a decode block in flight while the host
