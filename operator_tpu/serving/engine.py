@@ -319,6 +319,14 @@ class BatchedGenerator:
             self.allocator = PageAllocator(num_pages)
             self.cache = None
             self._alloc_decode_state()
+            # ---- shared-prefix KV cache (set_shared_prefix): one prompt
+            # prefix prefilled ONCE into generator-owned pages; admitted
+            # prompts that start with it reference those pages read-only
+            # and prefill only their suffix
+            self._prefix_tokens: list[int] = []
+            self._prefix_pages: list[int] = []
+            self._prefix_text: Optional[str] = None
+            self._prefix_fns: dict[tuple, Any] = {}  # (n_pad, t_sfx, shared, guided)
             if mesh is not None:
                 s = self._shardings
                 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -1010,6 +1018,228 @@ class BatchedGenerator:
         )
 
     # ------------------------------------------------------------------
+    # shared-prefix KV cache (automatic prefix caching, paged mode)
+    # ------------------------------------------------------------------
+
+    def set_shared_prefix(self, text: str) -> int:
+        """Prefill ``text``'s KV ONCE into generator-owned pages; later
+        prompts that start with it skip recomputing that prefix.
+
+        The serving workload this system exists for shares one prompt
+        template across every request (SURVEY.md §2.2: 32 concurrent
+        failure events -> one prefill), so the template's static preamble
+        is prefilled once and every admission forwards only its suffix —
+        the vLLM "automatic prefix caching" idea reduced to the one shared
+        prefix that actually occurs, with no radix tree and no refcounts:
+        the prefix pages are OWNED by the generator (never in any slot's
+        grant, so sequence teardown can never free them).
+
+        Sharing is decided per admission wave by TOKEN comparison (BPE
+        boundaries need not align with the text prefix) and rounded down
+        to whole pages; a wave with any non-matching prompt falls back to
+        the ordinary full prefill.  Note one interaction: admission
+        tail-truncates over-budget prompts (evidence concentrates at the
+        tail), which cuts the PREFIX off — so prompts longer than
+        ``max_seq - max_tokens`` silently lose the fast path.  Paged mode
+        only.  Returns the number of prefix tokens cached (0 = nothing
+        cached).
+        """
+        jnp = self._jnp
+        if not self.paged:
+            log.warning("set_shared_prefix needs paged KV; ignoring")
+            return 0
+        if self.num_active:
+            # live slots' page tables may reference the CURRENT prefix
+            # pages; releasing them mid-decode would hand another wave
+            # pages a live sequence still attends over
+            raise RuntimeError(
+                "set_shared_prefix requires an idle engine "
+                f"({self.num_active} sequences active)"
+            )
+        tokens = self.tokenizer.encode(text)
+        # leave at least one page of room for every suffix + generation,
+        # and at least one suffix token so the sampled first token always
+        # has a logit row (admission additionally enforces this per wave)
+        max_keep = self.max_seq - max(self.page_size, 64)
+        n_keep = (min(len(tokens) - 1, max_keep) // self.page_size) * self.page_size
+        if n_keep < self.page_size:
+            log.warning("shared prefix shorter than one page; not caching")
+            return 0
+        if self._prefix_pages:
+            self.allocator.release(self._prefix_pages)
+            self._prefix_pages = []
+            self._prefix_tokens = []
+            self._prefix_fns.clear()
+        pages = self.allocator.allocate(n_keep // self.page_size)
+        config, jax = self.config, self._jax
+        score_shards = self._prefill_score_shards() if self.mesh is not None else 1
+
+        def build_fn(params, paged, ids, table):
+            from ..ops.paged_attention import write_tokens
+
+            mini = KVCache.create(config, 1, n_keep, dtype=paged.k_pages.dtype)
+            positions = jnp.arange(n_keep, dtype=jnp.int32)[None]
+            kv_valid = jnp.ones((1, n_keep), bool)
+            lengths = jnp.full((1,), n_keep, jnp.int32)
+            _, mini = forward(
+                params, config, ids, positions, cache=mini, cache_offset=0,
+                kv_valid=kv_valid, score_shards=score_shards,
+                prefill_lengths=lengths,
+            )
+            zero = jnp.zeros((1,), jnp.int32)
+            scatter = jax.vmap(write_tokens, in_axes=(0, None, 0, None, None))
+            from ..ops.paged_attention import PagedKVCache
+
+            return PagedKVCache(
+                k_pages=scatter(paged.k_pages, table, mini.k, zero, lengths),
+                v_pages=scatter(paged.v_pages, table, mini.v, zero, lengths),
+                page_table=paged.page_table, lengths=paged.lengths,
+            )
+
+        if self.mesh is not None:
+            s = self._shardings
+            build = jax.jit(
+                build_fn,
+                in_shardings=(
+                    self._param_shardings, s["paged"], s["repl"], s["repl"]
+                ),
+                out_shardings=s["paged"],
+            )
+        else:
+            build = jax.jit(build_fn)
+        try:
+            self.paged_cache = build(
+                self.params,
+                self.paged_cache,
+                jnp.asarray([tokens[:n_keep]], jnp.int32),
+                jnp.asarray([pages], jnp.int32),
+            )
+        except BaseException:
+            self.allocator.release(pages)
+            raise
+        self._prefix_tokens = tokens[:n_keep]
+        self._prefix_pages = pages
+        self._prefix_text = text
+        log.info("shared prefix cached: %d tokens in %d pages", n_keep, len(pages))
+        return n_keep
+
+    def _wave_shared_prefix(
+        self, token_lists: list, params_list: "Sequence[SamplingParams]"
+    ) -> int:
+        """Whole-page prefix-token count shared by EVERY prompt in the
+        wave (0 = at least one prompt diverges before a full page).
+
+        LoRA waves never share: adapters modify the K/V projections, so
+        the base-model prefix KV would not equal what a full prefill with
+        the adapter computes — reuse must stay EXACT."""
+        if not (self.paged and self._prefix_tokens and token_lists):
+            return 0
+        if any(p.adapter for p in params_list):
+            return 0
+        shared = len(self._prefix_tokens)
+        for toks in token_lists:
+            common = 0
+            for a, b in zip(toks, self._prefix_tokens):
+                if a != b:
+                    break
+                common += 1
+            # every row must keep >=1 suffix token: its first sampled
+            # token needs a logit row in the suffix program
+            shared = min(shared, common, len(toks) - 1)
+        return (shared // self.page_size) * self.page_size
+
+    def _make_prefill_paged_prefixed(
+        self, n_pad: int, t_sfx: int, shared: int, guided: bool = False
+    ):
+        """Suffix-only prefill: the first ``shared`` tokens' KV is gathered
+        from the cached prefix pages into the mini cache (read-only reuse),
+        and only ``t_sfx`` suffix tokens run through the model."""
+        jax, jnp = self._jax, self._jnp
+        config = self.config
+        score_shards = self._prefill_score_shards()
+        n_prefix_pages = shared // self.page_size
+        t_total = shared + t_sfx
+
+        def prefill_fn(params, paged, prefix_table, token_ids, lengths,
+                       row_tables, rng, temp, top_p,
+                       lora=None, lora_idx=None, gtables=None, gaut=None):
+            from ..ops.paged_attention import PagedKVCache, write_tokens
+
+            # prefix KV: pages -> contiguous [L, shared, KH, D], shared by
+            # every row of the mini cache (broadcast, not per-row copies)
+            def gather(pages):
+                picked = pages[:, prefix_table]  # [L, n_pp, ps, KH, D]
+                return picked.reshape(
+                    pages.shape[0], shared, *pages.shape[3:]
+                )
+
+            mini = KVCache.create(config, n_pad, t_total, dtype=paged.k_pages.dtype)
+            mini = KVCache(
+                k=mini.k.at[:, :, :shared].set(
+                    gather(paged.k_pages).astype(mini.k.dtype)[:, None]
+                ),
+                v=mini.v.at[:, :, :shared].set(
+                    gather(paged.v_pages).astype(mini.v.dtype)[:, None]
+                ),
+            )
+            positions = shared + jnp.broadcast_to(
+                jnp.arange(t_sfx, dtype=jnp.int32)[None], (n_pad, t_sfx)
+            )
+            kv_positions = jnp.broadcast_to(
+                jnp.arange(t_total, dtype=jnp.int32)[None], (n_pad, t_total)
+            )
+            kv_valid = kv_positions < lengths[:, None]
+            logits, mini = forward(
+                params, config, token_ids, positions, cache=mini,
+                cache_offset=jnp.full((n_pad,), shared, jnp.int32),
+                kv_valid=kv_valid, score_shards=score_shards,
+                lora=lora, lora_alpha=self.lora_alpha, lora_indices=lora_idx,
+            )
+            # scatter ONLY the suffix into this wave's own pages — the
+            # prefix pages are shared and must never be rewritten
+            start = jnp.full((n_pad,), shared, jnp.int32)
+            suffix_len = lengths - shared
+            suffix_k = jax.lax.slice_in_dim(mini.k, shared, t_total, axis=2)
+            suffix_v = jax.lax.slice_in_dim(mini.v, shared, t_total, axis=2)
+            zero_scatter = jax.vmap(write_tokens, in_axes=(0, None, 0, None, None))
+            k_pages = zero_scatter(paged.k_pages, row_tables, suffix_k, start, suffix_len)
+            v_pages = zero_scatter(paged.v_pages, row_tables, suffix_v, start, suffix_len)
+            last = jnp.take_along_axis(
+                logits, (lengths - 1 - shared)[:, None, None].astype(jnp.int32),
+                axis=1,
+            )[:, 0, :]
+            if guided:
+                row = gtables[gaut, jnp.zeros_like(gaut)]
+                last = jnp.where(row >= 0, last, -jnp.inf)
+            first_tokens, rng = self._sample(last, rng, temp, top_p)
+            new_paged = PagedKVCache(
+                k_pages=k_pages, v_pages=v_pages,
+                page_table=paged.page_table, lengths=paged.lengths,
+            )
+            if guided:
+                first_state = jnp.take_along_axis(
+                    row, first_tokens[:, None], axis=1
+                )[:, 0]
+                return new_paged, first_tokens, rng, jnp.maximum(first_state, 0)
+            return new_paged, first_tokens, rng
+
+        if self.mesh is None:
+            return jax.jit(prefill_fn)
+        s = self._shardings
+        rows, vec = self._prefill_shardings(n_pad)
+        in_shardings = (
+            self._param_shardings, s["paged"], s["repl"], rows, vec, rows,
+            s["repl"], vec, vec, s["repl"], vec,
+        )
+        out_shardings = (s["paged"], vec, s["repl"])
+        if guided:
+            in_shardings += (s["repl"], vec)
+            out_shardings += (vec,)
+        return jax.jit(
+            prefill_fn, in_shardings=in_shardings, out_shardings=out_shardings
+        )
+
+    # ------------------------------------------------------------------
     # host-side API
     # ------------------------------------------------------------------
 
@@ -1083,12 +1313,28 @@ class BatchedGenerator:
         self.guided_state = None
         if self.paged:
             self.allocator = PageAllocator(self.allocator.num_pages)
+            self._prefix_tokens = []
+            self._prefix_pages = []
+            self._prefix_fns.clear()
         self._alloc_decode_state()
         for i in range(self.max_slots):
             self._slot_epoch[i] += 1  # orphan any in-flight device tokens
             self.slots[i] = _Slot()
         self._host_offsets[:] = 0
         self._sampling_cache = None
+        if self.paged and self._prefix_text:
+            # the page pool was rebuilt: re-prime the shared prefix so
+            # post-recovery admissions keep their fast path.  Guarded: a
+            # failed re-prime must not fail the RECOVERY — serving without
+            # the optimisation beats staying down (_try_recover treats a
+            # reset() exception as fatal)
+            try:
+                self.set_shared_prefix(self._prefix_text)
+            except Exception:  # noqa: BLE001
+                log.warning(
+                    "shared-prefix re-prime failed after reset; serving "
+                    "without it", exc_info=True,
+                )
 
     def free_slots(self) -> list[int]:
         return [
@@ -1138,14 +1384,18 @@ class BatchedGenerator:
 
         page_grants: list[list[int]] = []
         if self.paged:
+            # shared-prefix reuse: when EVERY prompt starts with the cached
+            # prefix, rows reference the generator-owned prefix pages and
+            # allocate (and later prefill) only their suffix
+            shared = self._wave_shared_prefix(token_lists, params_list)
+            pool = self.allocator.num_pages - 1 - len(self._prefix_pages)
             for toks, sampling in zip(token_lists, params_list):
                 total = min(len(toks) + sampling.max_tokens, self.max_seq)
-                need = -(-total // self.page_size)
-                if need > self.allocator.num_pages - 1:
+                need = -(-total // self.page_size) - shared // self.page_size
+                if need > pool:
                     if not page_grants:
                         raise OversizedRequest(
-                            f"request needs {need} KV pages, cache holds "
-                            f"{self.allocator.num_pages - 1}"
+                            f"request needs {need} KV pages, cache holds {pool}"
                         )
                     break
                 try:
@@ -1157,7 +1407,10 @@ class BatchedGenerator:
             token_lists = token_lists[: len(page_grants)]
             params_list = params_list[: len(page_grants)]
             try:
-                return self._admit_batch(token_lists, params_list, page_grants, started)
+                return self._admit_batch(
+                    token_lists, params_list, page_grants, started,
+                    prefix_shared=shared,
+                )
             except BaseException:
                 for grant in page_grants:  # don't leak pages on prefill failure
                     self.allocator.release(grant)
@@ -1170,10 +1423,15 @@ class BatchedGenerator:
         params_list: Sequence[SamplingParams],
         page_grants: list[list[int]],
         started: float,
+        prefix_shared: int = 0,
     ) -> list[int]:
         jnp = self._jnp
         free = self.free_slots()
         n = len(token_lists)
+        if prefix_shared:
+            # shared-prefix wave: the program sees only suffixes; lengths
+            # stay FULL (decode appends at the true sequence length)
+            token_lists = [toks[prefix_shared:] for toks in token_lists]
         max_len = max(len(t) for t in token_lists)
         n_pad = _bucket(n, 1, self.max_slots)
         if self.mesh is not None:
@@ -1194,7 +1452,7 @@ class BatchedGenerator:
         taken = free[:n]
         for row, (toks, sampling) in enumerate(zip(token_lists, params_list)):
             ids[row, : len(toks)] = toks
-            lengths[row] = len(toks)
+            lengths[row] = len(toks) + prefix_shared  # FULL sequence length
             temp[row] = sampling.temperature
             top_p[row] = sampling.top_p
             slot_ids[row] = taken[row]
@@ -1229,11 +1487,47 @@ class BatchedGenerator:
             self.prefill_chunk is not None
             and t_pad > self.prefill_chunk
             and self._prefill_job is None
+            and not prefix_shared  # suffix-only prefill is already short
         ):
             return self._start_prefill_job(
                 key, ids, lengths, temp, top_p, slot_ids, adapter_idx,
                 token_lists, params_list, page_grants, taken,
             )
+        if prefix_shared:
+            pkey = (n_pad, t_pad, prefix_shared, guided)
+            if pkey not in self._prefix_fns:
+                log.info(
+                    "compiling prefixed prefill bucket n=%d t_sfx=%d shared=%d "
+                    "(guided=%s)", n_pad, t_pad, prefix_shared, guided,
+                )
+                self._prefix_fns[pkey] = self._make_prefill_paged_prefixed(
+                    n_pad, t_pad, prefix_shared, guided
+                )
+            staged, row_tables = self._stage_page_tables(
+                n, n_pad, slot_ids, page_grants, lengths,
+                prefix_shared=prefix_shared,
+            )
+            prefix_table = jnp.asarray(
+                self._prefix_pages[: prefix_shared // self.page_size], jnp.int32
+            )
+            outs = self._prefix_fns[pkey](
+                self.params, staged, prefix_table, jnp.asarray(ids),
+                jnp.asarray(lengths), jnp.asarray(row_tables), self._rng,
+                jnp.asarray(temp), jnp.asarray(top_p), self.lora,
+                jnp.asarray(adapter_idx) if self.lora is not None else None,
+                *((self._guided_tables, jnp.asarray(row_aut)) if guided else ()),
+            )
+            if guided:
+                self.paged_cache, first_tokens, self._rng, first_state = outs
+            else:
+                self.paged_cache, first_tokens, self._rng = outs
+            result = self._activate_slots(
+                np.asarray(first_tokens), lengths, taken, params_list,
+                page_grants, (time.perf_counter() - started) * 1e3,
+            )
+            if guided:
+                self._apply_guided_activation(row_aut, taken, first_state)
+            return result
         key = (n_pad, t_pad, guided)
         if key not in self._prefill_fns:
             log.info("compiling prefill bucket n=%d t=%d (paged=%s guided=%s)",
@@ -1315,7 +1609,8 @@ class BatchedGenerator:
         return list(taken)
 
     def _stage_page_tables(
-        self, n: int, n_pad: int, slot_ids, page_grants, lengths
+        self, n: int, n_pad: int, slot_ids, page_grants, lengths,
+        prefix_shared: int = 0,
     ):
         """Build the wave's page-table rows and a STAGED cache carrying
         them (shared by one-shot and chunked prefill); padding rows
@@ -1332,8 +1627,14 @@ class BatchedGenerator:
 
         jnp = self._jnp
         row_tables = np.zeros((n_pad, self.pages_per_seq), np.int32)
+        n_prefix = prefix_shared // self.page_size if prefix_shared else 0
         for row, grant in enumerate(page_grants):
-            row_tables[row, : len(grant)] = grant
+            if n_prefix:
+                # shared-prefix wave: every row's table starts with the
+                # generator-owned prefix pages (read-only; never in the
+                # grant, so slot teardown cannot free them)
+                row_tables[row, :n_prefix] = self._prefix_pages[:n_prefix]
+            row_tables[row, n_prefix: n_prefix + len(grant)] = grant
         for row in range(n, n_pad):
             row_tables[row] = row_tables[0]
         paged = self.paged_cache

@@ -17,7 +17,18 @@ from typing import Optional
 
 from ..schema.analysis import AnalysisRequest, AnalysisResult
 
-DEFAULT_TEMPLATE = """You are a Kubernetes failure analyst. A pod failed; explain why.
+#: the preamble before the first placeholder is STATIC across every
+#: request, so the engine caches its KV once (set_shared_prefix) and each
+#: admission prefills only the variable remainder — keep new static
+#: instructions above the first ``{`` and variable content below it
+DEFAULT_TEMPLATE = """You are a Kubernetes failure analyst. A pod failed; your job is to name the root cause and the most direct fix.
+
+Ground rules:
+- Trust the pattern analysis and the quoted log evidence over speculation; if they conflict, say which you believe and why.
+- Distinguish the root cause from its symptoms (a CrashLoopBackOff is a symptom; the exception or exit code behind it is the cause).
+- Common causes worth checking against the evidence: out-of-memory kills (exit 137, OOMKilled), failed liveness/readiness probes, image pull errors, missing config/secrets, permission errors, disk pressure or eviction, dependency outages (databases, DNS, upstream services), and application exceptions at startup.
+- Name concrete Kubernetes objects and fields in the fix when the evidence identifies them (limits, probes, image tags, env vars).
+- If the evidence is insufficient for a confident diagnosis, say so and name the single most useful signal to collect next.
 
 Pod: {pod_name} (namespace {namespace})
 Pattern analysis (severity {severity}): {patterns}

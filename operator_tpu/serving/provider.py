@@ -89,6 +89,17 @@ class TPUNativeProvider:
         # silently dropping the constraint the CR asked for.
         guided_regex = extra.get("guided_regex") or None
         guided_schema = extra.get("guided_json") or None
+        if guided_regex is not None and (
+            not isinstance(guided_regex, str) or len(guided_regex) > 1024
+        ):
+            # same bound the HTTP entry point enforces: DFA compilation
+            # runs synchronously at submit time, so an unbounded pattern
+            # from one misconfigured CR could stall the serving thread
+            return AIResponse(
+                error="additionalConfig.guided_regex must be a string of "
+                      "<=1024 chars",
+                provider_id="tpu-native", model_id=self.model_id,
+            )
         if guided_schema is not None:
             if guided_regex is not None:
                 return AIResponse(
@@ -273,6 +284,20 @@ def build_serving_engine(
         lora_alpha=config.lora_alpha,
         prefill_chunk=prefill_chunk,
     )
+    if config.prefix_cache and generator.paged:
+        # the default template's static preamble is shared by every
+        # explanation request: cache its KV once so each admission
+        # prefills only its variable remainder.  CRs with a custom
+        # promptTemplate simply fall back to full prefill (the engine
+        # compares TOKENS per wave; a non-matching wave costs nothing).
+        from .prompts import DEFAULT_TEMPLATE
+
+        static_preamble = DEFAULT_TEMPLATE.split("{", 1)[0]
+        try:
+            generator.set_shared_prefix(static_preamble)
+        except Exception:  # noqa: BLE001 - an optimisation must never block startup
+            log.warning("shared-prefix priming failed; serving without it",
+                        exc_info=True)
     return ServingEngine(generator), model_id
 
 

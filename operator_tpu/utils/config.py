@@ -73,6 +73,10 @@ class OperatorConfig:
     # tokens per engine round so long prefills don't stall in-flight
     # decodes; 0 = one-shot prefill (power of two when set)
     prefill_chunk: int = 0
+    # shared-prefix KV caching (engine.set_shared_prefix): the default
+    # prompt template's static preamble is prefilled once and admissions
+    # forward only their suffix; paged mode only, exact (causal) reuse
+    prefix_cache: bool = True
     # nucleus-sampling candidate set (engine SAMPLE_TOP_K): top-p filtering
     # runs inside the top-k — raise for high-temperature diversity
     sample_top_k: int = 64

@@ -207,9 +207,13 @@ async def main() -> int:
         if generator.paged:
             allocator = generator.allocator
             free = len(allocator._free)
-            total = allocator.num_pages - 1  # minus the trash page
+            # minus the trash page and the generator-owned shared-prefix
+            # pages (held for the engine's lifetime by design)
+            held = len(getattr(generator, "_prefix_pages", []) or [])
+            total = allocator.num_pages - 1 - held
             if free != total:
-                leaks["kv_pages"] = {"free": free, "total": total}
+                leaks["kv_pages"] = {"free": free, "total": total,
+                                     "prefix_held": held}
         if generator.num_active:
             leaks["active_slots"] = generator.num_active
         if generator._reserved:

@@ -1,0 +1,180 @@
+"""Shared-prefix KV caching (automatic prefix caching, paged mode).
+
+The serving workload shares one prompt template across every request
+(BASELINE config 4: 32 concurrent failure events -> one prefill), so the
+template's preamble is prefilled ONCE into generator-owned pages and
+admissions forward only their suffix.  The hard guarantees:
+
+- causal attention makes prefix reuse mathematically exact: greedy
+  tokens match the uncached path
+- prefix pages are never freed by sequence teardown (they are not in
+  any slot's grant) and page accounting balances after waves finish
+- waves whose prompts do not all share the prefix fall back to the
+  ordinary full prefill
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from operator_tpu.models import TINY_TEST, init_params
+from operator_tpu.models.tokenizer import ByteTokenizer
+from operator_tpu.serving.engine import BatchedGenerator, SamplingParams
+
+PREFIX = (
+    "You are a Kubernetes failure analyst. Explain the failure using the "
+    "pattern evidence and log excerpts provided below; answer with Root "
+    "Cause and Fix sections. "
+)  # ~150 byte-tokens -> several 16-token pages
+
+GREEDY = SamplingParams(max_tokens=6, temperature=0.0, stop_on_eos=False)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def _generator(params, **kw):
+    return BatchedGenerator(
+        params, TINY_TEST, ByteTokenizer(), max_slots=4,
+        max_seq=kw.pop("max_seq", 512), cache_dtype=jnp.float32, paged=True,
+        page_size=16, decode_block=2, **kw,
+    )
+
+
+def _drain(generator, prompts, sampling=None):
+    slots = generator.admit(prompts, [sampling or GREEDY] * len(prompts))
+    results = {}
+    while generator.num_active:
+        for slot_id, result in generator.step():
+            results[slot_id] = result
+    return [results[s].token_ids for s in slots]
+
+
+def test_set_prefix_accounting(params):
+    generator = _generator(params)
+    before = generator.allocator.available
+    cached = generator.set_shared_prefix(PREFIX)
+    assert cached > 0 and cached % generator.page_size == 0
+    held = cached // generator.page_size
+    assert generator.allocator.available == before - held
+    assert len(generator._prefix_pages) == held
+    # re-setting releases the old pages first (no leak)
+    generator.set_shared_prefix(PREFIX + "extra tail of instructions here")
+    assert generator.allocator.available <= before - held  # new prefix >= old
+
+
+def test_too_short_prefix_is_not_cached(params):
+    generator = _generator(params)
+    assert generator.set_shared_prefix("tiny") == 0
+    assert generator._prefix_pages == []
+
+
+def test_greedy_parity_with_uncached(params):
+    prompts = [
+        PREFIX + "Pod web-1 exit 137 oom",
+        PREFIX + "Pod db-0 crashloop backoff restarts 12",
+        PREFIX + "Pod api-2 liveness probe failed on 8080",
+    ]
+    plain = _drain(_generator(params), prompts)
+    cached_gen = _generator(params)
+    assert cached_gen.set_shared_prefix(PREFIX) > 0
+    cached = _drain(cached_gen, prompts)
+    assert cached == plain
+
+
+def test_pages_balance_and_prefix_survives_teardown(params):
+    generator = _generator(params)
+    generator.set_shared_prefix(PREFIX)
+    held = len(generator._prefix_pages)
+    free_before = generator.allocator.available
+    _drain(generator, [PREFIX + "alpha", PREFIX + "beta"])
+    # all wave pages returned; the prefix pages are still held
+    assert generator.allocator.available == free_before
+    assert len(generator._prefix_pages) == held
+    # and a second wave reuses them (tokens still correct)
+    again = _drain(generator, [PREFIX + "alpha"])
+    solo = _drain(_generator(params), [PREFIX + "alpha"])
+    assert again == solo
+
+
+def test_mixed_wave_falls_back(params):
+    generator = _generator(params)
+    generator.set_shared_prefix(PREFIX)
+    prompts = [PREFIX + "matching prompt", "completely different prompt"]
+    mixed = _drain(generator, prompts)
+    plain = _drain(_generator(params), prompts)
+    assert mixed == plain
+
+
+def test_interaction_with_guided_and_sampling(params):
+    generator = _generator(params)
+    generator.set_shared_prefix(PREFIX)
+    [a, b] = generator.admit(
+        [PREFIX + "severity?", PREFIX + "free text"],
+        [SamplingParams(max_tokens=16, temperature=0.9,
+                        guided_choice=("CRITICAL", "LOW")),
+         SamplingParams(max_tokens=8, temperature=0.0, stop_on_eos=False)],
+    )
+    results = {}
+    while generator.num_active:
+        for slot_id, result in generator.step():
+            results[slot_id] = result
+    assert results[a].text in ("CRITICAL", "LOW")
+    assert len(results[b].token_ids) == 8
+
+
+def test_prefix_on_mesh(params):
+    from operator_tpu.parallel import MeshPlan, make_mesh
+
+    mesh = make_mesh(MeshPlan(dp=2, tp=2), jax.devices("cpu")[:4])
+    generator = BatchedGenerator(
+        params, TINY_TEST, ByteTokenizer(), max_slots=4, max_seq=512,
+        cache_dtype=jnp.float32, paged=True, page_size=16, decode_block=2,
+        mesh=mesh,
+    )
+    assert generator.set_shared_prefix(PREFIX) > 0
+    prompts = [PREFIX + "mesh pod one", PREFIX + "mesh pod two"]
+    cached = _drain(generator, prompts)
+    plain = _drain(_generator(params), prompts)
+    assert cached == plain
+
+
+def test_lora_wave_never_shares(params):
+    """Adapters modify the K/V projections, so base-model prefix KV must
+    never be reused for an adapter-bearing wave (exactness guarantee)."""
+    generator = _generator(params)
+    generator.set_shared_prefix(PREFIX)
+    toks = [generator.tokenizer.encode(PREFIX + "suffix")]
+    assert generator._wave_shared_prefix(toks, [SamplingParams()]) > 0
+    assert generator._wave_shared_prefix(
+        toks, [SamplingParams(adapter="some-adapter")]
+    ) == 0
+
+
+def test_set_prefix_refuses_while_active(params):
+    generator = _generator(params)
+    generator.admit(
+        [PREFIX + "busy"],
+        [SamplingParams(max_tokens=8, temperature=0.0, stop_on_eos=False)],
+    )
+    with pytest.raises(RuntimeError, match="idle"):
+        generator.set_shared_prefix(PREFIX)
+    while generator.num_active:
+        generator.step()
+    assert generator.set_shared_prefix(PREFIX) > 0  # idle again
+
+
+def test_reset_reprimes_prefix(params):
+    generator = _generator(params)
+    generator.set_shared_prefix(PREFIX)
+    tokens_before = list(generator._prefix_tokens)
+    generator.reset()
+    assert generator._prefix_tokens == tokens_before  # re-primed
+    again = _drain(generator, [PREFIX + "after reset"])
+    solo = _drain(_generator(params), [PREFIX + "after reset"])
+    assert again == solo
