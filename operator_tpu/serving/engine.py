@@ -1037,12 +1037,11 @@ class BatchedGenerator:
         Sharing is decided per admission wave by TOKEN comparison (BPE
         boundaries need not align with the text prefix) and rounded down
         to whole pages; a wave with any non-matching prompt falls back to
-        the ordinary full prefill.  Note one interaction: admission
-        tail-truncates over-budget prompts (evidence concentrates at the
-        tail), which cuts the PREFIX off — so prompts longer than
-        ``max_seq - max_tokens`` silently lose the fast path.  Paged mode
-        only.  Returns the number of prefix tokens cached (0 = nothing
-        cached).
+        the ordinary full prefill.  Over-budget prompts keep the fast
+        path: admission truncation drops their MIDDLE, preserving the
+        prefix head and the evidence tail (``_truncate_prompt``).  Paged
+        mode only.  Returns the number of prefix tokens cached (0 =
+        nothing cached).
         """
         jnp = self._jnp
         if not self.paged:
@@ -1122,6 +1121,28 @@ class BatchedGenerator:
         self._prefix_text = text
         log.info("shared prefix cached: %d tokens in %d pages", n_keep, len(pages))
         return n_keep
+
+    def _truncate_prompt(self, ids: list, budget: int) -> list:
+        """Fit ``ids`` into ``budget`` tokens.
+
+        Failure evidence concentrates at the TAIL; instructions (and the
+        cached shared prefix) sit at the HEAD — when the prompt starts
+        with the cached prefix, drop the MIDDLE so both survive (and the
+        prefix fast path stays available).  The head keeps at most half
+        the budget so evidence always gets the larger share; without a
+        matching cached prefix this is plain tail truncation.
+        """
+        if len(ids) <= budget:
+            return ids
+        head = 0
+        if self.paged and self._prefix_tokens:
+            for a, b in zip(ids, self._prefix_tokens):
+                if a != b:
+                    break
+                head += 1
+            head = min(head, budget // 2)
+            head = (head // self.page_size) * self.page_size
+        return ids[:head] + ids[-(budget - head):]
 
     def _wave_shared_prefix(
         self, token_lists: list, params_list: "Sequence[SamplingParams]"
@@ -1378,9 +1399,7 @@ class BatchedGenerator:
             ids = self.tokenizer.encode(prompt)
             # leave room for at least one generated token
             budget = self.max_seq - max(1, min(sampling.max_tokens, self.max_seq // 2))
-            if len(ids) > budget:
-                ids = ids[-budget:]  # failure evidence concentrates at the tail
-            token_lists.append(ids)
+            token_lists.append(self._truncate_prompt(ids, budget))
 
         page_grants: list[list[int]] = []
         if self.paged:

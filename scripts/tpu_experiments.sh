@@ -90,6 +90,8 @@ run bench_kernel_v2 900 env BENCH_OPEN=0 OPERATOR_TPU_PAGED_KERNEL=v2 python ben
 run bench_flash  900 env BENCH_OPEN=0 OPERATOR_TPU_FLASH_PREFILL=1 python bench.py
 # literal BASELINE config 4: 32 slots, 32 concurrent arrivals -> one prefill
 run bench_slots32 900 env BENCH_OPEN=0 BENCH_SLOTS=32 python bench.py
+# shared-prefix caching off: attribution of the template-prefill win
+run bench_noprefix 900 env BENCH_OPEN=0 BENCH_PREFIX_CACHE=0 python bench.py
 # north-star model class: llama-3-8b int8 (~8.2 GB) on the 16 GB chip
 run bench_8b     2400 env BENCH_OPEN=0 BENCH_MODEL=llama-3-8b BENCH_QUANT=1 \
     BENCH_SLOTS=8 BENCH_REQUESTS=16 BENCH_MAX_SEQ=2048 python bench.py
@@ -98,10 +100,14 @@ run bench_unroll 900 env BENCH_OPEN=0 OPERATOR_TPU_LAYER_UNROLL=22 python bench.
 # decode-block straight-lining: does the scan CARRY (cache) get copied?
 run bench_block_unroll 900 env BENCH_OPEN=0 OPERATOR_TPU_DECODE_UNROLL=1 python bench.py
 # chunked prefill A/B in the regime it was built for (VERDICT r3 item 4):
-# open-loop p50/p99 vs bench_main at 1B, and an 8B closed-batch pair
-run bench_chunked 1500 env BENCH_OPEN_SECONDS=60 BENCH_PREFILL_CHUNK=256 python bench.py
+# open-loop p50/p99 vs bench_main at 1B, and an 8B closed-batch pair.
+# PREFIX_CACHE off: prefix-shared waves skip the chunk job entirely, so
+# these rows must disable it to measure CHUNKING, not the prefix cache
+run bench_chunked 1500 env BENCH_OPEN_SECONDS=60 BENCH_PREFILL_CHUNK=256 \
+    BENCH_PREFIX_CACHE=0 python bench.py
 run bench_8b_chunked 2400 env BENCH_OPEN=0 BENCH_MODEL=llama-3-8b BENCH_QUANT=1 \
-    BENCH_SLOTS=8 BENCH_REQUESTS=16 BENCH_MAX_SEQ=2048 BENCH_PREFILL_CHUNK=512 python bench.py
+    BENCH_SLOTS=8 BENCH_REQUESTS=16 BENCH_MAX_SEQ=2048 BENCH_PREFILL_CHUNK=512 \
+    BENCH_PREFIX_CACHE=0 python bench.py
 # xplane trace of the timed region for the remaining-gap attribution
 run bench_profile 900 env BENCH_OPEN=0 BENCH_PROFILE=$OUT/xplane python bench.py
 run trace_summary 300 python scripts/analyze_xplane.py "$OUT/xplane" 40

@@ -144,6 +144,41 @@ def test_prefix_on_mesh(params):
     assert cached == plain
 
 
+def test_truncation_preserves_prefix_and_tail(params):
+    """Over-budget prompts drop their MIDDLE when they start with the
+    cached prefix: the template head keeps the fast path (and the
+    instructions), the tail keeps the failure evidence."""
+    generator = _generator(params, max_seq=256)
+    generator.set_shared_prefix(PREFIX)
+    evidence = "the unique evidence marker at the very end"
+    long_prompt = PREFIX + ("middle filler " * 100) + evidence
+    ids = generator.tokenizer.encode(long_prompt)
+    budget = 200
+    truncated = generator._truncate_prompt(list(ids), budget)
+    assert len(truncated) == budget
+    # head: a whole-page, <=budget//2 slice of the cached prefix tokens
+    head = next(
+        i for i, (a, b) in enumerate(
+            zip(truncated, generator._prefix_tokens + [None] * budget)
+        ) if a != b
+    )
+    assert head > 0 and head % generator.page_size == 0 and head <= budget // 2
+    assert truncated[:head] == generator._prefix_tokens[:head]
+    # tail: the evidence marker survives verbatim at the end
+    tail_text = generator.tokenizer.decode(truncated[-len(evidence):])
+    assert evidence in tail_text
+    # and the engine actually takes the fast path for such a prompt
+    sampling = SamplingParams(max_tokens=8, temperature=0.0, stop_on_eos=False)
+    generator.admit([long_prompt], [sampling])
+    assert generator._prefix_fns, "prefix fast path should have been used"
+    while generator.num_active:
+        generator.step()
+    # without a cached prefix: plain tail-only truncation (head == 0)
+    plain = _generator(params, max_seq=256)
+    tail_only = plain._truncate_prompt(list(ids), budget)
+    assert tail_only == ids[-budget:]
+
+
 def test_lora_wave_never_shares(params):
     """Adapters modify the K/V projections, so base-model prefix KV must
     never be reused for an adapter-bearing wave (exactness guarantee)."""
