@@ -15,6 +15,11 @@ import os
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _cpu_pin import pin_cpu_if_requested
+
+pin_cpu_if_requested()
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -85,7 +90,7 @@ def measure(params, config, *, paged, sampler, donate, block=BLOCK):
 def main():
     print(f"device: {jax.devices()[0]}  block={BLOCK} slots={SLOTS} seq={SEQ}",
           flush=True)
-    config = get_config("tinyllama-1.1b")
+    config = get_config(os.environ.get("PD_MODEL", "tinyllama-1.1b"))
     params = jax.block_until_ready(
         jax.jit(lambda k: init_params(config, k, dtype=jnp.bfloat16))(
             jax.random.PRNGKey(0)

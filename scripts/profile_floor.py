@@ -7,12 +7,24 @@
    batch-16 activations through the 7 layer matmuls + lm_head — the decode
    step minus attention/cache/sampling. Run as a scan-of-K outer block like
    the engine's decode block.
+
+FLOOR_SMOKE=1 shrinks every leg to trivial CPU shapes (MiB transfer,
+256^3 matmul, 2 layers) and pins the cpu backend: it proves the probes
+compile+run without the chip — round 3 lost its floor measurement to a
+leg first executed ON the chip that didn't compile.
 """
 
 from __future__ import annotations
 
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(__file__))
+from _cpu_pin import pin_cpu_if_requested
+
+SMOKE = os.environ.get("FLOOR_SMOKE", "0") == "1"
+pin_cpu_if_requested(force=SMOKE)  # smoke must never touch the tunnel
 
 import jax
 import jax.numpy as jnp
@@ -37,7 +49,7 @@ def main():
     key = jax.random.PRNGKey(0)
 
     # 1. HBM bandwidth ------------------------------------------------------
-    nbytes = 1 << 30
+    nbytes = 1 << (20 if SMOKE else 30)
     x = jnp.zeros((nbytes // 2,), jnp.bfloat16)
 
     @jax.jit
@@ -49,12 +61,12 @@ def main():
         state["x"] = bump(state["x"])
         return state["x"][:1]
     dt = fetch_time(step, iters=10)
-    # read + write = 2 GB per iteration
-    print(f"HBM elementwise: {dt*1e3:.2f} ms for 1 GB r+w -> {2*nbytes/dt/1e9:.0f} GB/s",
-          flush=True)
+    # read + write = 2x nbytes per iteration (2 GB full, 2 MiB smoke)
+    print(f"HBM elementwise: {dt*1e3:.2f} ms for {nbytes/2**30:.3g} GiB r+w -> "
+          f"{2*nbytes/dt/1e9:.0f} GB/s", flush=True)
 
     # 2. MXU ---------------------------------------------------------------
-    n = 8192
+    n = 256 if SMOKE else 8192
     a = jax.random.normal(key, (n, n), jnp.bfloat16)
 
     @jax.jit
@@ -69,8 +81,12 @@ def main():
     print(f"MXU {n}^3 bf16: {dt*1e3:.2f} ms -> {2*n**3/dt/1e12:.0f} TFLOP/s", flush=True)
 
     # 3. weights-streaming floor -------------------------------------------
-    B, H, F, L = 16, 2048, 5632, 22
-    QH, KH, D, V = 32, 4, 64, 32000
+    if SMOKE:
+        B, H, F, L = 4, 128, 256, 2
+        QH, KH, D, V = 4, 2, 32, 1024
+    else:
+        B, H, F, L = 16, 2048, 5632, 22
+        QH, KH, D, V = 32, 4, 64, 32000
     keys = jax.random.split(key, 8)
     layers = {
         "wq": jax.random.normal(keys[0], (L, H, QH * D), jnp.bfloat16),

@@ -52,12 +52,13 @@ def _percentile(values: list, q: float) -> float:
 async def main() -> int:
     # the container sitecustomize force-registers the TPU plugin; env
     # JAX_PLATFORMS=cpu alone does NOT stop jax.devices() from probing the
-    # tunnel (and hanging when it is down/claimed) — the config update must
-    # run before any backend query (same pattern as tests/conftest.py)
-    import jax
+    # tunnel (and hanging when it is down/claimed) — pin before any
+    # backend query (shared shim, scripts/_cpu_pin.py)
+    sys.path.insert(0, str(REPO / "scripts"))
+    from _cpu_pin import pin_cpu_if_requested
 
-    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-        jax.config.update("jax_platforms", "cpu")
+    pin_cpu_if_requested()
+    import jax
 
     from operator_tpu.operator.app import Operator
     from operator_tpu.operator.kubeapi import FakeKubeApi
