@@ -88,6 +88,28 @@ def via_stdlib(base_url: str, token: str) -> None:
     print("embeddings:", len(embeddings["data"]), "vectors of dim",
           len(embeddings["data"][0]["embedding"]))
 
+    # constrained decoding: the output is exactly one allowed string / a
+    # schema-valid JSON document, whatever the model wants to say
+    status, choice = request("POST", "/v1/completions", {
+        "prompt": "classify the severity:", "max_tokens": 16,
+        "guided_choice": ["CRITICAL", "HIGH", "MEDIUM", "LOW"],
+    })
+    assert status == 200, choice
+    print("guided_choice:", repr(choice["choices"][0]["text"]))
+
+    status, doc = request("POST", "/v1/completions", {
+        "prompt": "diagnose:", "max_tokens": 96,
+        "guided_json": {
+            "type": "object",
+            "properties": {
+                "severity": {"enum": ["CRITICAL", "HIGH", "MEDIUM", "LOW"]},
+                "restart_recommended": {"type": "boolean"},
+            },
+        },
+    })
+    assert status == 200, doc
+    print("guided_json:", json.loads(doc["choices"][0]["text"]))
+
 
 def main() -> None:
     base_url = sys.argv[1] if len(sys.argv) > 1 else "http://127.0.0.1:8000"

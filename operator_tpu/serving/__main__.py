@@ -31,12 +31,18 @@ def main() -> None:
 
     platform = os.environ.get("OPERATOR_TPU_PLATFORM", "").strip()
     if platform:
-        # the env's sitecustomize may force jax_platforms to the TPU plugin;
         # only a live config update reliably pins another backend (same
         # pattern as bench.py BENCH_PLATFORM / tests/conftest.py)
         import jax
 
         jax.config.update("jax_platforms", platform)
+    else:
+        # honour plain JAX_PLATFORMS=cpu too: a sitecustomize may force
+        # jax_platforms to the TPU plugin, in which case the env var alone
+        # never takes effect and a dead tunnel hangs startup silently
+        from ..utils.platform import pin_cpu_if_requested
+
+        pin_cpu_if_requested()
 
     from .httpserver import serve_forever
     from .provider import build_serving_engine

@@ -1,0 +1,31 @@
+"""Backend-pinning helper for every CPU-capable entry point.
+
+A container sitecustomize may force-register the TPU plugin and set
+``jax_platforms`` to it in every python process, so the environment
+variable ``JAX_PLATFORMS=cpu`` alone does NOT stop ``jax.devices()``
+from probing the TPU tunnel — and a dead or claimed tunnel hangs that
+probe with no output.  Only a live ``jax.config`` update before any
+backend query reliably pins another platform.
+
+One shared site (scripts/_cpu_pin.py and the serving CLI both call
+this) so the workaround cannot drift between entry points.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_cpu_if_requested(force: bool = False) -> bool:
+    """Pin jax to the cpu platform when requested; returns True if pinned.
+
+    ``force=True`` pins unconditionally (for smoke modes that must never
+    touch the tunnel even when the env var is unset).  Must run before
+    any jax backend query.
+    """
+    if force or os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    return False

@@ -1,30 +1,15 @@
 """Shared CPU-pin shim for standalone scripts.
 
-The container sitecustomize force-registers the axon TPU plugin in every
-python process and sets ``jax_platforms="axon,cpu"``, so the env var
-``JAX_PLATFORMS=cpu`` alone does NOT stop ``jax.devices()`` from probing
-the tunnel — and a dead/claimed tunnel hangs the probe with no output.
-Import this module (or call :func:`pin_cpu_if_requested`) BEFORE any jax
-backend query; it pins the cpu platform via ``jax.config`` when the
-caller asked for cpu.  One shared site so the workaround cannot drift
-between scripts (tests/conftest.py and __graft_entry__.py carry the same
-pattern for their own import-order reasons).
+Thin re-export of :mod:`operator_tpu.utils.platform` (see its docstring
+for why the env var alone is not enough) so scripts that only have the
+scripts/ directory on ``sys.path`` can import it before any jax use.
 """
 
 from __future__ import annotations
 
 import os
+import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def pin_cpu_if_requested(force: bool = False) -> bool:
-    """Pin jax to the cpu platform when requested; returns True if pinned.
-
-    ``force=True`` pins unconditionally (for smoke modes that must never
-    touch the tunnel even when the env var is unset).
-    """
-    if force or os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-        return True
-    return False
+from operator_tpu.utils.platform import pin_cpu_if_requested  # noqa: F401
