@@ -76,7 +76,14 @@ class HealthServer:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         try:
-            line = await reader.readline()
+            try:
+                line = await reader.readline()
+            except ValueError:
+                # readline() raises ValueError past the StreamReader limit
+                # (a >64 KiB request line); drop the connection quietly —
+                # this catch is deliberately NARROW so ValueErrors from
+                # routing/health checks/metrics still surface in logs
+                return
             if len(line) > _MAX_REQUEST_LINE or not line:
                 return
             parts = line.decode("latin-1").split()
