@@ -128,15 +128,15 @@ def test_interaction_with_guided_and_sampling(params):
     assert len(results[b].token_ids) == 8
 
 
-def test_prefix_on_mesh(params):
+@pytest.mark.parametrize("plan", ["dp2tp2", "dp2fsdp2tp2"])
+def test_prefix_on_mesh(params, plan):
     from operator_tpu.parallel import MeshPlan, make_mesh
 
-    mesh = make_mesh(MeshPlan(dp=2, tp=2), jax.devices("cpu")[:4])
-    generator = BatchedGenerator(
-        params, TINY_TEST, ByteTokenizer(), max_slots=4, max_seq=512,
-        cache_dtype=jnp.float32, paged=True, page_size=16, decode_block=2,
-        mesh=mesh,
-    )
+    if plan == "dp2tp2":
+        mesh = make_mesh(MeshPlan(dp=2, tp=2), jax.devices("cpu")[:4])
+    else:  # all three axes live, the full 8-device factorisation
+        mesh = make_mesh(MeshPlan(dp=2, fsdp=2, tp=2), jax.devices("cpu"))
+    generator = _generator(params, mesh=mesh)
     assert generator.set_shared_prefix(PREFIX) > 0
     prompts = [PREFIX + "mesh pod one", PREFIX + "mesh pod two"]
     cached = _drain(generator, prompts)
