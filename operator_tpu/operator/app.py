@@ -146,7 +146,7 @@ class Operator:
         server = None
         self.engine_warmth = ENGINE_LOADING
         try:
-            from ..serving.engine import SamplingParams
+            from ..serving.engine import OversizedRequest, SamplingParams
             from ..serving.httpserver import CompletionServer
             from ..serving.provider import TPUNativeProvider, build_serving_engine
 
@@ -175,10 +175,40 @@ class Operator:
                 embedder=embedder,
             )
             await server.start()
-            # warmup: one throwaway generation compiles the default-bucket
-            # prefill + decode programs NOW, while readiness still reports
-            # cold — not inside the first real failure's 2 s budget
-            await engine.generate("warmup", SamplingParams(max_tokens=1))
+            # warmup: one throwaway generation compiles the prefill + decode
+            # programs NOW, while readiness still reports cold — not inside
+            # the first real failure's 2 s budget.  The prompt is shaped like
+            # a real explanation (DEFAULT_TEMPLATE with dummy fields) so it
+            # shares the primed static preamble and compiles the PREFIXED
+            # prefill bucket — a bare "warmup" prompt would compile only the
+            # plain bucket and leave the first real request to pay the
+            # prefixed program's XLA compile despite ENGINE_READY.  A couple
+            # of decode blocks suffice for the decode program: its shape is
+            # fixed per block, so decoding production-length outputs here
+            # would compile nothing more and only delay ENGINE_READY.
+            from ..serving.prompts import build_warmup_prompt
+
+            warm_prompt = build_warmup_prompt()
+            warm_tokens = 2 * max(1, self.config.decode_block)
+            try:
+                await engine.generate(
+                    warm_prompt, SamplingParams(max_tokens=warm_tokens)
+                )
+            except OversizedRequest:
+                # a KV pool too small for the full-budget probe must not
+                # disable the API (small prompts may still fit): warm what
+                # the cache can actually hold instead — and if even the
+                # minimal probe cannot fit, serve cold rather than not at all
+                log.warning(
+                    "full-size warmup exceeds the KV cache; warming with a "
+                    "minimal prompt — first full-size request will pay its "
+                    "prefill compile"
+                )
+                try:
+                    await engine.generate("warmup", SamplingParams(max_tokens=1))
+                except OversizedRequest:
+                    log.warning("minimal warmup also exceeds the KV cache; "
+                                "serving cold")
         except asyncio.CancelledError:
             # operator stop() mid-load: not a failure, just no engine
             self.engine_warmth = ENGINE_DISABLED

@@ -707,11 +707,14 @@ class BatchedGenerator:
             return ("regex", str(params.guided_regex))
         return None
 
-    def validate_guided(self, choices: tuple) -> None:
-        self._ensure_automaton(("choice", tuple(choices)))
-
-    def validate_guided_regex(self, pattern: str) -> None:
-        self._ensure_automaton(("regex", str(pattern)))
+    def _automaton_cached(self, spec: tuple) -> bool:
+        """Lock-guarded cache probe (with the LRU touch) so async submit
+        paths can skip the executor hop for already-built specs."""
+        with self._guided_lock:
+            if spec in self._guided_cache:
+                self._guided_cache[spec] = self._guided_cache.pop(spec)
+                return True
+        return False
 
     def _ensure_automaton(self, spec: tuple) -> None:
         """Build (and cache) the automaton for a guided spec; raises
@@ -732,10 +735,8 @@ class BatchedGenerator:
         runs outside the lock: DFA compilation can take seconds, and
         holding the lock through it would stall the decode loop from the
         event-loop thread (or all HTTP traffic from the executor)."""
-        with self._guided_lock:
-            if spec in self._guided_cache:
-                self._guided_cache[spec] = self._guided_cache.pop(spec)  # LRU
-                return
+        if self._automaton_cached(spec):
+            return
         kind, payload = spec
         if kind == "choice":
             from .guided import build_choice_automaton
@@ -1156,6 +1157,12 @@ class BatchedGenerator:
         if not (self.paged and self._prefix_tokens and token_lists):
             return 0
         if any(p.adapter for p in params_list):
+            return 0
+        if any(not toks for toks in token_lists):
+            # encode() normally guarantees >=1 token (BOS), but the page
+            # arithmetic below must not hinge on tokenizer behavior: an
+            # empty row would make len(toks)-1 negative and the floored
+            # page multiple would slice token_lists from the tail
             return 0
         shared = len(self._prefix_tokens)
         for toks in token_lists:
@@ -2199,6 +2206,8 @@ class ServingEngine:
         # decode worker; call_soon_threadsafe marshals it onto the loop.
         self._partial_by_future: dict[asyncio.Future, Any] = {}
         self._partial_cbs: dict[int, Any] = {}
+        # single-flight dedup for guided-automaton builds (ensure_guided)
+        self._guided_builds: dict[tuple, asyncio.Future] = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         generator.partial_hook = self._on_partial_from_worker
         self._stalled_avail: Optional[int] = None  # pages free at last stall
@@ -2316,6 +2325,40 @@ class ServingEngine:
             if not future.done():
                 future.set_exception(exc)
 
+    async def ensure_guided(self, spec: tuple) -> None:
+        """Build (and cache) the automaton for a guided spec; raises
+        ValueError on bad specs or unsupported engine configs.
+
+        The build (regex NFA + subset construction, seconds for a novel
+        spec) runs on the loop's default executor — NOT inline (it would
+        stall every HTTP connection) and NOT on the dedicated decode
+        thread (it would delay decode steps queued behind it);
+        ``_guided_lock`` makes the cache safe across threads.  The inline
+        probe keeps cache-hit submits (the common case: validation
+        already built the spec) off the shared executor, where one slow
+        novel build would queue them.  Concurrent callers with the same
+        novel spec piggyback on ONE in-flight build (shielded, so a
+        cancelled waiter never kills the build for the others) instead of
+        occupying one executor thread each.  The single entry point for
+        both submit (generate) and HTTP validate paths, so build
+        scheduling can never diverge between them."""
+        if self.generator._automaton_cached(spec):
+            return
+        build = self._guided_builds.get(spec)
+        if build is None:
+            build = asyncio.get_running_loop().run_in_executor(
+                None, self.generator._ensure_automaton, spec
+            )
+            self._guided_builds[spec] = build
+
+            def _done(fut: "asyncio.Future") -> None:
+                self._guided_builds.pop(spec, None)
+                if not fut.cancelled():
+                    fut.exception()  # retrieved even with zero waiters left
+
+            build.add_done_callback(_done)
+        await asyncio.shield(build)
+
     async def generate(
         self,
         prompt: str,
@@ -2357,7 +2400,7 @@ class ServingEngine:
         if guided_spec is not None:
             # builds+caches the automaton; raises ValueError here (to THIS
             # caller) on bad specs or unsupported engine configs
-            self.generator._ensure_automaton(guided_spec)
+            await self.ensure_guided(guided_spec)
         if self._task is None:
             await self.start()
         future: asyncio.Future = asyncio.get_running_loop().create_future()

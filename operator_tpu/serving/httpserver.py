@@ -60,6 +60,10 @@ _READ_TIMEOUT_S = 30.0
 #: sentinel: the handler already wrote the (SSE) response to the socket
 _STREAMED = object()
 
+#: sentinel: the bounded pre-header peek in _stream expired before the
+#: first engine update — commit the SSE headers and report in-stream
+_PEEK_TIMED_OUT = object()
+
 
 def _content_text(content: Any) -> str:
     """Flatten OpenAI message content: plain string or content-parts list
@@ -117,8 +121,28 @@ class ApiError(Exception):
         self.err_type = err_type
 
 
+def _map_engine_error(exc: BaseException) -> Optional[ApiError]:
+    """The admission-error contract, shared by the streaming and
+    non-streaming paths so the same engine failure can never produce
+    diverging responses: OversizedRequest (prompt needs more KV pages than
+    the whole cache) is a CLIENT error -> 400; RuntimeError (engine
+    closed/dead) -> 503.  Other engine-internal errors (including
+    ValueError) deliberately stay 5xx via the generic handler."""
+    if isinstance(exc, OversizedRequest):
+        return ApiError(400, str(exc))
+    if isinstance(exc, RuntimeError):
+        return ApiError(503, f"engine unavailable: {exc}", "server_error")
+    return None
+
+
 class CompletionServer:
     """Serve the shared ``ServingEngine`` over the OpenAI wire format."""
+
+    #: how long _stream holds back the status line waiting for the first
+    #: engine update (which surfaces admission failures as clean 400/503s);
+    #: generous enough for an idle engine's prefill compile-hit, short
+    #: enough to stay under client/ingress response-header timeouts
+    stream_peek_timeout_s = 1.0
 
     def __init__(
         self,
@@ -344,7 +368,16 @@ class CompletionServer:
             "invalid_request_error",
         )
 
-    def _sampling(self, req: dict) -> tuple[SamplingParams, list[str]]:
+    async def _ensure_guided(self, spec: tuple) -> None:
+        """engine.ensure_guided with the validate-time ValueError→400
+        mapping.  Engine-internal ValueErrors raised later deliberately
+        stay 5xx, so the 400 mapping lives only here."""
+        try:
+            await self.engine.ensure_guided(spec)
+        except ValueError as exc:
+            raise ApiError(400, str(exc)) from None
+
+    async def _sampling(self, req: dict) -> tuple[SamplingParams, list[str]]:
         max_tokens = req.get("max_tokens", 256)
         if not isinstance(max_tokens, int) or max_tokens < 1:
             raise ApiError(400, "max_tokens must be a positive integer")
@@ -374,23 +407,14 @@ class CompletionServer:
                     "guided_choice must be a non-empty list of <=256 strings "
                     "of <=512 chars each",
                 )
-            try:
-                # surfaces bad choice sets (oversized automata, unservable
-                # configs) as a 400 HERE — engine-internal ValueErrors later
-                # must stay 5xx, so no blanket mapping at the gather
-                self.engine.generator.validate_guided(tuple(guided))
-            except ValueError as exc:
-                raise ApiError(400, str(exc)) from None
+            await self._ensure_guided(("choice", tuple(guided)))
         regex = req.get("guided_regex")
         if regex is not None:
             if guided is not None:
                 raise ApiError(400, "guided_choice and guided_regex are mutually exclusive")
             if not isinstance(regex, str) or not regex or len(regex) > 1024:
                 raise ApiError(400, "guided_regex must be a non-empty string (<=1024 chars)")
-            try:
-                self.engine.generator.validate_guided_regex(regex)
-            except ValueError as exc:
-                raise ApiError(400, str(exc)) from None
+            await self._ensure_guided(("regex", regex))
         schema = req.get("guided_json")
         response_format = req.get("response_format")
         if schema is None and isinstance(response_format, dict):
@@ -428,9 +452,9 @@ class CompletionServer:
                 # machinery end to end, validated here so a bad schema can
                 # never fail a co-batched wave
                 regex = lower_guided_json(schema)
-                self.engine.generator.validate_guided_regex(regex)
             except ValueError as exc:
                 raise ApiError(400, str(exc)) from None
+            await self._ensure_guided(("regex", regex))
         params = SamplingParams(
             max_tokens=max_tokens, temperature=float(temperature),
             top_p=float(top_p), adapter=self._resolve_adapter(req),
@@ -440,7 +464,7 @@ class CompletionServer:
         return params, stop
 
     async def _completions(self, req: dict, *, chat: bool, writer=None):
-        params, stop = self._sampling(req)
+        params, stop = await self._sampling(req)
         n = req.get("n", 1)
         if not isinstance(n, int) or not 1 <= n <= 16:
             raise ApiError(400, "n must be an integer in [1, 16]")
@@ -479,20 +503,50 @@ class CompletionServer:
         ]
         try:
             results = await asyncio.gather(*tasks)
-        except OversizedRequest as exc:
-            # admission-time client error (prompt needs more KV pages than
-            # the whole cache) — a 400, not an internal failure; other
-            # engine-internal ValueErrors deliberately stay 5xx
-            raise ApiError(400, str(exc)) from None
-        except RuntimeError as exc:
-            raise ApiError(503, f"engine unavailable: {exc}", "server_error") from None
-        finally:
+        except BaseException as exc:
             # one failed job must not leave its siblings decoding on the
             # shared engine after the response went out — cancellation
-            # triggers the engine's slot/page reclamation
+            # triggers the engine's slot/page reclamation.  EVERY sibling
+            # is then AWAITED (the loop never exits early): a task that
+            # already failed holds an unretrieved exception ("Task
+            # exception was never retrieved" log noise at GC), and a
+            # cancelled one finishes its engine-side cleanup only when
+            # awaited — both must resolve before the error response is
+            # written
             for task in tasks:
                 if not task.done():
                     task.cancel()
+            handler_cancelled = False
+            for task in tasks:
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    # the cancellation is OURS when it was delivered while
+                    # the sibling was still running, or injected into this
+                    # handler (teardown) while awaiting an already-
+                    # cancelled sibling — task.cancelled() alone cannot
+                    # tell the latter apart, .cancelling() (3.11+; absent
+                    # on 3.10, where that rarer case is missed) can.
+                    # Remember it and KEEP draining: later siblings still
+                    # need their exceptions retrieved and cleanup awaited
+                    current = asyncio.current_task()
+                    cancelling = getattr(current, "cancelling", None)
+                    if not task.cancelled() or (
+                        cancelling is not None and cancelling()
+                    ):
+                        handler_cancelled = True
+                except Exception as sibling:
+                    # retrieved (silencing the GC "never retrieved" noise),
+                    # but a DISTINCT internal failure co-occurring with the
+                    # mapped one must still leave a trace in the logs
+                    if sibling is not exc:
+                        log.warning("sibling generation also failed: %r", sibling)
+            if handler_cancelled:
+                raise asyncio.CancelledError from None
+            mapped = _map_engine_error(exc)
+            if mapped is not None:
+                raise mapped from None
+            raise
 
         choices = []
         usage_prompt = usage_completion = 0
@@ -595,7 +649,16 @@ class CompletionServer:
         job = asyncio.ensure_future(
             self.engine.generate(prompt, params, on_partial=updates.put_nowait)
         )
-        job.add_done_callback(lambda _: updates.put_nowait(None))  # wake the loop
+
+        def _on_done(t: asyncio.Task) -> None:
+            if not t.cancelled():
+                t.exception()  # mark retrieved: the early-exit paths
+                # (peek cancellation, client OSError, finally-cancel) never
+                # await the job, and an unretrieved failure would log GC
+                # "Task exception was never retrieved" noise
+            updates.put_nowait(None)  # wake the loop
+
+        job.add_done_callback(_on_done)
 
         ident = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:24]}"
         created = int(time.time())
@@ -624,6 +687,39 @@ class CompletionServer:
                 end -= 1  # incomplete multi-byte sequence still in flight
             return text[: max(0, end - stop_holdback)]
 
+        # peek at the FIRST engine update before committing to the 200/SSE
+        # headers: admission-time failures (OversizedRequest, engine down)
+        # resolve the job before any partial arrives, and they must surface
+        # as the same 400/503 the non-streaming path returns — not as a 200
+        # with an in-stream error event.  The peek is BOUNDED: a healthy
+        # request queued behind a long prefill may take many seconds to its
+        # first block, and holding back the status line that long would trip
+        # client/ingress response-header timeouts — on timeout, commit the
+        # headers and fall back to in-stream error reporting (the pre-fix
+        # behavior), keeping the 400 mapping for the fast failure case
+        try:
+            first = await asyncio.wait_for(
+                updates.get(), self.stream_peek_timeout_s
+            )
+        except asyncio.TimeoutError:
+            first = _PEEK_TIMED_OUT
+        except BaseException:
+            job.cancel()
+            raise
+        if first is None and job.done():
+            try:
+                job.result()
+            except asyncio.CancelledError:
+                raise ApiError(503, "server shutting down", "server_error") from None
+            except BaseException as exc:
+                mapped = _map_engine_error(exc)
+                if mapped is not None:
+                    raise mapped from None
+                raise
+            # success with no partials (or an unexpected failure -> the
+            # outer 500 mapping, matching non-streaming): fall through and
+            # emit the final text below
+
         writer.write(
             b"HTTP/1.1 200 OK\r\n"
             b"Content-Type: text/event-stream\r\n"
@@ -634,11 +730,12 @@ class CompletionServer:
         stopped = False
         try:
             await writer.drain()
-            while True:
-                token_ids = await updates.get()
-                if token_ids is None:
-                    break
+            token_ids = (
+                await updates.get() if first is _PEEK_TIMED_OUT else first
+            )
+            while token_ids is not None:
                 if stopped:
+                    token_ids = await updates.get()
                     continue  # drain remaining deltas past a stop match
                 text = tokenizer.decode(token_ids)
                 cut = _earliest_stop(text, stop)
@@ -650,6 +747,7 @@ class CompletionServer:
                     writer.write(chunk(text[len(sent_text):], None))
                     await writer.drain()
                     sent_text = text
+                token_ids = await updates.get()
             try:
                 result = await job
             except asyncio.CancelledError:

@@ -81,6 +81,27 @@ def _evidence(result: Optional[AnalysisResult]) -> str:
     return "\n---\n".join(blocks) if blocks else "(none)"
 
 
+def build_warmup_prompt() -> str:
+    """A production-shaped prompt for engine warmup (operator/app.py).
+
+    Starts with the template's static preamble (so the PREFIXED prefill
+    bucket compiles, not just the plain one) and pads evidence/log_tail to
+    their production CHAR budgets with log-shaped filler: prefill programs
+    are keyed by the power-of-two bucket of the suffix TOKEN length, so
+    the filler must tokenize at real log density — tiny dummy fields (or
+    repeated single chars, which BPE packs very differently) would warm a
+    different bucket than real explanation prompts use.  Lives next to
+    DEFAULT_TEMPLATE so a placeholder change updates both or neither."""
+    line = ("2026-01-01T00:00:00Z ERROR connection refused "
+            "connecting to upstream service on port 8080\n")
+    evidence = (line * (MAX_EVIDENCE_CHARS // len(line) + 1))[:MAX_EVIDENCE_CHARS]
+    log_tail = (line * (MAX_TAIL_CHARS // len(line) + 1))[:MAX_TAIL_CHARS]
+    return DEFAULT_TEMPLATE.format(
+        pod_name="warmup", namespace="warmup", severity="NONE",
+        patterns="warmup", evidence=evidence, log_tail=log_tail,
+    )
+
+
 def build_prompt(request: AnalysisRequest) -> str:
     from ..patterns.windows import tail_chars  # local import keeps serving lean
 

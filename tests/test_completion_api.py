@@ -432,3 +432,39 @@ def test_oversized_request_maps_to_400():
     with pytest.raises(ApiError) as err:
         asyncio.run(server._completions({"prompt": "x" * 4096}, chat=False))
     assert err.value.status == 400 and "KV pages" in str(err.value)
+
+
+def test_streaming_oversized_request_maps_to_400_before_headers():
+    """stream:true with a prompt bigger than the KV cache must get the SAME
+    400 the non-streaming path returns — not a 200 with SSE headers and an
+    in-stream error event (advisor r4): the peek at the first engine update
+    happens before anything is written to the socket."""
+    from operator_tpu.serving.engine import OversizedRequest
+    from operator_tpu.serving.httpserver import ApiError, CompletionServer
+
+    class _StubGenerator:
+        tokenizer = None
+
+    class _StubEngine:
+        generator = _StubGenerator()
+
+        async def generate(self, prompt, params, on_partial=None):
+            raise OversizedRequest("request needs 9 KV pages, cache holds 4")
+
+    class _RecordingWriter:
+        def __init__(self):
+            self.chunks = []
+
+        def write(self, data):
+            self.chunks.append(data)
+
+        async def drain(self):
+            pass
+
+    writer = _RecordingWriter()
+    server = CompletionServer(_StubEngine(), model_id="tiny-test")
+    with pytest.raises(ApiError) as err:
+        asyncio.run(server._completions(
+            {"prompt": "x" * 4096, "stream": True}, chat=False, writer=writer))
+    assert err.value.status == 400 and "KV pages" in str(err.value)
+    assert not writer.chunks  # no 200/SSE bytes hit the socket

@@ -284,7 +284,7 @@ def test_oversized_choice_set_rejected_at_submit(params):
 
     huge = tuple(secrets.token_hex(64) for _ in range(256))  # ~32k states
     with pytest.raises(ValueError, match="cap"):
-        generator.validate_guided(huge)
+        generator._ensure_automaton(("choice", huge))
 
 
 # --- guided_regex (serving/regex_dfa.py) -----------------------------------

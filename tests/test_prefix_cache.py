@@ -191,6 +191,19 @@ def test_lora_wave_never_shares(params):
     ) == 0
 
 
+def test_empty_token_list_never_shares(params):
+    """An empty token row must return 0 shared tokens, not a NEGATIVE page
+    multiple (advisor r4): len(toks)-1 == -1 floored to a page boundary
+    would slice token_lists from the tail and corrupt every length."""
+    generator = _generator(params)
+    generator.set_shared_prefix(PREFIX)
+    good = generator.tokenizer.encode(PREFIX + "suffix")
+    assert generator._wave_shared_prefix(
+        [good, []], [SamplingParams(), SamplingParams()]
+    ) == 0
+    assert generator._wave_shared_prefix([[]], [SamplingParams()]) == 0
+
+
 def test_set_prefix_refuses_while_active(params):
     generator = _generator(params)
     generator.admit(
