@@ -1,13 +1,13 @@
 #!/bin/bash
-# Round-4 TPU experiment series (run on the TPU-attached host).
+# Round-5 TPU experiment series (run on the TPU-attached host).
 # Produces $OUT/: hardware floors, decode attribution, bench variants
 # (pipeline, page size, quant, config-4 slots=32, 8B int8, chunked A/B),
 # and an xplane profile. Each step is individually timeboxed so one hang
 # doesn't kill the series, and EVERY completed step commits the refreshed
-# docs/R4_RESULTS.md — a mid-series tunnel death leaves partial evidence
+# docs/R5_RESULTS.md — a mid-series tunnel death leaves partial evidence
 # in git (round 3 lost everything to an all-or-nothing queue).
 set -u
-OUT=$(realpath -m "${1:-/root/r4_experiments}")  # absolute BEFORE the cd below
+OUT=$(realpath -m "${1:-$(cd "$(dirname "$0")/.." && pwd)/r5_experiments}")  # absolute BEFORE the cd below
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 # the keep-host-quiet flag must not outlive the series: the EXIT trap
@@ -32,15 +32,15 @@ wait_chip() {  # block until the TPU answers a device probe (a step killed at
 }
 
 capture() {  # refresh the results doc and commit it (index-lock tolerant)
-  python scripts/summarize_series.py "$OUT" docs/R4_RESULTS.md \
+  python scripts/summarize_series.py "$OUT" docs/R5_RESULTS.md \
       >> "$OUT/series.log" 2>&1
-  if [ -f docs/R4_RESULTS.md ] && { \
-      ! git ls-files --error-unmatch docs/R4_RESULTS.md > /dev/null 2>&1 \
-      || ! git diff --quiet HEAD -- docs/R4_RESULTS.md 2>/dev/null; }; then
+  if [ -f docs/R5_RESULTS.md ] && { \
+      ! git ls-files --error-unmatch docs/R5_RESULTS.md > /dev/null 2>&1 \
+      || ! git diff --quiet HEAD -- docs/R5_RESULTS.md 2>/dev/null; }; then
     for _ in 1 2 3; do
-      git add docs/R4_RESULTS.md 2>/dev/null \
+      git add docs/R5_RESULTS.md 2>/dev/null \
         && git commit -m "Record on-chip result: $1" \
-            -- docs/R4_RESULTS.md >> "$OUT/series.log" 2>&1 \
+            -- docs/R5_RESULTS.md >> "$OUT/series.log" 2>&1 \
         && break
       sleep 5  # another process may hold .git/index.lock
     done
@@ -70,6 +70,9 @@ run() {  # run <name> <timeout_s> <cmd...>
   echo "rc=$rc $name" | tee -a "$OUT/series.log"
 }
 
+# kernels FIRST (VERDICT r4 item 3): a short tunnel window validates Mosaic
+# lowering + parity of all four Pallas kernels before any long bench runs
+run kernels_smoke 900 python scripts/tpu_kernel_smoke.py
 # the single probe that settles the roofline question (VERDICT r3 weak #5):
 # the fixed weights-streaming leg of the floor profiler
 run floor        600 python scripts/profile_floor.py

@@ -1,9 +1,25 @@
-"""Real-chip smoke test: compile + parity of both Pallas kernels on TPU."""
+"""Real-chip smoke test: compile + parity of all four Pallas kernels on TPU.
+
+Runs FIRST in scripts/tpu_experiments.sh (kernels-first ordering): a
+short tunnel window (15-minute timebox) validates Mosaic lowering of the
+exact kernels the perf series depends on before any long bench spends
+chip time.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
 import numpy as np
 import jax, jax.numpy as jnp
 
 from operator_tpu.ops.similarity import _best_window_pallas, best_window_scores_reference
-from operator_tpu.ops.paged_attention import _paged_attention_pallas, paged_attention_reference
+from operator_tpu.ops.paged_attention import (
+    _paged_attention_pallas,
+    _paged_attention_pallas_v2,
+    paged_attention_reference,
+)
+from operator_tpu.ops.flash_prefill import _flash_prefill_pallas, flash_prefill_reference
 
 dev = jax.devices()[0]
 print("device:", dev, dev.platform)
@@ -30,3 +46,17 @@ o_r = paged_attention_reference(q, kp, vp, table, lens)
 # (XLA's own TPU-vs-CPU gap is the same magnitude)
 np.testing.assert_allclose(np.asarray(o_k), np.asarray(o_r), atol=2e-2)
 print("paged attention kernel: OK, max |d| =", float(jnp.max(jnp.abs(o_k - o_r))))
+
+o_k2 = _paged_attention_pallas_v2(q, kp, vp, table, lens)
+np.testing.assert_allclose(np.asarray(o_k2), np.asarray(o_r), atol=2e-2)
+print("paged attention kernel v2: OK, max |d| =", float(jnp.max(jnp.abs(o_k2 - o_r))))
+
+fb, ft, fqh, fkh, fd = 2, 256, 32, 8, 128
+fq = jax.device_put(jax.random.normal(jax.random.PRNGKey(5), (fb, ft, fqh, fd), jnp.float32), dev)
+fk = jax.device_put(jax.random.normal(jax.random.PRNGKey(6), (fb, ft, fkh, fd), jnp.float32), dev)
+fv = jax.device_put(jax.random.normal(jax.random.PRNGKey(7), (fb, ft, fkh, fd), jnp.float32), dev)
+flens = jax.device_put(jnp.asarray([256, 131], jnp.int32), dev)
+f_k = _flash_prefill_pallas(fq, fk, fv, flens)
+f_r = flash_prefill_reference(fq, fk, fv, flens)
+np.testing.assert_allclose(np.asarray(f_k), np.asarray(f_r), atol=2e-2)
+print("flash prefill kernel: OK, max |d| =", float(jnp.max(jnp.abs(f_k - f_r))))

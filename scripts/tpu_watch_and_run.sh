@@ -3,10 +3,10 @@
 # experiment series once.  Survives tunnel outages that outlast any single
 # step's wait window (scripts/tpu_experiments.sh aborts fast on a dead
 # tunnel; this relaunches it when the chip returns).  The series commits
-# docs/R4_RESULTS.md after every completed step, so this wrapper only
+# docs/R5_RESULTS.md after every completed step, so this wrapper only
 # needs to relaunch on rc=2 (mid-series tunnel death).
 set -u
-OUT=$(realpath -m "${1:-/root/r4_experiments}")
+OUT=$(realpath -m "${1:-$(cd "$(dirname "$0")/.." && pwd)/r5_experiments}")
 cd "$(dirname "$0")/.."
 mkdir -p "$OUT"
 echo "watcher start $(date +%H:%M:%S)" >> "$OUT/watcher.log"
@@ -19,14 +19,14 @@ while true; do
     echo "series rc=$rc $(date +%H:%M:%S)" >> "$OUT/watcher.log"
     # belt-and-braces final capture: covers a series killed between a
     # step's run and its own capture call
-    python scripts/summarize_series.py "$OUT" docs/R4_RESULTS.md \
+    python scripts/summarize_series.py "$OUT" docs/R5_RESULTS.md \
         >> "$OUT/watcher.log" 2>&1
-    if [ -f docs/R4_RESULTS.md ] && { \
-        ! git ls-files --error-unmatch docs/R4_RESULTS.md > /dev/null 2>&1 \
-        || ! git diff --quiet HEAD -- docs/R4_RESULTS.md 2>/dev/null; }; then
-      git add docs/R4_RESULTS.md 2>/dev/null
+    if [ -f docs/R5_RESULTS.md ] && { \
+        ! git ls-files --error-unmatch docs/R5_RESULTS.md > /dev/null 2>&1 \
+        || ! git diff --quiet HEAD -- docs/R5_RESULTS.md 2>/dev/null; }; then
+      git add docs/R5_RESULTS.md 2>/dev/null
       git commit -m "Record on-chip experiment series results" \
-          -- docs/R4_RESULTS.md >> "$OUT/watcher.log" 2>&1
+          -- docs/R5_RESULTS.md >> "$OUT/watcher.log" 2>&1
     fi
     # rc=2 means the tunnel died mid-series: go back to polling and rerun
     [ "$rc" != 2 ] && break
