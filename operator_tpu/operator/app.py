@@ -209,6 +209,13 @@ class Operator:
                 except OversizedRequest:
                     log.warning("minimal warmup also exceeds the KV cache; "
                                 "serving cold")
+            # grid precompile: the template probe above warmed ONE bucket;
+            # every other (n_pad, t_pad) program a wave can select would
+            # otherwise compile in-band as a multi-second p99 outlier (the
+            # 100/min soak's 5.9 s tail).  Readiness keeps reporting cold
+            # until the grid is warm.
+            grid = await engine.precompile(self.config.warmup_grid)
+            log.info("engine warmup grid: %s", grid)
         except asyncio.CancelledError:
             # operator stop() mid-load: not a failure, just no engine
             self.engine_warmth = ENGINE_DISABLED

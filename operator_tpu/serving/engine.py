@@ -1126,12 +1126,15 @@ class BatchedGenerator:
     def _truncate_prompt(self, ids: list, budget: int) -> list:
         """Fit ``ids`` into ``budget`` tokens.
 
-        Failure evidence concentrates at the TAIL; instructions (and the
-        cached shared prefix) sit at the HEAD — when the prompt starts
-        with the cached prefix, drop the MIDDLE so both survive (and the
-        prefix fast path stays available).  The head keeps at most half
-        the budget so evidence always gets the larger share; without a
-        matching cached prefix this is plain tail truncation.
+        Failure evidence concentrates at the TAIL; instructions sit at
+        the HEAD — when the prompt starts with the cached prefix, drop
+        the MIDDLE so both survive.  The head keeps at most half the
+        budget so evidence always gets the larger share; without a
+        matching cached prefix this is plain tail truncation.  A
+        truncated prompt usually keeps only PART of the cached prefix,
+        so its wave takes the plain prefill program (_wave_shared_prefix
+        is all-or-nothing) — the head is kept for the instructions, not
+        for KV reuse.
         """
         if len(ids) <= budget:
             return ids
@@ -1174,7 +1177,16 @@ class BatchedGenerator:
             # every row must keep >=1 suffix token: its first sampled
             # token needs a logit row in the suffix program
             shared = min(shared, common, len(toks) - 1)
-        return (shared // self.page_size) * self.page_size
+        shared = (shared // self.page_size) * self.page_size
+        # all-or-nothing: the suffix program is specialised on the static
+        # shared length, so interior values (e.g. the page-floored half
+        # budget a truncated long prompt keeps, _truncate_prompt) would
+        # each compile their OWN (n_pad, t_sfx, shared) program — an
+        # unbounded compile surface that defeats the warmup grid
+        # (precompile_grid) and turns rare long prompts into mid-run
+        # multi-second p99 outliers.  A wave that cannot reuse the WHOLE
+        # cached prefix takes the precompiled plain program instead.
+        return shared if shared == len(self._prefix_tokens) else 0
 
     def _make_prefill_paged_prefixed(
         self, n_pad: int, t_sfx: int, shared: int, guided: bool = False
@@ -1380,6 +1392,173 @@ class BatchedGenerator:
     def num_decoding(self) -> int:
         return sum(s.active for s in self.slots)
 
+    def _program_count(self) -> int:
+        """Compiled-program cache population (prefill variants + chunked +
+        decode) — the precompile coverage metric."""
+        decode = int(self._decode_fn is not None) + int(
+            self._decode_fn_guided is not None
+        )
+        return (
+            len(self._prefill_fns)
+            + len(self._prefix_fns)
+            + len(self._chunk_fns)
+            + len(self._finish_fns)
+            + decode
+        )
+
+    def precompile_grid(self, level: str = "serving") -> dict:
+        """Compile every program the admission policy can select BEFORE
+        serving: a mid-run XLA compile is an SLO violation, not noise (the
+        100/min CPU soak's 5.9 s p99 was exactly three first-encounter
+        prefill-bucket compiles of ~2 s each in the first ten seconds).
+        The reference has no analogue — its LLM leg is an external REST
+        call (AIInterfaceRestClient.java:37-39); a compiled-serving design
+        must instead guarantee the program grid is warm when readiness
+        flips.
+
+        ``level``:
+          - ``"off"``: nothing.
+          - ``"serving"``: the unguided grid — plain AND shared-prefix
+            prefill for every (n_pad, t_pad) bucket admission can produce
+            (driving the chunked job programs wherever ``prefill_chunk``
+            makes them the selected path) plus the decode block.  Guided
+            programs still compile on the first guided request: guided
+            traffic is opt-in per AIProvider CR and its automaton build is
+            already off-loop (ensure_guided).
+          - ``"full"``: additionally the guided variants of the whole grid
+            and the guided decode block.
+
+        Every wave runs through the REAL admission path (`_admit_tokens`),
+        so bucket selection, page granting, shared-prefix detection, and
+        the host-side glue ops all compile exactly as production traffic
+        would trigger them.  Waves the KV pool cannot grant are skipped —
+        production admission could not form them either — as are waves a
+        concurrently-admitted live request leaves too few free slots for.
+        All grid slots are cancelled and their pages released afterwards.
+        """
+        if level not in ("off", "serving", "full"):
+            raise ValueError(
+                f"warmup grid level {level!r}: expected off/serving/full"
+            )
+        t0 = time.perf_counter()
+        before = self._program_count()
+        if level == "off":
+            return {"level": level, "programs": 0, "seconds": 0.0}
+
+        vocab = self.config.vocab_size
+        filler = 7 % vocab
+        prefix = list(self._prefix_tokens) if self.paged else []
+        if prefix and prefix[0] == filler:
+            filler = (filler + 1) % vocab
+        short = 8  # filler rows: only row 0 drives the t_pad bucket
+        n_pads = self._admission_n_pads()
+
+        def t_buckets(limit: int) -> list:
+            ts, t = [], 64
+            while t < min(limit, self.max_seq):
+                ts.append(t)
+                t *= 2
+            ts.append(min(limit if limit >= 64 else 64, self.max_seq))
+            return sorted(set(ts))
+
+        guided_variants = [False] + ([True] if level == "full" else [])
+        base = dict(max_tokens=1, stop_on_eos=False)
+        waves: list[tuple[list, SamplingParams]] = []
+        for guided in guided_variants:
+            params = SamplingParams(
+                **base,
+                guided_choice=("warm", "cold") if guided else None,
+            )
+            # plain grid: first token diverges from the shared prefix so
+            # _wave_shared_prefix refuses and the plain program is selected
+            for t in t_buckets(self.max_seq - 1):
+                long_row = [filler] * min(t, self.max_seq - 1)
+                for n in n_pads:
+                    rows = [list(long_row)] + [
+                        [filler] * short for _ in range(n - 1)
+                    ]
+                    waves.append((rows, params))
+            # shared-prefix grid: every row starts with the cached prefix
+            if prefix:
+                for t in t_buckets(self.max_seq - 1 - len(prefix)):
+                    long_sfx = min(t, self.max_seq - 1 - len(prefix))
+                    if long_sfx < 1:
+                        continue
+                    for n in n_pads:
+                        rows = [prefix + [filler] * long_sfx] + [
+                            prefix + [filler] * short for _ in range(n - 1)
+                        ]
+                        waves.append((rows, params))
+
+        decode_warm = {False: False, True: False}
+        skipped = 0
+
+        def drive(rows: list, params: SamplingParams) -> None:
+            nonlocal skipped
+            guided = params.guided_choice is not None
+            if len(self.free_slots()) < len(rows):
+                # a live request admitted between waves holds slots — the
+                # grid must degrade, not assert: an early client during
+                # startup is harmless, its programs compile in-band and
+                # the remaining waves still warm everything slots permit
+                skipped += 1
+                return
+            try:
+                taken = self._admit_tokens(
+                    [list(r) for r in rows], [params] * len(rows),
+                    time.perf_counter(),
+                )
+            except OversizedRequest:
+                skipped += 1
+                return
+            while self._prefill_job is not None:
+                self.step()
+            if len(taken) < len(rows):
+                skipped += 1  # page pool can't grant the full wave
+            if taken and not decode_warm[guided]:
+                self.step()  # compiles the (guided) decode block
+                decode_warm[guided] = True
+            for slot_id in taken:
+                self.cancel(slot_id)
+            while self._inflight_blocks:
+                self.step()
+
+        for rows, params in waves:
+            guided = params.guided_choice is not None
+            n_pad = self._admission_n_pad(len(rows))
+            t_all = max(len(r) for r in rows)
+            shared = self._wave_shared_prefix(rows, [params] * len(rows))
+            t_pad = _bucket(t_all - shared, 64, self.max_seq)
+            if shared:
+                key_hit = (n_pad, t_pad, shared, guided) in self._prefix_fns
+            elif (
+                self.prefill_chunk is not None and t_pad > self.prefill_chunk
+            ):
+                key_hit = (n_pad, t_pad, guided) in self._finish_fns
+            else:
+                key_hit = (n_pad, t_pad, guided) in self._prefill_fns
+            if key_hit and decode_warm[guided]:
+                continue
+            drive(rows, params)
+
+        # n-specific host glue (page-table staging, slot-activation
+        # vectors) compiles eagerly per ACTUAL wave size, not per bucket:
+        # one cheap wave at every n (programs already cached above) keeps
+        # those 10-50 ms first-occurrence compiles out of request latency
+        params = SamplingParams(**base)
+        for n in range(1, self.max_slots + 1):
+            drive([[filler] * short] * n, params)
+            if prefix:
+                drive([prefix + [filler] * short] * n, params)
+        result = {
+            "level": level,
+            "programs": self._program_count() - before,
+            "skipped_waves": skipped,
+            "seconds": round(time.perf_counter() - t0, 2),
+        }
+        log.info("precompile grid: %s", result)
+        return result
+
     def admit(
         self, prompts: Sequence[str], params_list: Sequence[SamplingParams]
     ) -> list[int]:
@@ -1394,7 +1573,6 @@ class BatchedGenerator:
         shorter than ``prompts`` — the caller requeues the rest.  A single
         request larger than the whole cache raises :class:`OversizedRequest`.
         """
-        jnp = self._jnp
         free = self.free_slots()
         assert len(prompts) <= len(free), "admit() called with too few free slots"
         if not prompts:
@@ -1407,7 +1585,18 @@ class BatchedGenerator:
             # leave room for at least one generated token
             budget = self.max_seq - max(1, min(sampling.max_tokens, self.max_seq // 2))
             token_lists.append(self._truncate_prompt(ids, budget))
+        return self._admit_tokens(token_lists, params_list, started)
 
+    def _admit_tokens(
+        self,
+        token_lists: list,
+        params_list: Sequence[SamplingParams],
+        started: float,
+    ) -> list[int]:
+        """Admission after tokenisation/truncation: page grants + the
+        shared-prefix decision + the batched prefill.  Split from admit()
+        so precompile_grid() can drive exact token-length waves through
+        the REAL admission path (bucket selection included)."""
         page_grants: list[list[int]] = []
         if self.paged:
             # shared-prefix reuse: when EVERY prompt starts with the cached
@@ -1443,6 +1632,29 @@ class BatchedGenerator:
                 raise
         return self._admit_batch(token_lists, params_list, [], started)
 
+    def _admission_n_pads(self) -> list[int]:
+        """The CLOSED set of batch buckets admission can assign: power-of-
+        two buckets, dp-rounded (multiples of dp*fsdd so prefill rows shard
+        instead of hitting the replicated fallback, _prefill_shardings),
+        capped at max_slots.  Selecting the smallest member >= n keeps
+        _admission_n_pad idempotent even when dp*fsdp is not a power of two
+        (naive re-rounding would map 6 -> 9 for dp_total=3 and leave the
+        6-row bucket uncompilable by any warmup)."""
+        pads = set()
+        d = self._dp_total if self.mesh is not None else 1
+        for k in range(self.max_slots.bit_length() + 1):
+            pads.add(min(self.max_slots, -(-(1 << k) // d) * d))
+        return sorted(pads)
+
+    def _admission_n_pad(self, n: int) -> int:
+        """Smallest admissible batch bucket that fits ``n`` rows (padding
+        rows are row-0 duplicates, so the only cost is their flops on one
+        device's shard)."""
+        for pad in self._admission_n_pads():
+            if pad >= n:
+                return pad
+        return self.max_slots
+
     def _admit_batch(
         self,
         token_lists: list[list[int]],
@@ -1459,14 +1671,7 @@ class BatchedGenerator:
             # stay FULL (decode appends at the true sequence length)
             token_lists = [toks[prefix_shared:] for toks in token_lists]
         max_len = max(len(t) for t in token_lists)
-        n_pad = _bucket(n, 1, self.max_slots)
-        if self.mesh is not None:
-            # dp-aware admission: pad the wave to a multiple of dp*fsdp so
-            # prefill rows shard instead of hitting the replicated fallback
-            # (_prefill_shardings) — padding rows are row-0 duplicates, so
-            # the only cost is their flops on one device's shard
-            d = self._dp_total
-            n_pad = min(self.max_slots, -(-n_pad // d) * d)
+        n_pad = self._admission_n_pad(n)
         t_pad = _bucket(max_len, 64, self.max_seq)
 
         ids = np.zeros((n_pad, t_pad), np.int32)
@@ -2324,6 +2529,16 @@ class ServingEngine:
             _, _, future = self._unwrap(self._queue.get_nowait())
             if not future.done():
                 future.set_exception(exc)
+
+    async def precompile(self, level: str = "serving") -> dict:
+        """Run the generator's program-grid precompile on the decode
+        worker thread (single-threaded executor: serialised with every
+        other generator op).  Call before serving traffic — readiness
+        should gate on it (operator/app.py warmup)."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._executor, lambda: self.generator.precompile_grid(level)
+        )
 
     async def ensure_guided(self, spec: tuple) -> None:
         """Build (and cache) the automaton for a guided spec; raises

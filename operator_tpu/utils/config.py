@@ -77,6 +77,12 @@ class OperatorConfig:
     # prompt template's static preamble is prefilled once and admissions
     # forward only their suffix; paged mode only, exact (causal) reuse
     prefix_cache: bool = True
+    # program-grid precompile at warmup (engine.precompile_grid): compile
+    # every prefill/decode program admission can select BEFORE readiness
+    # flips — a mid-run XLA compile is a multi-second p99 outlier.
+    # "serving" = unguided grid; "full" adds guided variants; "off" = the
+    # pre-r5 behavior (first bucket hit pays its compile in-band)
+    warmup_grid: str = "serving"
     # nucleus-sampling candidate set (engine SAMPLE_TOP_K): top-p filtering
     # runs inside the top-k — raise for high-temperature diversity
     sample_top_k: int = 64

@@ -60,6 +60,10 @@ async def main() -> int:
     pin_cpu_if_requested()
     import jax
 
+    from operator_tpu.utils.compilewatch import CompileWatcher
+
+    compile_watch = CompileWatcher()
+
     from operator_tpu.operator.app import Operator
     from operator_tpu.operator.kubeapi import FakeKubeApi
     from operator_tpu.operator.storage import ANNOTATION_ANALYZED_AT
@@ -131,6 +135,11 @@ async def main() -> int:
         )
         await api.create("Podmortem", pm.to_dict())
         await app.watcher.cache.prime()
+
+        # everything compiled from here on is a mid-run compile: an SLO
+        # violation (the p99 tail at 100/min), not just noise.  The soak
+        # reports each one with its offset into the run and build time.
+        compile_watch.mark()
 
         rng = random.Random(0)
         started = time.monotonic()
@@ -222,6 +231,7 @@ async def main() -> int:
         resets = len(engine._reset_times)
 
         wall = time.monotonic() - started
+        midrun = compile_watch.events_since_mark()
         record = {
             "metric": "soak",
             "platform": platform,
@@ -237,6 +247,12 @@ async def main() -> int:
             "p90_s": round(_percentile(latencies, 0.90), 3),
             "p99_s": round(_percentile(latencies, 0.99), 3),
             "engine_resets": resets,
+            "midrun_compiles": len(midrun),
+            "midrun_compile_events": [
+                {"t_s": round(t, 1), "name": n,
+                 "build_s": round(d, 2) if d is not None else None}
+                for t, n, d in midrun[:40]
+            ],
             "leaks": leaks or None,
             "slo_p50_under_2s": (
                 bool(latencies) and _percentile(latencies, 0.50) < 2.0

@@ -146,8 +146,11 @@ def test_prefix_on_mesh(params, plan):
 
 def test_truncation_preserves_prefix_and_tail(params):
     """Over-budget prompts drop their MIDDLE when they start with the
-    cached prefix: the template head keeps the fast path (and the
-    instructions), the tail keeps the failure evidence."""
+    cached prefix: the template head keeps the instructions, the tail
+    keeps the failure evidence.  The truncated wave takes the PLAIN
+    prefill program — partial prefix reuse would specialise one program
+    per interior shared length, an unbounded compile surface that defeats
+    the warmup grid (engine._wave_shared_prefix is all-or-nothing)."""
     generator = _generator(params, max_seq=256)
     generator.set_shared_prefix(PREFIX)
     evidence = "the unique evidence marker at the very end"
@@ -167,10 +170,20 @@ def test_truncation_preserves_prefix_and_tail(params):
     # tail: the evidence marker survives verbatim at the end
     tail_text = generator.tokenizer.decode(truncated[-len(evidence):])
     assert evidence in tail_text
-    # and the engine actually takes the fast path for such a prompt
+    # the partially-matching truncated wave shares NOTHING (all-or-nothing)
+    assert generator._wave_shared_prefix([truncated], [SamplingParams()]) == 0
     sampling = SamplingParams(max_tokens=8, temperature=0.0, stop_on_eos=False)
     generator.admit([long_prompt], [sampling])
-    assert generator._prefix_fns, "prefix fast path should have been used"
+    assert not generator._prefix_fns, (
+        "truncated prompt must take the plain program, not specialise an "
+        "interior-shared prefix program"
+    )
+    assert generator._prefill_fns, "plain prefill should have run"
+    while generator.num_active:
+        generator.step()
+    # an untruncated template prompt still takes the fast path
+    generator.admit([PREFIX + "short suffix"], [sampling])
+    assert generator._prefix_fns, "full-prefix wave should use the fast path"
     while generator.num_active:
         generator.step()
     # without a cached prefix: plain tail-only truncation (head == 0)
