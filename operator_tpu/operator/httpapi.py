@@ -44,6 +44,7 @@ from .kubeapi import (
     KubeApi,
     NotFoundError,
     WatchClosed,
+    WatchExpired,
     WatchEvent,
 )
 
@@ -296,16 +297,25 @@ class HttpKubeApi(KubeApi):
         namespace: Optional[str] = None,
         label_selector: Optional[LabelSelector] = None,
     ) -> list[dict]:
+        items, _ = await self.list_rv(kind, namespace, label_selector)
+        return items
+
+    async def list_rv(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[LabelSelector] = None,
+    ) -> tuple[list[dict], Optional[str]]:
         path = self._path(kind, namespace)
         selector = _selector_string(label_selector)
         if selector:
             path += "?" + urllib.parse.urlencode({"labelSelector": selector})
         body = await self._request("GET", path)
-        kind_name = kind  # items omit kind/apiVersion; restore for callers
         items = body.get("items", [])
-        for item in items:
-            item.setdefault("kind", kind_name)
-        return items
+        for item in items:  # items omit kind/apiVersion; restore for callers
+            item.setdefault("kind", kind)
+        version = (body.get("metadata") or {}).get("resourceVersion")
+        return items, version
 
     async def create(self, kind: str, obj: dict) -> dict:
         namespace = obj.get("metadata", {}).get("namespace") or self.config.namespace
@@ -373,11 +383,21 @@ class HttpKubeApi(KubeApi):
 
     # -- watch ----------------------------------------------------------
     async def watch(
-        self, kind: str, namespace: Optional[str] = None
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        resource_version: Optional[str] = None,
     ) -> AsyncIterator[WatchEvent]:
-        """Stream ADDED/MODIFIED/DELETED events as JSON-lines.
+        """Stream ADDED/MODIFIED/DELETED/BOOKMARK events as JSON-lines.
 
-        The response is read line-by-line off-loop; server close raises
+        With ``resource_version`` the stream resumes from that point
+        (list+watch: pass the list's collection resourceVersion and no
+        event between the list and the watch is lost — the informer
+        discipline of the fabric8 client the reference runs on,
+        PodFailureWatcher.java:92).  Bookmarks are requested so callers
+        can refresh their cursor from quiet streams.  A compacted cursor
+        raises :class:`WatchExpired` (HTTP 410 / ERROR-410 event): relist
+        before watching again.  Other server closes raise
         :class:`WatchClosed` so the caller's restart-after-5s loop engages
         (reference PodFailureWatcher.java:562-583).
         """
@@ -387,13 +407,14 @@ class HttpKubeApi(KubeApi):
         # otherwise block readline in its worker thread forever and
         # silently stop failure detection — the fabric8 client the
         # reference relies on keeps watches live the same two ways
-        path = self._path(kind, namespace) + "?" + urllib.parse.urlencode(
-            {
-                "watch": "true",
-                "allowWatchBookmarks": "false",
-                "timeoutSeconds": str(int(self.watch_timeout_s)),
-            }
-        )
+        query = {
+            "watch": "true",
+            "allowWatchBookmarks": "true",
+            "timeoutSeconds": str(int(self.watch_timeout_s)),
+        }
+        if resource_version is not None:
+            query["resourceVersion"] = resource_version
+        path = self._path(kind, namespace) + "?" + urllib.parse.urlencode(query)
         conn = self._connect(timeout=self.watch_timeout_s + self._WATCH_SOCKET_MARGIN_S)
 
         def open_stream() -> Any:
@@ -405,6 +426,11 @@ class HttpKubeApi(KubeApi):
                 response = await asyncio.to_thread(open_stream)
             except (TimeoutError, OSError) as exc:
                 raise WatchClosed(f"watch open for {kind} failed: {exc}") from exc
+            if response.status == 410:
+                raise WatchExpired(
+                    f"watch resume for {kind} at resourceVersion "
+                    f"{resource_version!r} expired (410 Gone)"
+                )
             if response.status >= 400:
                 payload = await asyncio.to_thread(response.read)
                 _raise_for_status(response.status, payload, f"WATCH {path}")
@@ -424,12 +450,20 @@ class HttpKubeApi(KubeApi):
                     log.warning("unparseable watch line for %s: %.120r", kind, line)
                     continue
                 event_type = event.get("type", "")
-                if event_type == "BOOKMARK":
-                    continue
                 if event_type == "ERROR":
-                    raise WatchClosed(f"watch error for {kind}: {event.get('object')}")
+                    obj = event.get("object") or {}
+                    if obj.get("code") == 410:
+                        # etcd compacted past the resume cursor: the
+                        # caller must relist, not merely reconnect
+                        raise WatchExpired(
+                            f"watch resume for {kind} expired: "
+                            f"{obj.get('message', '410 Gone')}"
+                        )
+                    raise WatchClosed(f"watch error for {kind}: {obj}")
                 obj = event.get("object", {})
                 obj.setdefault("kind", kind)
+                # BOOKMARK events flow through: the caller refreshes its
+                # resume cursor from object.metadata.resourceVersion
                 yield WatchEvent(type=event_type, object=obj)
         finally:
             await asyncio.to_thread(conn.close)

@@ -59,6 +59,13 @@ class WatchClosed(Exception):
     PodFailureWatcher.java:562-583)."""
 
 
+class WatchExpired(WatchClosed):
+    """The resume resourceVersion is too old (HTTP 410 Gone / ERROR event
+    with code 410): the apiserver has compacted past it.  Callers must
+    relist (re-sweep) and watch from the fresh list's resourceVersion —
+    resuming from the stale cursor would silently drop events."""
+
+
 @dataclass
 class WatchEvent:
     type: str  # ADDED | MODIFIED | DELETED
@@ -84,6 +91,18 @@ class KubeApi:
         label_selector: Optional[LabelSelector] = None,
     ) -> list[dict]:
         raise NotImplementedError
+
+    async def list_rv(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[LabelSelector] = None,
+    ) -> tuple[list[dict], Optional[str]]:
+        """List plus the collection's resourceVersion — the resume cursor
+        a subsequent watch() starts from so nothing between the list and
+        the watch is missed.  None when the backend can't provide one
+        (callers then watch from "now" and rely on sweeps)."""
+        return await self.list(kind, namespace, label_selector), None
 
     async def create(self, kind: str, obj: dict) -> dict:
         raise NotImplementedError
@@ -125,8 +144,16 @@ class KubeApi:
         raise NotImplementedError
 
     def watch(
-        self, kind: str, namespace: Optional[str] = None
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        resource_version: Optional[str] = None,
     ) -> AsyncIterator[WatchEvent]:
+        """Stream events.  With ``resource_version`` the stream RESUMES
+        from that point (events after it are replayed), raising
+        :class:`WatchExpired` when the server compacted past it.  BOOKMARK
+        events surface to the caller (cursor refresh), everything else is
+        ADDED/MODIFIED/DELETED."""
         raise NotImplementedError
 
 
@@ -161,11 +188,20 @@ ErrorHook = Callable[[str, str, str], Optional[Exception]]
 
 
 class FakeKubeApi(KubeApi):
+    #: watch-history ring size per kind: events older than this are
+    #: compacted away and a resume from before them gets 410 (WatchExpired),
+    #: the real apiserver's etcd-compaction behavior
+    WATCH_HISTORY = 1024
+
     def __init__(self) -> None:
         self._objects: dict[str, dict[tuple[str, str], dict]] = {}
         self._logs: dict[tuple[str, str, bool], str] = {}
         self._rv = 0
         self._watches: list[_WatchRegistration] = []
+        # per-kind replay buffer [(rv_at_event, event)] + highest rv ever
+        # compacted out of it (0 = full history retained)
+        self._history: dict[str, list[tuple[int, WatchEvent]]] = {}
+        self._trimmed_through: dict[str, int] = {}
         self.error_hooks: list[ErrorHook] = []
 
     # --- error injection --------------------------------------------------
@@ -201,12 +237,22 @@ class FakeKubeApi(KubeApi):
 
     def _notify(self, event_type: str, kind: str, obj: dict) -> None:
         namespace = obj.get("metadata", {}).get("namespace")
+        event = WatchEvent(event_type, copy.deepcopy(obj))
+        history = self._history.setdefault(kind, [])
+        history.append((self._rv, event))
+        if len(history) > self.WATCH_HISTORY:
+            trimmed_rv, _ = history.pop(0)
+            self._trimmed_through[kind] = max(
+                self._trimmed_through.get(kind, 0), trimmed_rv
+            )
         for registration in list(self._watches):
             if registration.kind != kind:
                 continue
             if registration.namespace is not None and registration.namespace != namespace:
                 continue
-            registration.queue.put_nowait(WatchEvent(event_type, copy.deepcopy(obj)))
+            registration.queue.put_nowait(
+                WatchEvent(event_type, copy.deepcopy(obj))
+            )
 
     # --- KubeApi ----------------------------------------------------------
     async def get(self, kind: str, name: str, namespace: str) -> dict:
@@ -306,6 +352,9 @@ class FakeKubeApi(KubeApi):
         obj = bucket.pop((namespace, name), None)
         if obj is None:
             raise NotFoundError(f"{kind} {namespace}/{name} not found")
+        # deletion is a store write: it gets its own resourceVersion (so a
+        # watch resume strictly after the previous event still replays it)
+        obj["metadata"]["resourceVersion"] = self._next_rv()
         self._notify("DELETED", kind, obj)
 
     # --- pod logs ---------------------------------------------------------
@@ -335,11 +384,36 @@ class FakeKubeApi(KubeApi):
 
     # --- watch ------------------------------------------------------------
     async def watch(  # type: ignore[override]
-        self, kind: str, namespace: Optional[str] = None
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        resource_version: Optional[str] = None,
     ) -> AsyncIterator[WatchEvent]:
+        replayed: list[WatchEvent] = []
+        if resource_version is not None:
+            since = int(resource_version)
+            if since < self._trimmed_through.get(kind, 0):
+                raise WatchExpired(
+                    f"resourceVersion {resource_version} for {kind} is too "
+                    f"old (compacted through "
+                    f"{self._trimmed_through.get(kind, 0)})"
+                )
+            for rv, event in self._history.get(kind, []):
+                if rv <= since:
+                    continue
+                obj_ns = event.object.get("metadata", {}).get("namespace")
+                if namespace is not None and obj_ns != namespace:
+                    continue
+                replayed.append(
+                    WatchEvent(event.type, copy.deepcopy(event.object))
+                )
+        # snapshot-then-register runs with no await in between, so no event
+        # can land in both the replay list and the live queue
         registration = _WatchRegistration(kind=kind, namespace=namespace)
         self._watches.append(registration)
         try:
+            for event in replayed:
+                yield event
             while True:
                 event = await registration.queue.get()
                 if isinstance(event, Exception):
@@ -356,6 +430,39 @@ class FakeKubeApi(KubeApi):
             registration.queue.put_nowait(RuntimeError(error))
             closed += 1
         return closed
+
+    def bookmark_watches(self, kind: Optional[str] = None) -> int:
+        """Deliver a BOOKMARK event (current resourceVersion, no object
+        payload) to open watches — the apiserver's periodic cursor
+        refresh when allowWatchBookmarks is on."""
+        sent = 0
+        for registration in list(self._watches):
+            if kind is not None and registration.kind != kind:
+                continue
+            registration.queue.put_nowait(WatchEvent(
+                "BOOKMARK",
+                {
+                    "kind": registration.kind,
+                    "metadata": {"resourceVersion": str(self._rv)},
+                },
+            ))
+            sent += 1
+        return sent
+
+    def compact_watch_history(self, kind: str) -> None:
+        """Drop the retained event history for ``kind`` — a subsequent
+        resume from any pre-compaction resourceVersion gets 410
+        (WatchExpired), the etcd-compaction path."""
+        self._history[kind] = []
+        self._trimmed_through[kind] = self._rv
+
+    async def list_rv(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[LabelSelector] = None,
+    ) -> tuple[list[dict], Optional[str]]:
+        return await self.list(kind, namespace, label_selector), str(self._rv)
 
     # --- typed convenience (tests) ---------------------------------------
     async def create_obj(self, obj: Any) -> dict:

@@ -13,6 +13,10 @@ Retained behaviours: namespace allowlist (:52-79), MODIFIED-event filter
 (:107), non-zero-exit detection (:147-159), failure-time keyed dedupe
 (:180-193), fan-out of one pipeline per matching CR (:196-199), and
 auto-restart of a closed watch after a delay (:127-135,562-583).
+
+Beyond the reference: the pod watch is a list+watch with resourceVersion
+resume (bookmarks on, 410 -> relist) — the reference's informer client does
+this internally; its hand-rolled gap coverage is the poll reconciler alone.
 """
 
 from __future__ import annotations
@@ -26,7 +30,7 @@ from ..schema.crds import Podmortem
 from ..schema.kube import ContainerStatus, Pod
 from ..utils.config import OperatorConfig
 from ..utils.timing import METRICS, MetricsRegistry
-from .kubeapi import KubeApi, WatchClosed
+from .kubeapi import KubeApi, WatchClosed, WatchExpired
 from .pipeline import AnalysisPipeline
 
 log = logging.getLogger(__name__)
@@ -104,6 +108,11 @@ class PodmortemCache:
                 if not self._primed:
                     await self.prime()
                 async for event in self.api.watch("Podmortem"):
+                    if event.type == "BOOKMARK":
+                        # cursor-refresh only: its object is bare metadata
+                        # that would otherwise parse into a phantom CR whose
+                        # empty selector matches EVERY pod
+                        continue
                     try:
                         pm = Podmortem.parse(event.object)
                     except Exception:  # noqa: BLE001 - skip malformed objects
@@ -158,6 +167,10 @@ class PodFailureWatcher:
         self._max_dedupe = max_dedupe_entries
         self._tasks: set[asyncio.Task] = set()
         self.restarts = 0
+        # per-namespace watch resume cursor (resourceVersion): a reconnect
+        # resumes exactly where the stream dropped, replaying the gap
+        # server-side — None forces the blind-window sweep + fresh list
+        self._cursors: dict[Optional[str], Optional[str]] = {}
 
     # ------------------------------------------------------------------
     def _allowed(self, namespace: Optional[str]) -> bool:
@@ -253,28 +266,64 @@ class PodFailureWatcher:
             await asyncio.gather(*tasks, return_exceptions=True)
 
     async def _watch_one(self, namespace: Optional[str], stop: asyncio.Event) -> None:
-        # close the blind window between watch sessions: the stream recycles
-        # every watch_timeout_s (and on any network failure), and a pod that
-        # failed during the gap will never emit another event — sweep current
-        # pods first; dedupe makes re-observation free (reference covers this
-        # with its poll-path reconciler, we cover it at both layers)
-        try:
-            for raw in await self.api.list("Pod", namespace):
-                try:
-                    await self.handle_pod_event("MODIFIED", Pod.parse(raw))
-                except Exception:  # noqa: BLE001 - one bad pod shouldn't kill the sweep
-                    log.exception("pre-watch sweep failed for one pod; skipping")
-        except Exception:  # noqa: BLE001 - sweep is best-effort; watch still runs
-            log.warning("pre-watch pod sweep failed; relying on reconciler", exc_info=True)
-        async for event in self.api.watch("Pod", namespace):
+        # list+watch with resourceVersion resume (the informer discipline of
+        # the client the reference runs on, PodFailureWatcher.java:92): with
+        # a live cursor the stream resumes exactly where it dropped and the
+        # apiserver REPLAYS the gap — no blind window, no sweep needed.
+        # Without one (first run, or after a 410 told us the cursor was
+        # compacted away) sweep current pods AND capture the list's
+        # collection resourceVersion so the subsequent watch starts exactly
+        # where the sweep observed; dedupe makes re-observation free
+        # (reference covers the gap with its poll-path reconciler only)
+        cursor = self._cursors.get(namespace)
+        if cursor is None:
             try:
-                pod = Pod.parse(event.object)
-            except Exception:  # noqa: BLE001 - skip malformed objects
-                log.exception("unparseable Pod watch event; skipping")
-                continue
-            await self.handle_pod_event(event.type, pod)
-            if stop.is_set():
-                return
+                items, cursor = await self.api.list_rv("Pod", namespace)
+                for raw in items:
+                    try:
+                        await self.handle_pod_event("MODIFIED", Pod.parse(raw))
+                    except Exception:  # noqa: BLE001 - one bad pod shouldn't kill the sweep
+                        log.exception("pre-watch sweep failed for one pod; skipping")
+            except Exception:  # noqa: BLE001 - sweep is best-effort; watch still runs
+                cursor = None
+                log.warning("pre-watch pod sweep failed; relying on reconciler",
+                            exc_info=True)
+            # persist immediately: a stream that drops before delivering a
+            # single event must still resume from the LIST's version, not
+            # relist (the list already observed everything up to it)
+            self._cursors[namespace] = cursor
+        try:
+            async for event in self.api.watch(
+                "Pod", namespace, resource_version=cursor
+            ):
+                version = (event.object.get("metadata") or {}).get(
+                    "resourceVersion"
+                )
+                if event.type == "BOOKMARK":
+                    if version:
+                        self._cursors[namespace] = version
+                    continue
+                try:
+                    pod = Pod.parse(event.object)
+                except Exception:  # noqa: BLE001 - skip malformed objects
+                    log.exception("unparseable Pod watch event; skipping")
+                    if version:
+                        self._cursors[namespace] = version
+                    continue
+                await self.handle_pod_event(event.type, pod)
+                # cursor advances only AFTER the handler returns: if it
+                # raises, the restart resumes AT this event and the server
+                # replays it (there is no per-restart sweep to catch a
+                # skipped failure anymore)
+                if version:
+                    self._cursors[namespace] = version
+                if stop.is_set():
+                    return
+        except WatchExpired:
+            # the apiserver compacted past our cursor: resuming would drop
+            # events silently — clear it so the restart path relists
+            self._cursors[namespace] = None
+            raise
 
     async def drain(self) -> None:
         """Wait for in-flight pipelines (tests/shutdown)."""

@@ -180,8 +180,8 @@ def test_watch_streams_then_raises_closed(api):
         return seen
 
     events = asyncio.run(main())
-    # bookmark filtered out
-    assert [e.type for e in events] == ["ADDED", "MODIFIED"]
+    # bookmarks flow through so callers can refresh their resume cursor
+    assert [e.type for e in events] == ["ADDED", "BOOKMARK", "MODIFIED"]
     assert events[0].object["kind"] == "Pod"
 
 
