@@ -68,7 +68,11 @@ class WatchExpired(WatchClosed):
 
 @dataclass
 class WatchEvent:
-    type: str  # ADDED | MODIFIED | DELETED
+    #: ADDED | MODIFIED | DELETED | BOOKMARK — bookmarks carry only
+    #: metadata.resourceVersion (cursor refresh); consumers MUST skip them
+    #: before parsing (a bookmark parsed as a CR is a phantom object whose
+    #: empty selector matches everything)
+    type: str
     object: dict[str, Any]
 
 

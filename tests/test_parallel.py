@@ -110,6 +110,30 @@ def test_dryrun_multichip_entry():
     entrypoints.dryrun_multichip(8)
 
 
+def test_dryrun_multichip_16_devices():
+    """The v5e-16 factorisations (dp4·tp4 serving, fsdp4·tp4 training) run
+    end to end — a 16-virtual-device subprocess because the suite's own
+    backend is pinned to 8 devices at conftest import."""
+    import os
+    import pathlib
+    import subprocess
+    import sys
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=16",
+        PYTHONPATH=str(repo),
+    )
+    out = subprocess.run(
+        [sys.executable, str(repo / "__graft_entry__.py"), "16"],
+        capture_output=True, text=True, timeout=900, env=env, cwd=str(repo),
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert out.stdout.count("[dryrun_multichip] 16-device ok") == 2, out.stdout
+
+
 def test_entry_compiles_tiny():
     import os
 
