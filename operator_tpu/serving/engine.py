@@ -23,6 +23,15 @@ prefill / decode-step / sampler); :class:`ServingEngine` is the asyncio
 front the operator talks to (queue, admission, futures).  The split keeps
 the JAX code testable without an event loop.
 
+Module layout (round-5 split; this module remains the public import
+surface): program construction lives in :mod:`.programs`
+(ProgramBuilderMixin — every jitted XLA program), admission policy in
+:mod:`.admission` (AdmissionMixin — wave formation, truncation, prefix
+decision, page grants, warmup grid), shared dataclasses in :mod:`.types`.
+This module keeps the STATE and the loops: slot/cache/page lifecycle,
+decode stepping + pipelining, guided-automaton registry, chunked-prefill
+job advancement, and the async engine.
+
 Grown-in serving subsystems (each opt-in or zero-cost when unused):
 multi-step decode blocks + decode-ahead pipelining; sharded TP/DP serving
 over a mesh; multi-LoRA (per-slot adapters stacked into one program);
@@ -36,13 +45,10 @@ and slot/page reclamation for cancelled callers.
 from __future__ import annotations
 
 import asyncio
-import functools
 import itertools
 import logging
-import os
 import threading
 import time
-from dataclasses import dataclass, field
 from typing import Any, Optional, Sequence
 
 import numpy as np
@@ -51,122 +57,25 @@ from ..models.configs import ModelConfig
 from ..models.llama import KVCache, forward
 from ..models.tokenizer import Tokenizer
 from ..utils.timing import METRICS, MetricsRegistry
+from .admission import AdmissionMixin
+from .programs import ProgramBuilderMixin
+
+# re-exported types: the public import surface predates the round-5 module
+# split (every consumer does `from operator_tpu.serving.engine import ...`)
+from .types import (  # noqa: F401
+    GenerationResult,
+    OversizedRequest,
+    PageAllocator,
+    SamplingParams,
+    _bucket,
+    _PrefillJob,
+    _Slot,
+)
 
 log = logging.getLogger(__name__)
 
 
-@dataclass(frozen=True)
-class SamplingParams:
-    max_tokens: int = 256
-    temperature: float = 0.3  # reference default, aiprovider-crd.yaml:56-58
-    top_p: float = 0.95
-    stop_on_eos: bool = True
-    #: LoRA adapter name for this request (multi-LoRA serving: every slot
-    #: picks its own adapter from the generator's stacked registry; None =
-    #: base model).  Unknown names are rejected at admission.
-    adapter: Optional[str] = None
-    #: constrain the output to one of these strings (serving/guided.py):
-    #: a token-trie automaton rides the decode scan as device state and
-    #: masks the sampler every step.  None = unconstrained.
-    guided_choice: Optional[tuple] = None
-    #: constrain the output to match this regex (serving/regex_dfa.py:
-    #: byte-level DFA, token closure, same device-state machinery).
-    #: Mutually exclusive with guided_choice.
-    guided_regex: Optional[str] = None
-
-
-@dataclass
-class GenerationResult:
-    text: str
-    token_ids: list[int]
-    prompt_tokens: int
-    completion_tokens: int
-    finish_reason: str  # "stop" | "length"
-    prefill_ms: float = 0.0
-    decode_ms: float = 0.0
-
-    @property
-    def total_ms(self) -> float:
-        return self.prefill_ms + self.decode_ms
-
-
-@dataclass
-class _Slot:
-    active: bool = False
-    prompt_len: int = 0
-    generated: list[int] = field(default_factory=list)
-    params: SamplingParams = field(default_factory=SamplingParams)
-    started: float = 0.0
-    prefill_ms: float = 0.0
-    pages: list[int] = field(default_factory=list)  # paged mode only
-
-
-@dataclass
-class _PrefillJob:
-    """An in-progress chunked prefill (engine.prefill_chunk).
-
-    Device state (the bucket mini cache and the running last-token logits)
-    carries across chunk calls; host arrays describe the admitted wave the
-    same way _admit_batch's one-shot path does."""
-
-    key: tuple  # (n_pad, t_pad)
-    ids: Any  # [n_pad, t_pad] device tokens
-    lengths_np: Any
-    lengths: Any  # device
-    temp: Any
-    top_p: Any
-    slot_ids_np: Any  # padded rows duplicate row 0
-    taken: list
-    params_list: list
-    page_grants: list
-    adapter_idx: Any  # device or None
-    mini: Any  # KVCache carry
-    last_logits: Any  # [n_pad, vocab] carry
-    written: int
-    chunk_ms: float = 0.0  # accumulated chunk compute (not interleaved wall)
-
-
-class OversizedRequest(ValueError):
-    """A single request needs more KV pages than the whole cache holds."""
-
-
-def _bucket(n: int, floor: int, cap: int) -> int:
-    """Smallest power-of-two >= n, clamped to [floor, cap]."""
-    size = floor
-    while size < n and size < cap:
-        size *= 2
-    return min(size, cap)
-
-
-class PageAllocator:
-    """Host-side free list for the paged KV cache (ops/paged_attention.py).
-
-    Page 0 is reserved as the trash page: padded prefill rows and released
-    slots write there, so a page handed to a live sequence is never touched
-    by anyone else.  Allocation is worst-case up front (prompt + max new
-    tokens), which keeps the device page table static for a sequence's
-    whole lifetime — no mid-decode growth, no host sync in the decode loop.
-    """
-
-    def __init__(self, num_pages: int) -> None:
-        assert num_pages >= 2, "need at least one real page beyond the trash page"
-        self.num_pages = num_pages
-        self._free = list(range(num_pages - 1, 0, -1))  # pop() yields low ids first
-
-    @property
-    def available(self) -> int:
-        return len(self._free)
-
-    def allocate(self, count: int) -> list[int]:
-        if count > len(self._free):
-            raise MemoryError(f"KV pages exhausted: want {count}, have {len(self._free)}")
-        return [self._free.pop() for _ in range(count)]
-
-    def release(self, pages: list[int]) -> None:
-        self._free.extend(pages)
-
-
-class BatchedGenerator:
+class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
     """Slot-based generation over one shared KV cache (single host thread).
 
     Not thread-safe by design: the ServingEngine serialises all calls on
@@ -417,191 +326,6 @@ class BatchedGenerator:
             ),
         }
 
-    # ------------------------------------------------------------------
-    # jitted bodies
-    # ------------------------------------------------------------------
-
-    def _decode_step(self, params, cache, tokens, offsets, rng, temp, top_p, active,
-                     lora=None, lora_idx=None,
-                     gtables=None, gaut=None, gstate=None):
-        """[B,1] tokens at per-slot offsets -> next token per slot."""
-        jnp = self._jnp
-        positions = offsets[:, None]
-        logits, cache = forward(
-            params, self.config, tokens, positions, cache=cache, cache_offset=offsets,
-            lora=lora, lora_alpha=self.lora_alpha, lora_indices=lora_idx,
-        )
-        last = logits[:, -1, :]
-        if gtables is not None:
-            row = gtables[gaut, gstate]
-            last = jnp.where(row >= 0, last, -jnp.inf)
-        next_tokens, rng = self._sample(last, rng, temp, top_p)
-        # inactive slots keep decoding garbage into their own slot space;
-        # offsets only advance for active ones so their state is untouched
-        offsets = jnp.where(active, offsets + 1, offsets)
-        if gtables is None:
-            return cache, next_tokens, offsets, rng
-        stepped = jnp.take_along_axis(row, next_tokens[:, None], axis=1)[:, 0]
-        gstate = jnp.where(active & (stepped >= 0), stepped, gstate)
-        return cache, next_tokens, offsets, rng, gstate
-
-    def _decode_step_paged(self, params, paged, tokens, rng, temp, top_p, active,
-                           lora=None, lora_idx=None,
-                           gtables=None, gaut=None, gstate=None):
-        """Paged twin of :meth:`_decode_step` (released slots write to the
-        trash page via their zeroed page-table row; their lengths stay put).
-        With guided args, the sampler is masked by the automaton row and the
-        per-slot DFA state advances — returned as an extra carry."""
-        from ..models.llama import decode_step_paged
-        from ..ops.paged_attention import PagedKVCache
-
-        jnp = self._jnp
-        logits, new_paged = decode_step_paged(
-            params, self.config, tokens, paged,
-            lora=lora, lora_alpha=self.lora_alpha, lora_indices=lora_idx,
-        )
-        if gtables is not None:
-            row = gtables[gaut, gstate]  # [B, vocab] allowed-transition rows
-            logits = jnp.where(row >= 0, logits, -jnp.inf)
-        next_tokens, rng = self._sample(logits, rng, temp, top_p)
-        lengths = jnp.where(active, new_paged.lengths, paged.lengths)
-        new_paged = PagedKVCache(
-            k_pages=new_paged.k_pages, v_pages=new_paged.v_pages,
-            page_table=new_paged.page_table, lengths=lengths,
-        )
-        if gtables is None:
-            return new_paged, next_tokens, rng
-        stepped = jnp.take_along_axis(row, next_tokens[:, None], axis=1)[:, 0]
-        gstate = jnp.where(active & (stepped >= 0), stepped, gstate)
-        return new_paged, next_tokens, rng, gstate
-
-    #: unroll the K-step decode block into straight-line XLA instead of a
-    #: lax.scan: a scan CARRIES the whole KV cache/page pool, and XLA's
-    #: loop handling may double-buffer (copy) the carry every iteration —
-    #: unrolled, updates chain without loop plumbing.  Experiment knob
-    #: (scripts/tpu_experiments.sh); compile time grows ~K-fold.
-    DECODE_UNROLL = os.environ.get("OPERATOR_TPU_DECODE_UNROLL", "0") == "1"
-
-    def _decode_block(self, params, cache, tokens, offsets, rng, temp, top_p, active,
-                      lora=None, lora_idx=None):
-        """K chained decode steps in one program; returns the [K, B] token
-        matrix plus final carry state.  lax.scan by default, straight-line
-        unrolled under OPERATOR_TPU_DECODE_UNROLL=1 (see DECODE_UNROLL)."""
-        jax, jnp = self._jax, self._jnp
-
-        if self.DECODE_UNROLL:
-            toks = []
-            for _ in range(self.decode_block):
-                cache, next_tokens, offsets, rng = self._decode_step(
-                    params, cache, tokens, offsets, rng, temp, top_p, active,
-                    lora, lora_idx,
-                )
-                tokens = next_tokens[:, None]
-                toks.append(next_tokens)
-            return cache, jnp.stack(toks), tokens, offsets, rng
-
-        def body(carry, _):
-            cache, tokens, offsets, rng = carry
-            cache, next_tokens, offsets, rng = self._decode_step(
-                params, cache, tokens, offsets, rng, temp, top_p, active,
-                lora, lora_idx,
-            )
-            return (cache, next_tokens[:, None], offsets, rng), next_tokens
-
-        (cache, last, offsets, rng), toks = jax.lax.scan(
-            body, (cache, tokens, offsets, rng), None, length=self.decode_block
-        )
-        return cache, toks, last, offsets, rng
-
-    def _decode_block_paged(self, params, paged, tokens, rng, temp, top_p, active,
-                            lora=None, lora_idx=None):
-        jax, jnp = self._jax, self._jnp
-
-        if self.DECODE_UNROLL:
-            toks = []
-            for _ in range(self.decode_block):
-                paged, next_tokens, rng = self._decode_step_paged(
-                    params, paged, tokens, rng, temp, top_p, active,
-                    lora, lora_idx,
-                )
-                tokens = next_tokens[:, None]
-                toks.append(next_tokens)
-            return paged, jnp.stack(toks), tokens, rng
-
-        def body(carry, _):
-            paged, tokens, rng = carry
-            paged, next_tokens, rng = self._decode_step_paged(
-                params, paged, tokens, rng, temp, top_p, active,
-                lora, lora_idx,
-            )
-            return (paged, next_tokens[:, None], rng), next_tokens
-
-        (paged, last, rng), toks = jax.lax.scan(
-            body, (paged, tokens, rng), None, length=self.decode_block
-        )
-        return paged, toks, last, rng
-
-    def _decode_block_guided(self, params, cache, tokens, offsets, rng, temp,
-                             top_p, active, lora, lora_idx,
-                             gtables, gaut, gstate):
-        """Guided twin of :meth:`_decode_block`: the DFA state joins the
-        scan carry, so masking and stepping never leave the device."""
-        jax, jnp = self._jax, self._jnp
-
-        if self.DECODE_UNROLL:
-            toks = []
-            for _ in range(self.decode_block):
-                cache, next_tokens, offsets, rng, gstate = self._decode_step(
-                    params, cache, tokens, offsets, rng, temp, top_p, active,
-                    lora, lora_idx, gtables, gaut, gstate,
-                )
-                tokens = next_tokens[:, None]
-                toks.append(next_tokens)
-            return cache, jnp.stack(toks), tokens, offsets, rng, gstate
-
-        def body(carry, _):
-            cache, tokens, offsets, rng, gstate = carry
-            cache, next_tokens, offsets, rng, gstate = self._decode_step(
-                params, cache, tokens, offsets, rng, temp, top_p, active,
-                lora, lora_idx, gtables, gaut, gstate,
-            )
-            return (cache, next_tokens[:, None], offsets, rng, gstate), next_tokens
-
-        (cache, last, offsets, rng, gstate), toks = jax.lax.scan(
-            body, (cache, tokens, offsets, rng, gstate), None,
-            length=self.decode_block,
-        )
-        return cache, toks, last, offsets, rng, gstate
-
-    def _decode_block_paged_guided(self, params, paged, tokens, rng, temp,
-                                   top_p, active, lora, lora_idx,
-                                   gtables, gaut, gstate):
-        jax, jnp = self._jax, self._jnp
-
-        if self.DECODE_UNROLL:
-            toks = []
-            for _ in range(self.decode_block):
-                paged, next_tokens, rng, gstate = self._decode_step_paged(
-                    params, paged, tokens, rng, temp, top_p, active,
-                    lora, lora_idx, gtables, gaut, gstate,
-                )
-                tokens = next_tokens[:, None]
-                toks.append(next_tokens)
-            return paged, jnp.stack(toks), tokens, rng, gstate
-
-        def body(carry, _):
-            paged, tokens, rng, gstate = carry
-            paged, next_tokens, rng, gstate = self._decode_step_paged(
-                params, paged, tokens, rng, temp, top_p, active,
-                lora, lora_idx, gtables, gaut, gstate,
-            )
-            return (paged, next_tokens[:, None], rng, gstate), next_tokens
-
-        (paged, last, rng, gstate), toks = jax.lax.scan(
-            body, (paged, tokens, rng, gstate), None, length=self.decode_block
-        )
-        return paged, toks, last, rng, gstate
-
     def _put_batch_vec(self, array):
         """Place a per-slot [B] vector: batch sharding under a mesh (one
         host->mesh transfer), plain device array otherwise.  The one
@@ -635,55 +359,6 @@ class BatchedGenerator:
                 jnp.asarray(np.asarray(taken, np.int32))
             ].set(first_state[: len(taken)])
         )
-
-    def _get_guided_decode_fn(self):
-        if self._decode_fn_guided is None:
-            jax = self._jax
-            body = (
-                self._decode_block_paged_guided if self.paged
-                else self._decode_block_guided
-            )
-            if self.mesh is None:
-                self._decode_fn_guided = jax.jit(body, donate_argnums=(1,))
-            else:
-                # mirrors the unguided mesh programs: automaton tables
-                # replicate (tens of MB, read-only), per-slot aut/state
-                # shard over the data axes with the other [B] vectors
-                from jax.sharding import NamedSharding, PartitionSpec as P
-
-                s = self._shardings
-                block_tokens = NamedSharding(self.mesh, P(None, ("dp", "fsdp")))
-                if self.paged:
-                    self._decode_fn_guided = jax.jit(
-                        body,
-                        in_shardings=(
-                            self._param_shardings, s["paged"], s["tokens"],
-                            s["repl"], s["batch"], s["batch"], s["batch"],
-                            s["repl"], s["batch"],  # lora stack, idx
-                            s["repl"], s["batch"], s["batch"],  # tables, aut, state
-                        ),
-                        out_shardings=(
-                            s["paged"], block_tokens, s["tokens"], s["repl"],
-                            s["batch"],
-                        ),
-                        donate_argnums=(1,),
-                    )
-                else:
-                    self._decode_fn_guided = jax.jit(
-                        body,
-                        in_shardings=(
-                            self._param_shardings, s["cache"], s["tokens"],
-                            s["batch"], s["repl"], s["batch"], s["batch"],
-                            s["batch"], s["repl"], s["batch"],
-                            s["repl"], s["batch"], s["batch"],
-                        ),
-                        out_shardings=(
-                            s["cache"], block_tokens, s["tokens"], s["batch"],
-                            s["repl"], s["batch"],
-                        ),
-                        donate_argnums=(1,),
-                    )
-        return self._decode_fn_guided
 
     # ------------------------------------------------------------------
     # guided decoding registry (serving/guided.py)
@@ -848,176 +523,6 @@ class BatchedGenerator:
                 np.zeros((self.max_slots,), np.int32)
             )
 
-    #: nucleus-sampling candidate-set size (constructor: ``sample_top_k``).
-    #: A full-vocab ``top_k`` is a 32k-128k element sort on the TPU vector
-    #: units EVERY decode step, so sampling is truncated to the top-k
-    #: candidates FIRST and the top-p cutoff computed within them — i.e.
-    #: the served distribution is top-k AND top-p composed, the standard
-    #: serving trade.  At this system's temperatures (0.3 default,
-    #: aiprovider-crd.yaml:56-58) the top-64 hold ~all the nucleus mass; at
-    #: temperatures ~1+ the truncation measurably narrows diversity vs true
-    #: nucleus sampling — raise sample_top_k (e.g. 256) if that matters
-    #: more than decode latency.
-    SAMPLE_TOP_K = 64
-
-    def _sample(self, logits, rng, temp, top_p):
-        """Temperature + truncated-nucleus sampling; temp<=0 means greedy.
-
-        [B, V] logits -> [B] token ids.  top-p filtering runs inside the
-        top-``sample_top_k`` candidates (renormalised by categorical), not
-        the full vocab — see SAMPLE_TOP_K above for the semantics trade.
-        """
-        jax, jnp = self._jax, self._jnp
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
-        safe_temp = jnp.maximum(temp, 1e-4)[:, None]
-        scaled = logits.astype(jnp.float32) / safe_temp
-        k = min(self.sample_top_k, logits.shape[-1])
-        top_logits, top_idx = jax.lax.top_k(scaled, k)
-        probs = jax.nn.softmax(top_logits, axis=-1)
-        cumulative = jnp.cumsum(probs, axis=-1) - probs  # exclusive prefix
-        keep = cumulative < top_p[:, None]  # first token always kept
-        filtered = jnp.where(keep, top_logits, -jnp.inf)
-        rng, sub = jax.random.split(rng)
-        choice = jax.random.categorical(sub, filtered, axis=-1)
-        sampled = jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0]
-        picked = jnp.where(temp <= 0.0, greedy, sampled.astype(jnp.int32))
-        return picked, rng
-
-    def _prefill_shardings(self, n_pad: int):
-        """(row, vec) shardings for a prefill bucket.  dp-aware admission
-        (_admit_batch) always pads the bucket to a multiple of dp*fsdp, so
-        rows shard over the data axes unconditionally."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        assert n_pad % self._dp_total == 0, (n_pad, self._dp_total)
-        return (
-            NamedSharding(self.mesh, P(("dp", "fsdp"), None)),
-            NamedSharding(self.mesh, P(("dp", "fsdp"))),
-        )
-
-    def _prefill_score_shards(self) -> int:
-        """Devices the prefill batch axis is sharded over — the
-        chunked-attention budget is per-device (models/llama.py)."""
-        return self._dp_total if self.mesh is not None else 1
-
-    def _make_prefill(self, n_pad: int, t_pad: int, guided: bool = False):
-        """Compile a prefill program for the (n_pad, t_pad) bucket."""
-        jax, jnp = self._jax, self._jnp
-        config = self.config
-        score_shards = self._prefill_score_shards()
-
-        def prefill_fn(params, cache, token_ids, lengths, slot_ids, rng, temp, top_p,
-                       lora=None, lora_idx=None, gtables=None, gaut=None):
-            # fresh contiguous mini-cache for the prompt tokens
-            mini = KVCache.create(config, n_pad, t_pad, dtype=cache.k.dtype)
-            positions = jnp.broadcast_to(
-                jnp.arange(t_pad, dtype=jnp.int32)[None], (n_pad, t_pad)
-            )
-            kv_valid = positions < lengths[:, None]
-            # kv_valid (not a materialised mask) so long buckets take the
-            # chunked-prefill path in models/llama.py — no [T, S] f32 scores
-            logits, mini = forward(
-                params, config, token_ids, positions, cache=mini,
-                cache_offset=0, kv_valid=kv_valid, score_shards=score_shards,
-                prefill_lengths=lengths,
-                lora=lora, lora_alpha=self.lora_alpha, lora_indices=lora_idx,
-            )
-            # scatter the prompt KV into the big cache rows for these slots
-            # (slot axis is axis 1 of [L, B, S, KH, D])
-            k = cache.k.at[:, slot_ids, :t_pad].set(mini.k.astype(cache.k.dtype))
-            v = cache.v.at[:, slot_ids, :t_pad].set(mini.v.astype(cache.v.dtype))
-            last = jnp.take_along_axis(
-                logits, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
-            )[:, 0, :]
-            if guided:
-                row = gtables[gaut, jnp.zeros_like(gaut)]  # DFA start state
-                last = jnp.where(row >= 0, last, -jnp.inf)
-            first_tokens, rng = self._sample(last, rng, temp, top_p)
-            if guided:
-                first_state = jnp.take_along_axis(
-                    row, first_tokens[:, None], axis=1
-                )[:, 0]
-                return KVCache(k=k, v=v), first_tokens, rng, jnp.maximum(first_state, 0)
-            return KVCache(k=k, v=v), first_tokens, rng
-
-        if self.mesh is None:
-            return jax.jit(prefill_fn)
-        s = self._shardings
-        rows, vec = self._prefill_shardings(n_pad)
-        in_shardings = (
-            self._param_shardings, s["cache"], rows, vec, vec,
-            s["repl"], vec, vec, s["repl"], vec,
-        )
-        out_shardings = (s["cache"], vec, s["repl"])
-        if guided:
-            in_shardings += (s["repl"], vec)   # tables, row automaton ids
-            out_shardings += (vec,)            # first DFA state per row
-        return jax.jit(
-            prefill_fn, in_shardings=in_shardings, out_shardings=out_shardings
-        )
-
-    def _make_prefill_paged(self, n_pad: int, t_pad: int, guided: bool = False):
-        """Prefill for the paged cache: same mini-cache forward, then the
-        prompt KV scatters into each sequence's pages (write_tokens with
-        valid_len so padded rows land in the trash page)."""
-        jax, jnp = self._jax, self._jnp
-        config = self.config
-        score_shards = self._prefill_score_shards()
-
-        def prefill_fn(params, paged, token_ids, lengths, row_tables, rng, temp, top_p,
-                       lora=None, lora_idx=None, gtables=None, gaut=None):
-            from ..ops.paged_attention import PagedKVCache, write_tokens
-
-            mini = KVCache.create(config, n_pad, t_pad, dtype=paged.k_pages.dtype)
-            positions = jnp.broadcast_to(
-                jnp.arange(t_pad, dtype=jnp.int32)[None], (n_pad, t_pad)
-            )
-            kv_valid = positions < lengths[:, None]
-            logits, mini = forward(
-                params, config, token_ids, positions, cache=mini,
-                cache_offset=0, kv_valid=kv_valid, score_shards=score_shards,
-                prefill_lengths=lengths,
-                lora=lora, lora_alpha=self.lora_alpha, lora_indices=lora_idx,
-            )
-            zero = jnp.zeros((n_pad,), jnp.int32)
-            scatter = jax.vmap(write_tokens, in_axes=(0, None, 0, None, None))
-            k_pages = scatter(paged.k_pages, row_tables, mini.k, zero, lengths)
-            v_pages = scatter(paged.v_pages, row_tables, mini.v, zero, lengths)
-            last = jnp.take_along_axis(
-                logits, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
-            )[:, 0, :]
-            if guided:
-                row = gtables[gaut, jnp.zeros_like(gaut)]  # DFA start state
-                last = jnp.where(row >= 0, last, -jnp.inf)
-            first_tokens, rng = self._sample(last, rng, temp, top_p)
-            new_paged = PagedKVCache(
-                k_pages=k_pages, v_pages=v_pages,
-                page_table=paged.page_table, lengths=paged.lengths,
-            )
-            if guided:
-                first_state = jnp.take_along_axis(
-                    row, first_tokens[:, None], axis=1
-                )[:, 0]
-                return new_paged, first_tokens, rng, jnp.maximum(first_state, 0)
-            return new_paged, first_tokens, rng
-
-        if self.mesh is None:
-            return jax.jit(prefill_fn)
-        s = self._shardings
-        rows, vec = self._prefill_shardings(n_pad)
-        in_shardings = (
-            self._param_shardings, s["paged"], rows, vec, rows,
-            s["repl"], vec, vec, s["repl"], vec,
-        )
-        out_shardings = (s["paged"], vec, s["repl"])
-        if guided:
-            in_shardings += (s["repl"], vec)
-            out_shardings += (vec,)
-        return jax.jit(
-            prefill_fn, in_shardings=in_shardings, out_shardings=out_shardings
-        )
-
     # ------------------------------------------------------------------
     # shared-prefix KV cache (automatic prefix caching, paged mode)
     # ------------------------------------------------------------------
@@ -1122,162 +627,6 @@ class BatchedGenerator:
         self._prefix_text = text
         log.info("shared prefix cached: %d tokens in %d pages", n_keep, len(pages))
         return n_keep
-
-    def _truncate_prompt(self, ids: list, budget: int) -> list:
-        """Fit ``ids`` into ``budget`` tokens.
-
-        Failure evidence concentrates at the TAIL; instructions sit at
-        the HEAD — when the prompt starts with the cached prefix, drop
-        the MIDDLE so both survive.  The head keeps at most half the
-        budget so evidence always gets the larger share; without a
-        matching cached prefix this is plain tail truncation.  A
-        truncated prompt usually keeps only PART of the cached prefix,
-        so its wave takes the plain prefill program (_wave_shared_prefix
-        is all-or-nothing) — the head is kept for the instructions, not
-        for KV reuse.
-        """
-        if len(ids) <= budget:
-            return ids
-        head = 0
-        if self.paged and self._prefix_tokens:
-            for a, b in zip(ids, self._prefix_tokens):
-                if a != b:
-                    break
-                head += 1
-            head = min(head, budget // 2)
-            head = (head // self.page_size) * self.page_size
-        return ids[:head] + ids[-(budget - head):]
-
-    def _wave_shared_prefix(
-        self, token_lists: list, params_list: "Sequence[SamplingParams]"
-    ) -> int:
-        """Whole-page prefix-token count shared by EVERY prompt in the
-        wave (0 = at least one prompt diverges before a full page).
-
-        LoRA waves never share: adapters modify the K/V projections, so
-        the base-model prefix KV would not equal what a full prefill with
-        the adapter computes — reuse must stay EXACT."""
-        if not (self.paged and self._prefix_tokens and token_lists):
-            return 0
-        if any(p.adapter for p in params_list):
-            return 0
-        if any(not toks for toks in token_lists):
-            # encode() normally guarantees >=1 token (BOS), but the page
-            # arithmetic below must not hinge on tokenizer behavior: an
-            # empty row would make len(toks)-1 negative and the floored
-            # page multiple would slice token_lists from the tail
-            return 0
-        shared = len(self._prefix_tokens)
-        for toks in token_lists:
-            common = 0
-            for a, b in zip(toks, self._prefix_tokens):
-                if a != b:
-                    break
-                common += 1
-            # every row must keep >=1 suffix token: its first sampled
-            # token needs a logit row in the suffix program
-            shared = min(shared, common, len(toks) - 1)
-        shared = (shared // self.page_size) * self.page_size
-        # all-or-nothing: the suffix program is specialised on the static
-        # shared length, so interior values (e.g. the page-floored half
-        # budget a truncated long prompt keeps, _truncate_prompt) would
-        # each compile their OWN (n_pad, t_sfx, shared) program — an
-        # unbounded compile surface that defeats the warmup grid
-        # (precompile_grid) and turns rare long prompts into mid-run
-        # multi-second p99 outliers.  A wave that cannot reuse the WHOLE
-        # cached prefix takes the precompiled plain program instead.
-        return shared if shared == len(self._prefix_tokens) else 0
-
-    def _make_prefill_paged_prefixed(
-        self, n_pad: int, t_sfx: int, shared: int, guided: bool = False
-    ):
-        """Suffix-only prefill: the first ``shared`` tokens' KV is gathered
-        from the cached prefix pages into the mini cache (read-only reuse),
-        and only ``t_sfx`` suffix tokens run through the model."""
-        jax, jnp = self._jax, self._jnp
-        config = self.config
-        score_shards = self._prefill_score_shards()
-        n_prefix_pages = shared // self.page_size
-        t_total = shared + t_sfx
-
-        def prefill_fn(params, paged, prefix_table, token_ids, lengths,
-                       row_tables, rng, temp, top_p,
-                       lora=None, lora_idx=None, gtables=None, gaut=None):
-            from ..ops.paged_attention import PagedKVCache, write_tokens
-
-            # prefix KV: pages -> contiguous [L, shared, KH, D], shared by
-            # every row of the mini cache (broadcast, not per-row copies)
-            def gather(pages):
-                picked = pages[:, prefix_table]  # [L, n_pp, ps, KH, D]
-                return picked.reshape(
-                    pages.shape[0], shared, *pages.shape[3:]
-                )
-
-            mini = KVCache.create(config, n_pad, t_total, dtype=paged.k_pages.dtype)
-            mini = KVCache(
-                k=mini.k.at[:, :, :shared].set(
-                    gather(paged.k_pages).astype(mini.k.dtype)[:, None]
-                ),
-                v=mini.v.at[:, :, :shared].set(
-                    gather(paged.v_pages).astype(mini.v.dtype)[:, None]
-                ),
-            )
-            positions = shared + jnp.broadcast_to(
-                jnp.arange(t_sfx, dtype=jnp.int32)[None], (n_pad, t_sfx)
-            )
-            kv_positions = jnp.broadcast_to(
-                jnp.arange(t_total, dtype=jnp.int32)[None], (n_pad, t_total)
-            )
-            kv_valid = kv_positions < lengths[:, None]
-            logits, mini = forward(
-                params, config, token_ids, positions, cache=mini,
-                cache_offset=jnp.full((n_pad,), shared, jnp.int32),
-                kv_valid=kv_valid, score_shards=score_shards,
-                lora=lora, lora_alpha=self.lora_alpha, lora_indices=lora_idx,
-            )
-            # scatter ONLY the suffix into this wave's own pages — the
-            # prefix pages are shared and must never be rewritten
-            start = jnp.full((n_pad,), shared, jnp.int32)
-            suffix_len = lengths - shared
-            suffix_k = jax.lax.slice_in_dim(mini.k, shared, t_total, axis=2)
-            suffix_v = jax.lax.slice_in_dim(mini.v, shared, t_total, axis=2)
-            zero_scatter = jax.vmap(write_tokens, in_axes=(0, None, 0, None, None))
-            k_pages = zero_scatter(paged.k_pages, row_tables, suffix_k, start, suffix_len)
-            v_pages = zero_scatter(paged.v_pages, row_tables, suffix_v, start, suffix_len)
-            last = jnp.take_along_axis(
-                logits, (lengths - 1 - shared)[:, None, None].astype(jnp.int32),
-                axis=1,
-            )[:, 0, :]
-            if guided:
-                row = gtables[gaut, jnp.zeros_like(gaut)]
-                last = jnp.where(row >= 0, last, -jnp.inf)
-            first_tokens, rng = self._sample(last, rng, temp, top_p)
-            new_paged = PagedKVCache(
-                k_pages=k_pages, v_pages=v_pages,
-                page_table=paged.page_table, lengths=paged.lengths,
-            )
-            if guided:
-                first_state = jnp.take_along_axis(
-                    row, first_tokens[:, None], axis=1
-                )[:, 0]
-                return new_paged, first_tokens, rng, jnp.maximum(first_state, 0)
-            return new_paged, first_tokens, rng
-
-        if self.mesh is None:
-            return jax.jit(prefill_fn)
-        s = self._shardings
-        rows, vec = self._prefill_shardings(n_pad)
-        in_shardings = (
-            self._param_shardings, s["paged"], s["repl"], rows, vec, rows,
-            s["repl"], vec, vec, s["repl"], vec,
-        )
-        out_shardings = (s["paged"], vec, s["repl"])
-        if guided:
-            in_shardings += (s["repl"], vec)
-            out_shardings += (vec,)
-        return jax.jit(
-            prefill_fn, in_shardings=in_shardings, out_shardings=out_shardings
-        )
 
     # ------------------------------------------------------------------
     # host-side API
@@ -1392,418 +741,6 @@ class BatchedGenerator:
     def num_decoding(self) -> int:
         return sum(s.active for s in self.slots)
 
-    def _program_count(self) -> int:
-        """Compiled-program cache population (prefill variants + chunked +
-        decode) — the precompile coverage metric."""
-        decode = int(self._decode_fn is not None) + int(
-            self._decode_fn_guided is not None
-        )
-        return (
-            len(self._prefill_fns)
-            + len(self._prefix_fns)
-            + len(self._chunk_fns)
-            + len(self._finish_fns)
-            + decode
-        )
-
-    def precompile_grid(self, level: str = "serving") -> dict:
-        """Compile every program the admission policy can select BEFORE
-        serving: a mid-run XLA compile is an SLO violation, not noise (the
-        100/min CPU soak's 5.9 s p99 was exactly three first-encounter
-        prefill-bucket compiles of ~2 s each in the first ten seconds).
-        The reference has no analogue — its LLM leg is an external REST
-        call (AIInterfaceRestClient.java:37-39); a compiled-serving design
-        must instead guarantee the program grid is warm when readiness
-        flips.
-
-        ``level``:
-          - ``"off"``: nothing.
-          - ``"serving"``: the unguided grid — plain AND shared-prefix
-            prefill for every (n_pad, t_pad) bucket admission can produce
-            (driving the chunked job programs wherever ``prefill_chunk``
-            makes them the selected path) plus the decode block.  Guided
-            programs still compile on the first guided request: guided
-            traffic is opt-in per AIProvider CR and its automaton build is
-            already off-loop (ensure_guided).
-          - ``"full"``: additionally the guided variants of the whole grid
-            and the guided decode block.
-
-        Every wave runs through the REAL admission path (`_admit_tokens`),
-        so bucket selection, page granting, shared-prefix detection, and
-        the host-side glue ops all compile exactly as production traffic
-        would trigger them.  Waves the KV pool cannot grant are skipped —
-        production admission could not form them either — as are waves a
-        concurrently-admitted live request leaves too few free slots for.
-        All grid slots are cancelled and their pages released afterwards.
-        """
-        if level not in ("off", "serving", "full"):
-            raise ValueError(
-                f"warmup grid level {level!r}: expected off/serving/full"
-            )
-        t0 = time.perf_counter()
-        before = self._program_count()
-        if level == "off":
-            return {"level": level, "programs": 0, "seconds": 0.0}
-
-        vocab = self.config.vocab_size
-        filler = 7 % vocab
-        prefix = list(self._prefix_tokens) if self.paged else []
-        if prefix and prefix[0] == filler:
-            filler = (filler + 1) % vocab
-        short = 8  # filler rows: only row 0 drives the t_pad bucket
-        n_pads = self._admission_n_pads()
-
-        def t_buckets(limit: int) -> list:
-            ts, t = [], 64
-            while t < min(limit, self.max_seq):
-                ts.append(t)
-                t *= 2
-            ts.append(min(limit if limit >= 64 else 64, self.max_seq))
-            return sorted(set(ts))
-
-        guided_variants = [False] + ([True] if level == "full" else [])
-        base = dict(max_tokens=1, stop_on_eos=False)
-        waves: list[tuple[list, SamplingParams]] = []
-        for guided in guided_variants:
-            params = SamplingParams(
-                **base,
-                guided_choice=("warm", "cold") if guided else None,
-            )
-            # plain grid: first token diverges from the shared prefix so
-            # _wave_shared_prefix refuses and the plain program is selected
-            for t in t_buckets(self.max_seq - 1):
-                long_row = [filler] * min(t, self.max_seq - 1)
-                for n in n_pads:
-                    rows = [list(long_row)] + [
-                        [filler] * short for _ in range(n - 1)
-                    ]
-                    waves.append((rows, params))
-            # shared-prefix grid: every row starts with the cached prefix
-            if prefix:
-                for t in t_buckets(self.max_seq - 1 - len(prefix)):
-                    long_sfx = min(t, self.max_seq - 1 - len(prefix))
-                    if long_sfx < 1:
-                        continue
-                    for n in n_pads:
-                        rows = [prefix + [filler] * long_sfx] + [
-                            prefix + [filler] * short for _ in range(n - 1)
-                        ]
-                        waves.append((rows, params))
-
-        decode_warm = {False: False, True: False}
-        skipped = 0
-
-        def drive(rows: list, params: SamplingParams) -> None:
-            nonlocal skipped
-            guided = params.guided_choice is not None
-            if len(self.free_slots()) < len(rows):
-                # a live request admitted between waves holds slots — the
-                # grid must degrade, not assert: an early client during
-                # startup is harmless, its programs compile in-band and
-                # the remaining waves still warm everything slots permit
-                skipped += 1
-                return
-            try:
-                taken = self._admit_tokens(
-                    [list(r) for r in rows], [params] * len(rows),
-                    time.perf_counter(),
-                )
-            except OversizedRequest:
-                skipped += 1
-                return
-            while self._prefill_job is not None:
-                self.step()
-            if len(taken) < len(rows):
-                skipped += 1  # page pool can't grant the full wave
-            if taken and not decode_warm[guided]:
-                self.step()  # compiles the (guided) decode block
-                decode_warm[guided] = True
-            for slot_id in taken:
-                self.cancel(slot_id)
-            while self._inflight_blocks:
-                self.step()
-
-        for rows, params in waves:
-            guided = params.guided_choice is not None
-            n_pad = self._admission_n_pad(len(rows))
-            t_all = max(len(r) for r in rows)
-            shared = self._wave_shared_prefix(rows, [params] * len(rows))
-            t_pad = _bucket(t_all - shared, 64, self.max_seq)
-            if shared:
-                key_hit = (n_pad, t_pad, shared, guided) in self._prefix_fns
-            elif (
-                self.prefill_chunk is not None and t_pad > self.prefill_chunk
-            ):
-                key_hit = (n_pad, t_pad, guided) in self._finish_fns
-            else:
-                key_hit = (n_pad, t_pad, guided) in self._prefill_fns
-            if key_hit and decode_warm[guided]:
-                continue
-            drive(rows, params)
-
-        # n-specific host glue (page-table staging, slot-activation
-        # vectors) compiles eagerly per ACTUAL wave size, not per bucket:
-        # one cheap wave at every n (programs already cached above) keeps
-        # those 10-50 ms first-occurrence compiles out of request latency
-        params = SamplingParams(**base)
-        for n in range(1, self.max_slots + 1):
-            drive([[filler] * short] * n, params)
-            if prefix:
-                drive([prefix + [filler] * short] * n, params)
-        result = {
-            "level": level,
-            "programs": self._program_count() - before,
-            "skipped_waves": skipped,
-            "seconds": round(time.perf_counter() - t0, 2),
-        }
-        log.info("precompile grid: %s", result)
-        return result
-
-    def admit(
-        self, prompts: Sequence[str], params_list: Sequence[SamplingParams]
-    ) -> list[int]:
-        """Tokenise + batch-prefill prompts into free slots; returns slot ids.
-
-        One forward pass for the whole group — the "32 concurrent failure
-        events -> one prefill" shape (BASELINE config 4).
-
-        In paged mode admission may be PARTIAL: when the KV free list can't
-        cover every prompt's worst case (prompt + max_tokens), only the
-        longest prefix that fits is admitted and the returned list is
-        shorter than ``prompts`` — the caller requeues the rest.  A single
-        request larger than the whole cache raises :class:`OversizedRequest`.
-        """
-        free = self.free_slots()
-        assert len(prompts) <= len(free), "admit() called with too few free slots"
-        if not prompts:
-            return []
-        started = time.perf_counter()
-
-        token_lists = []
-        for prompt, sampling in zip(prompts, params_list):
-            ids = self.tokenizer.encode(prompt)
-            # leave room for at least one generated token
-            budget = self.max_seq - max(1, min(sampling.max_tokens, self.max_seq // 2))
-            token_lists.append(self._truncate_prompt(ids, budget))
-        return self._admit_tokens(token_lists, params_list, started)
-
-    def _admit_tokens(
-        self,
-        token_lists: list,
-        params_list: Sequence[SamplingParams],
-        started: float,
-    ) -> list[int]:
-        """Admission after tokenisation/truncation: page grants + the
-        shared-prefix decision + the batched prefill.  Split from admit()
-        so precompile_grid() can drive exact token-length waves through
-        the REAL admission path (bucket selection included)."""
-        page_grants: list[list[int]] = []
-        if self.paged:
-            # shared-prefix reuse: when EVERY prompt starts with the cached
-            # prefix, rows reference the generator-owned prefix pages and
-            # allocate (and later prefill) only their suffix
-            shared = self._wave_shared_prefix(token_lists, params_list)
-            pool = self.allocator.num_pages - 1 - len(self._prefix_pages)
-            for toks, sampling in zip(token_lists, params_list):
-                total = min(len(toks) + sampling.max_tokens, self.max_seq)
-                need = -(-total // self.page_size) - shared // self.page_size
-                if need > pool:
-                    if not page_grants:
-                        raise OversizedRequest(
-                            f"request needs {need} KV pages, cache holds {pool}"
-                        )
-                    break
-                try:
-                    page_grants.append(self.allocator.allocate(need))
-                except MemoryError:
-                    break  # backpressure: admit the prefix that fits
-            if not page_grants:
-                return []
-            token_lists = token_lists[: len(page_grants)]
-            params_list = params_list[: len(page_grants)]
-            try:
-                return self._admit_batch(
-                    token_lists, params_list, page_grants, started,
-                    prefix_shared=shared,
-                )
-            except BaseException:
-                for grant in page_grants:  # don't leak pages on prefill failure
-                    self.allocator.release(grant)
-                raise
-        return self._admit_batch(token_lists, params_list, [], started)
-
-    def _admission_n_pads(self) -> list[int]:
-        """The CLOSED set of batch buckets admission can assign: power-of-
-        two buckets, dp-rounded (multiples of dp*fsdd so prefill rows shard
-        instead of hitting the replicated fallback, _prefill_shardings),
-        capped at max_slots.  Selecting the smallest member >= n keeps
-        _admission_n_pad idempotent even when dp*fsdp is not a power of two
-        (naive re-rounding would map 6 -> 9 for dp_total=3 and leave the
-        6-row bucket uncompilable by any warmup)."""
-        pads = set()
-        d = self._dp_total if self.mesh is not None else 1
-        for k in range(self.max_slots.bit_length() + 1):
-            pads.add(min(self.max_slots, -(-(1 << k) // d) * d))
-        return sorted(pads)
-
-    def _admission_n_pad(self, n: int) -> int:
-        """Smallest admissible batch bucket that fits ``n`` rows (padding
-        rows are row-0 duplicates, so the only cost is their flops on one
-        device's shard)."""
-        for pad in self._admission_n_pads():
-            if pad >= n:
-                return pad
-        return self.max_slots
-
-    def _admit_batch(
-        self,
-        token_lists: list[list[int]],
-        params_list: Sequence[SamplingParams],
-        page_grants: list[list[int]],
-        started: float,
-        prefix_shared: int = 0,
-    ) -> list[int]:
-        jnp = self._jnp
-        free = self.free_slots()
-        n = len(token_lists)
-        if prefix_shared:
-            # shared-prefix wave: the program sees only suffixes; lengths
-            # stay FULL (decode appends at the true sequence length)
-            token_lists = [toks[prefix_shared:] for toks in token_lists]
-        max_len = max(len(t) for t in token_lists)
-        n_pad = self._admission_n_pad(n)
-        t_pad = _bucket(max_len, 64, self.max_seq)
-
-        ids = np.zeros((n_pad, t_pad), np.int32)
-        lengths = np.ones((n_pad,), np.int32)
-        temp = np.zeros((n_pad,), np.float32)
-        top_p = np.ones((n_pad,), np.float32)
-        slot_ids = np.zeros((n_pad,), np.int32)
-        adapter_idx = np.zeros((n_pad,), np.int32)
-        taken = free[:n]
-        for row, (toks, sampling) in enumerate(zip(token_lists, params_list)):
-            ids[row, : len(toks)] = toks
-            lengths[row] = len(toks) + prefix_shared  # FULL sequence length
-            temp[row] = sampling.temperature
-            top_p[row] = sampling.top_p
-            slot_ids[row] = taken[row]
-            if sampling.adapter is not None and sampling.adapter not in self._adapter_ids:
-                raise ValueError(
-                    f"unknown LoRA adapter {sampling.adapter!r}; registered: "
-                    f"{sorted(n for n in self._adapter_ids if n)}"
-                )
-            adapter_idx[row] = self._adapter_ids[sampling.adapter]
-        # padding rows duplicate row 0 verbatim (tokens, length, AND slot):
-        # the scatter then writes identical values to one slot from several
-        # rows, which is order-independent — no scratch slot needed, no
-        # free-slot budget consumed, no risk of corrupting a live slot
-        for row in range(n, n_pad):
-            ids[row] = ids[0]
-            lengths[row] = lengths[0]
-            slot_ids[row] = slot_ids[0]
-            adapter_idx[row] = adapter_idx[0]
-
-        # guided decoding: stack the automata this wave + active slots need
-        wave_specs = [self._guided_spec(p) for p in params_list]
-        if any(wave_specs) or self._guided_tables is not None:
-            self._refresh_guided_tables(wave_specs)
-        guided = self._guided_tables is not None
-        row_aut = (
-            self._guided_row_aut(wave_specs, n_pad) if guided
-            else np.zeros((n_pad,), np.int32)
-        )
-
-        key = (n_pad, t_pad)
-        if (
-            self.prefill_chunk is not None
-            and t_pad > self.prefill_chunk
-            and self._prefill_job is None
-            and not prefix_shared  # suffix-only prefill is already short
-        ):
-            return self._start_prefill_job(
-                key, ids, lengths, temp, top_p, slot_ids, adapter_idx,
-                token_lists, params_list, page_grants, taken,
-            )
-        if prefix_shared:
-            pkey = (n_pad, t_pad, prefix_shared, guided)
-            if pkey not in self._prefix_fns:
-                log.info(
-                    "compiling prefixed prefill bucket n=%d t_sfx=%d shared=%d "
-                    "(guided=%s)", n_pad, t_pad, prefix_shared, guided,
-                )
-                self._prefix_fns[pkey] = self._make_prefill_paged_prefixed(
-                    n_pad, t_pad, prefix_shared, guided
-                )
-            staged, row_tables = self._stage_page_tables(
-                n, n_pad, slot_ids, page_grants, lengths,
-                prefix_shared=prefix_shared,
-            )
-            prefix_table = jnp.asarray(
-                self._prefix_pages[: prefix_shared // self.page_size], jnp.int32
-            )
-            outs = self._prefix_fns[pkey](
-                self.params, staged, prefix_table, jnp.asarray(ids),
-                jnp.asarray(lengths), jnp.asarray(row_tables), self._rng,
-                jnp.asarray(temp), jnp.asarray(top_p), self.lora,
-                jnp.asarray(adapter_idx) if self.lora is not None else None,
-                *((self._guided_tables, jnp.asarray(row_aut)) if guided else ()),
-            )
-            if guided:
-                self.paged_cache, first_tokens, self._rng, first_state = outs
-            else:
-                self.paged_cache, first_tokens, self._rng = outs
-            result = self._activate_slots(
-                np.asarray(first_tokens), lengths, taken, params_list,
-                page_grants, (time.perf_counter() - started) * 1e3,
-            )
-            if guided:
-                self._apply_guided_activation(row_aut, taken, first_state)
-            return result
-        key = (n_pad, t_pad, guided)
-        if key not in self._prefill_fns:
-            log.info("compiling prefill bucket n=%d t=%d (paged=%s guided=%s)",
-                     n_pad, t_pad, self.paged, guided)
-            self._prefill_fns[key] = (
-                self._make_prefill_paged(n_pad, t_pad, guided)
-                if self.paged
-                else self._make_prefill(n_pad, t_pad, guided)
-            )
-
-        if self.paged:
-            staged, row_tables = self._stage_page_tables(
-                n, n_pad, slot_ids, page_grants, lengths
-            )
-            outs = self._prefill_fns[key](
-                self.params, staged, jnp.asarray(ids), jnp.asarray(lengths),
-                jnp.asarray(row_tables), self._rng, jnp.asarray(temp),
-                jnp.asarray(top_p), self.lora,
-                jnp.asarray(adapter_idx) if self.lora is not None else None,
-                *((self._guided_tables, jnp.asarray(row_aut)) if guided else ()),
-            )
-            if guided:
-                self.paged_cache, first_tokens, self._rng, first_state = outs
-            else:
-                self.paged_cache, first_tokens, self._rng = outs
-        else:
-            outs = self._prefill_fns[key](
-                self.params, self.cache, jnp.asarray(ids), jnp.asarray(lengths),
-                jnp.asarray(slot_ids), self._rng, jnp.asarray(temp), jnp.asarray(top_p),
-                self.lora,
-                jnp.asarray(adapter_idx) if self.lora is not None else None,
-                *((self._guided_tables, jnp.asarray(row_aut)) if guided else ()),
-            )
-            if guided:
-                self.cache, first_tokens, self._rng, first_state = outs
-            else:
-                self.cache, first_tokens, self._rng = outs
-        result = self._activate_slots(
-            np.asarray(first_tokens), lengths, taken, params_list,
-            page_grants, (time.perf_counter() - started) * 1e3,
-        )
-        if guided:
-            self._apply_guided_activation(row_aut, taken, first_state)
-        return result
-
     def _activate_slots(
         self, first_np, lengths, taken, params_list, page_grants, prefill_ms
     ) -> list[int]:
@@ -1839,216 +776,9 @@ class BatchedGenerator:
         self._sampling_cache = None  # slot set changed
         return list(taken)
 
-    def _stage_page_tables(
-        self, n: int, n_pad: int, slot_ids, page_grants, lengths,
-        prefix_shared: int = 0,
-    ):
-        """Build the wave's page-table rows and a STAGED cache carrying
-        them (shared by one-shot and chunked prefill); padding rows
-        duplicate row 0 (identical duplicate writes are order-independent).
-
-        The staged cache is NOT committed to ``self.paged_cache`` — the
-        caller assigns only from its prefill/finish program's return value,
-        so a failed prefill leaves the device state untouched (inactive
-        slots keep their zeroed table rows pointing at the trash page while
-        the failed wave's grants go back to the allocator).
-
-        Returns ``(staged_cache, row_tables)``."""
-        from ..ops.paged_attention import PagedKVCache
-
-        jnp = self._jnp
-        row_tables = np.zeros((n_pad, self.pages_per_seq), np.int32)
-        n_prefix = prefix_shared // self.page_size if prefix_shared else 0
-        for row, grant in enumerate(page_grants):
-            if n_prefix:
-                # shared-prefix wave: every row's table starts with the
-                # generator-owned prefix pages (read-only; never in the
-                # grant, so slot teardown cannot free them)
-                row_tables[row, :n_prefix] = self._prefix_pages[:n_prefix]
-            row_tables[row, n_prefix: n_prefix + len(grant)] = grant
-        for row in range(n, n_pad):
-            row_tables[row] = row_tables[0]
-        paged = self.paged_cache
-        table = paged.page_table.at[jnp.asarray(slot_ids[:n])].set(
-            jnp.asarray(row_tables[:n])
-        )
-        lens = paged.lengths.at[jnp.asarray(slot_ids[:n])].set(
-            jnp.asarray(lengths[:n])
-        )
-        staged = PagedKVCache(
-            k_pages=paged.k_pages, v_pages=paged.v_pages,
-            page_table=table, lengths=lens,
-        )
-        return staged, row_tables
-
     # ------------------------------------------------------------------
     # chunked prefill (Sarathi-style interleaving; prefill_chunk knob)
     # ------------------------------------------------------------------
-
-    def _start_prefill_job(
-        self, key, ids, lengths, temp, top_p, slot_ids, adapter_idx,
-        token_lists, params_list, page_grants, taken,
-    ) -> list[int]:
-        """Reserve the wave's slots and stage device state; chunks run one
-        per step() call so in-flight decodes interleave."""
-        jnp = self._jnp
-        n_pad, t_pad = key
-        # NOTE: the device page table is NOT touched here — chunks run in
-        # the job's mini cache only; tables commit atomically with the
-        # finish program's successful return (_advance_prefill), so a
-        # failure at any chunk leaves the device state untouched
-        cache_ref = self.paged_cache.k_pages if self.paged else self.cache.k
-        mini = KVCache.create(self.config, n_pad, t_pad, dtype=cache_ref.dtype)
-        last_logits = jnp.zeros((n_pad, self.config.vocab_size), jnp.float32)
-        if self.mesh is not None:
-            # commit the carried device state to its program shardings once
-            # at job start; every later chunk keeps it in place (the chunk
-            # programs' in/out shardings match), so no per-chunk resharding
-            rows, _ = self._prefill_shardings(n_pad)
-            mini = self._jax.device_put(mini, self._shardings["cache"])
-            last_logits = self._jax.device_put(last_logits, rows)
-        self._prefill_job = _PrefillJob(
-            key=key,
-            ids=jnp.asarray(ids),
-            lengths_np=lengths,
-            lengths=jnp.asarray(lengths),
-            temp=jnp.asarray(temp),
-            top_p=jnp.asarray(top_p),
-            slot_ids_np=slot_ids,
-            taken=list(taken),
-            params_list=list(params_list),
-            page_grants=list(page_grants),
-            adapter_idx=(
-                jnp.asarray(adapter_idx) if self.lora is not None else None
-            ),
-            mini=mini,
-            last_logits=last_logits,
-            written=0,
-        )
-        self._reserved.update(taken)
-        return list(taken)
-
-    def _make_chunk_fn(self, n_pad: int, t_pad: int, chunk: int):
-        """One prefill chunk: forward ``chunk`` tokens at a dynamic offset
-        into the job's mini cache, carrying last-token logits for rows whose
-        prompt ends inside this chunk."""
-        jax, jnp = self._jax, self._jnp
-        config = self.config
-        score_shards = self._prefill_score_shards()
-
-        def chunk_fn(params, mini, ids_chunk, lengths, offset, last_logits,
-                     lora=None, lora_idx=None):
-            positions = offset + jnp.broadcast_to(
-                jnp.arange(chunk, dtype=jnp.int32)[None], (n_pad, chunk)
-            )
-            kv_positions = jnp.broadcast_to(
-                jnp.arange(t_pad, dtype=jnp.int32)[None], (n_pad, t_pad)
-            )
-            # valid cache slots: written so far (incl. this chunk) AND real
-            kv_valid = kv_positions < jnp.minimum(lengths, offset + chunk)[:, None]
-            logits, mini = forward(
-                params, config, ids_chunk, positions, cache=mini,
-                cache_offset=jnp.broadcast_to(offset, (n_pad,)),
-                kv_valid=kv_valid, score_shards=score_shards,
-                lora=lora, lora_alpha=self.lora_alpha, lora_indices=lora_idx,
-            )
-            rel = lengths - 1 - offset  # last-token position, chunk-relative
-            in_chunk = (rel >= 0) & (rel < chunk)
-            gathered = jnp.take_along_axis(
-                logits, jnp.clip(rel, 0, chunk - 1)[:, None, None].astype(jnp.int32),
-                axis=1,
-            )[:, 0, :]
-            last_logits = jnp.where(in_chunk[:, None], gathered, last_logits)
-            return mini, last_logits
-
-        if self.mesh is None:
-            return jax.jit(chunk_fn)
-        # mesh: same layout as the one-shot prefill programs — rows shard
-        # over the data axes (dp-aware admission pads the bucket), the
-        # mini cache shards like the big cache (batch over dp, heads over
-        # tp), and the chunk offset is a replicated scalar
-        s = self._shardings
-        rows, vec = self._prefill_shardings(n_pad)
-        return jax.jit(
-            chunk_fn,
-            in_shardings=(
-                self._param_shardings, s["cache"], rows, vec,
-                s["repl"], rows, s["repl"], vec,
-            ),
-            out_shardings=(s["cache"], rows),
-        )
-
-    def _make_finish_fn(self, n_pad: int, t_pad: int, guided: bool = False):
-        """Scatter the completed mini cache into the big cache / pages and
-        sample each row's first token from the carried last logits (masked
-        by the automaton start-state rows for guided waves)."""
-        jax, jnp = self._jax, self._jnp
-
-        def sample_first(last_logits, rng, temp, top_p, gtables, gaut):
-            if guided:
-                row = gtables[gaut, jnp.zeros_like(gaut)]
-                last_logits = jnp.where(row >= 0, last_logits, -jnp.inf)
-            first_tokens, rng = self._sample(last_logits, rng, temp, top_p)
-            if guided:
-                first_state = jnp.take_along_axis(
-                    row, first_tokens[:, None], axis=1
-                )[:, 0]
-                return first_tokens, rng, (jnp.maximum(first_state, 0),)
-            return first_tokens, rng, ()
-
-        if self.paged:
-            def finish_fn(paged, mini, lengths, row_tables, last_logits,
-                          rng, temp, top_p, gtables=None, gaut=None):
-                from ..ops.paged_attention import PagedKVCache, write_tokens
-
-                zero = jnp.zeros((n_pad,), jnp.int32)
-                scatter = jax.vmap(write_tokens, in_axes=(0, None, 0, None, None))
-                k_pages = scatter(paged.k_pages, row_tables, mini.k, zero, lengths)
-                v_pages = scatter(paged.v_pages, row_tables, mini.v, zero, lengths)
-                first_tokens, rng, extra = sample_first(
-                    last_logits, rng, temp, top_p, gtables, gaut
-                )
-                return (
-                    PagedKVCache(
-                        k_pages=k_pages, v_pages=v_pages,
-                        page_table=paged.page_table, lengths=paged.lengths,
-                    ),
-                    first_tokens, rng, *extra,
-                )
-        else:
-            def finish_fn(cache, mini, lengths, slot_ids, last_logits,
-                          rng, temp, top_p, gtables=None, gaut=None):
-                k = cache.k.at[:, slot_ids, :t_pad].set(mini.k.astype(cache.k.dtype))
-                v = cache.v.at[:, slot_ids, :t_pad].set(mini.v.astype(cache.v.dtype))
-                first_tokens, rng, extra = sample_first(
-                    last_logits, rng, temp, top_p, gtables, gaut
-                )
-                return KVCache(k=k, v=v), first_tokens, rng, *extra
-
-        if self.mesh is None:
-            return jax.jit(finish_fn)
-        s = self._shardings
-        rows, vec = self._prefill_shardings(n_pad)
-        if self.paged:
-            # (paged, mini, lengths, row_tables, last_logits, rng, temp, top_p)
-            in_shardings = (
-                s["paged"], s["cache"], vec, rows, rows,
-                s["repl"], vec, vec,
-            )
-            out_shardings = (s["paged"], vec, s["repl"])
-        else:
-            # (cache, mini, lengths, slot_ids, last_logits, rng, temp, top_p)
-            in_shardings = (
-                s["cache"], s["cache"], vec, vec, rows,
-                s["repl"], vec, vec,
-            )
-            out_shardings = (s["cache"], vec, s["repl"])
-        if guided:
-            in_shardings += (s["repl"], vec)
-            out_shardings += (vec,)
-        return jax.jit(
-            finish_fn, in_shardings=in_shardings, out_shardings=out_shardings
-        )
 
     def _advance_prefill(self) -> None:
         """Run ONE chunk of the pending job (or its finish step)."""

@@ -1,0 +1,122 @@
+"""Serving data types shared by the engine, admission, and program layers.
+
+Split out of serving/engine.py (round 5) so the admission-policy and
+program-builder modules can import them without a cycle; the public import
+surface is unchanged (serving.engine re-exports everything here).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    max_tokens: int = 256
+    temperature: float = 0.3  # reference default, aiprovider-crd.yaml:56-58
+    top_p: float = 0.95
+    stop_on_eos: bool = True
+    #: LoRA adapter name for this request (multi-LoRA serving: every slot
+    #: picks its own adapter from the generator's stacked registry; None =
+    #: base model).  Unknown names are rejected at admission.
+    adapter: Optional[str] = None
+    #: constrain the output to one of these strings (serving/guided.py):
+    #: a token-trie automaton rides the decode scan as device state and
+    #: masks the sampler every step.  None = unconstrained.
+    guided_choice: Optional[tuple] = None
+    #: constrain the output to match this regex (serving/regex_dfa.py:
+    #: byte-level DFA, token closure, same device-state machinery).
+    #: Mutually exclusive with guided_choice.
+    guided_regex: Optional[str] = None
+
+
+@dataclass
+class GenerationResult:
+    text: str
+    token_ids: list[int]
+    prompt_tokens: int
+    completion_tokens: int
+    finish_reason: str  # "stop" | "length"
+    prefill_ms: float = 0.0
+    decode_ms: float = 0.0
+
+    @property
+    def total_ms(self) -> float:
+        return self.prefill_ms + self.decode_ms
+
+
+@dataclass
+class _Slot:
+    active: bool = False
+    prompt_len: int = 0
+    generated: list[int] = field(default_factory=list)
+    params: SamplingParams = field(default_factory=SamplingParams)
+    started: float = 0.0
+    prefill_ms: float = 0.0
+    pages: list[int] = field(default_factory=list)  # paged mode only
+
+
+@dataclass
+class _PrefillJob:
+    """An in-progress chunked prefill (engine.prefill_chunk).
+
+    Device state (the bucket mini cache and the running last-token logits)
+    carries across chunk calls; host arrays describe the admitted wave the
+    same way _admit_batch's one-shot path does."""
+
+    key: tuple  # (n_pad, t_pad)
+    ids: Any  # [n_pad, t_pad] device tokens
+    lengths_np: Any
+    lengths: Any  # device
+    temp: Any
+    top_p: Any
+    slot_ids_np: Any  # padded rows duplicate row 0
+    taken: list
+    params_list: list
+    page_grants: list
+    adapter_idx: Any  # device or None
+    mini: Any  # KVCache carry
+    last_logits: Any  # [n_pad, vocab] carry
+    written: int
+    chunk_ms: float = 0.0  # accumulated chunk compute (not interleaved wall)
+
+
+class OversizedRequest(ValueError):
+    """A single request needs more KV pages than the whole cache holds."""
+
+
+def _bucket(n: int, floor: int, cap: int) -> int:
+    """Smallest power-of-two >= n, clamped to [floor, cap]."""
+    size = floor
+    while size < n and size < cap:
+        size *= 2
+    return min(size, cap)
+
+
+class PageAllocator:
+    """Host-side free list for the paged KV cache (ops/paged_attention.py).
+
+    Page 0 is reserved as the trash page: padded prefill rows and released
+    slots write there, so a page handed to a live sequence is never touched
+    by anyone else.  Allocation is worst-case up front (prompt + max new
+    tokens), which keeps the device page table static for a sequence's
+    whole lifetime — no mid-decode growth, no host sync in the decode loop.
+    """
+
+    def __init__(self, num_pages: int) -> None:
+        assert num_pages >= 2, "need at least one real page beyond the trash page"
+        self.num_pages = num_pages
+        self._free = list(range(num_pages - 1, 0, -1))  # pop() yields low ids first
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    def allocate(self, count: int) -> list[int]:
+        if count > len(self._free):
+            raise MemoryError(f"KV pages exhausted: want {count}, have {len(self._free)}")
+        return [self._free.pop() for _ in range(count)]
+
+    def release(self, pages: list[int]) -> None:
+        self._free.extend(pages)

@@ -34,6 +34,8 @@ kubectl apply -f deploy/crds/podmortem-crds.yaml
 kubectl create namespace podmortem-system --dry-run=client -o yaml | kubectl apply -f -
 kubectl apply -f deploy/operator-serviceaccount.yaml -n podmortem-system
 kubectl apply -f deploy/operator-rbac.yaml
-kubectl wait --for condition=established crd/podmortems.podmortem.tpu.dev --timeout=60s
+for crd in podmortems aiproviders patternlibraries; do
+  kubectl wait --for condition=established "crd/${crd}.podmortem.tpu.dev" --timeout=60s
+done
 
 E2E_CLUSTER=1 python -m pytest tests/test_e2e_cluster.py -x -q -s
