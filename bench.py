@@ -192,7 +192,9 @@ def main() -> None:
 
     from operator_tpu.models import get_config, init_params
     from operator_tpu.models.tokenizer import load_tokenizer
-    from operator_tpu.serving.engine import BatchedGenerator, SamplingParams, ServingEngine
+    from operator_tpu.serving.engine import (
+        BatchedGenerator, SamplingParams, ServingEngine,
+    )
     from operator_tpu.serving.prompts import build_prompt
 
     devices, platform = init_devices()
@@ -270,20 +272,22 @@ def main() -> None:
         log(f"shared prefix cached: {prefix_cached} tokens")
     sampling = SamplingParams(max_tokens=max_tokens, temperature=0.3, stop_on_eos=False)
 
+    open_enabled = os.environ.get("BENCH_OPEN", "1") == "1" and platform != "cpu-fallback"
+
     # warmup: compile the decode step and every prefill bucket the timed run
-    # can hit (full waves of `slots`, plus the remainder wave when requests
-    # is not a multiple of slots), so no XLA compile lands in the timed
-    # region.  Warm with the TIMED sampling params: max_tokens feeds the
-    # truncation budget, and with prefix caching the budget decides the
-    # suffix bucket — a max_tokens mismatch would warm the wrong program.
-    # One decode block suffices, then cancel (slots/pages reclaimed).
-    t0 = time.perf_counter()
-    warm_sizes = {slots}
-    if n_requests % slots:
-        warm_sizes.add(n_requests % slots)
-    for size in sorted(warm_sizes):
-        warm_slots = generator.admit(prompts[:size], [sampling] * size)
-        generator.step()  # compiles the decode block
+    # can hit, so no XLA compile lands in the timed region.  Warm with the
+    # TIMED sampling params: max_tokens feeds the truncation budget, and
+    # with prefix caching the budget decides the suffix bucket — a
+    # max_tokens mismatch would warm the wrong program.  One decode block
+    # suffices, then cancel (slots/pages reclaimed).
+    def warm_wave(wave: list) -> None:
+        warm_slots = generator.admit(wave, [sampling] * len(wave))
+        if len(warm_slots) < len(wave):
+            # page backpressure shrank the wave: the intended bucket was
+            # NOT compiled — surface it instead of reporting a clean warmup
+            log(f"warmup wave admitted {len(warm_slots)}/{len(wave)} rows "
+                "(KV pool backpressure); its bucket stays cold")
+        generator.step()  # compiles the decode block (first wave)
         # cancel-and-drain: chunk-prefilling slots are RESERVED (not yet
         # cancellable), so keep stepping the job and cancelling as slots
         # activate — leaving anything reserved would starve the next
@@ -294,9 +298,36 @@ def main() -> None:
             generator.step()
             for slot in warm_slots:
                 generator.cancel(slot)
+
+    t0 = time.perf_counter()
+    # closed phase: full waves of `slots`, plus the remainder wave when
+    # requests is not a multiple of slots
+    warm_sizes = {slots}
+    if n_requests % slots:
+        warm_sizes.add(n_requests % slots)
+    for size in sorted(warm_sizes):
+        warm_wave(prompts[:size])
+    if open_enabled and os.environ.get("BENCH_GRID", "1") == "1":
+        # open-loop phase: Poisson arrivals form waves of ANY size over any
+        # prompt subset, so every (n_pad, bucket) combo — and the per-size
+        # host glue — must be warm or it compiles inside a measured
+        # request's latency (the r2 on-chip p99 tail).  The engine's own
+        # grid precompile drives it through the real admission path,
+        # restricted to the buckets THIS prompt set can actually produce
+        # (chip time is the budget; all wave sizes stay covered).
+        grid = generator.precompile_grid(
+            "serving", workload_prompts=prompts, workload_params=sampling
+        )
+        log(f"warmup grid: {grid}")
     log(f"warmup (compile) {time.perf_counter() - t0:.1f}s")
 
-    open_enabled = os.environ.get("BENCH_OPEN", "1") == "1" and platform != "cpu-fallback"
+    # from here on, every XLA compile is a mid-run compile: a direct,
+    # multi-second p99 contribution the warmup above exists to prevent —
+    # counted and reported so the discipline is visible in the record
+    from operator_tpu.utils.compilewatch import CompileWatcher
+
+    compile_watch = CompileWatcher()
+    compile_watch.mark()
     open_seconds = float(os.environ.get("BENCH_OPEN_SECONDS", "60"))
     rates = [
         float(r) for r in os.environ.get(
@@ -390,6 +421,7 @@ def main() -> None:
         "tokenizer": tok_spec,
         "weight_dtype": "int8" if quant else "bf16",
         "prefix_cached_tokens": prefix_cached,
+        "midrun_compiles": compile_watch.count_since_mark(),
         "platform": platform,
         "degraded": degraded,
     }))

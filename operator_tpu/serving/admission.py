@@ -42,7 +42,13 @@ class AdmissionMixin:
             + decode
         )
 
-    def precompile_grid(self, level: str = "serving") -> dict:
+    def precompile_grid(
+        self,
+        level: str = "serving",
+        *,
+        workload_prompts: "Sequence[str] | None" = None,
+        workload_params: "SamplingParams | None" = None,
+    ) -> dict:
         """Compile every program the admission policy can select BEFORE
         serving: a mid-run XLA compile is an SLO violation, not noise (the
         100/min CPU soak's 5.9 s p99 was exactly three first-encounter
@@ -63,6 +69,14 @@ class AdmissionMixin:
             already off-loop (ensure_guided).
           - ``"full"``: additionally the guided variants of the whole grid
             and the guided decode block.
+
+        ``workload_prompts`` (with ``workload_params``, e.g. the bench
+        harness whose prompt set is known up front) restricts the length
+        buckets to exactly those the given prompts produce under the REAL
+        encode/truncate/prefix pipeline — every wave SIZE stays covered
+        (open-loop arrivals form all of them) but chip time is not spent
+        compiling length buckets the workload cannot hit.  The bucket
+        derivation lives here, next to the admission math it must mirror.
 
         Every wave runs through the REAL admission path (`_admit_tokens`),
         so bucket selection, page granting, shared-prefix detection, and
@@ -97,6 +111,33 @@ class AdmissionMixin:
             ts.append(min(limit if limit >= 64 else 64, self.max_seq))
             return sorted(set(ts))
 
+        plain_ts = t_buckets(self.max_seq - 1)
+        prefix_ts = (
+            t_buckets(self.max_seq - 1 - len(prefix)) if prefix else []
+        )
+        if workload_prompts is not None:
+            # restrict to the buckets THIS workload's prompts produce,
+            # derived through the real encode/truncate/prefix pipeline so
+            # it can never desync from admission
+            probe = workload_params or SamplingParams(max_tokens=1)
+            budget = self.max_seq - max(
+                1, min(probe.max_tokens, self.max_seq // 2)
+            )
+            plain_set, prefix_set = set(), set()
+            for prompt in workload_prompts:
+                toks = self._truncate_prompt(
+                    self.tokenizer.encode(prompt), budget
+                )
+                shared = self._wave_shared_prefix([toks], [probe])
+                if shared:
+                    prefix_set.add(
+                        _bucket(len(toks) - shared, 64, self.max_seq)
+                    )
+                else:
+                    plain_set.add(_bucket(len(toks), 64, self.max_seq))
+            plain_ts = sorted(plain_set)
+            prefix_ts = sorted(prefix_set)
+
         guided_variants = [False] + ([True] if level == "full" else [])
         base = dict(max_tokens=1, stop_on_eos=False)
         waves: list[tuple[list, SamplingParams]] = []
@@ -107,7 +148,7 @@ class AdmissionMixin:
             )
             # plain grid: first token diverges from the shared prefix so
             # _wave_shared_prefix refuses and the plain program is selected
-            for t in t_buckets(self.max_seq - 1):
+            for t in plain_ts:
                 long_row = [filler] * min(t, self.max_seq - 1)
                 for n in n_pads:
                     rows = [list(long_row)] + [
@@ -116,7 +157,7 @@ class AdmissionMixin:
                     waves.append((rows, params))
             # shared-prefix grid: every row starts with the cached prefix
             if prefix:
-                for t in t_buckets(self.max_seq - 1 - len(prefix)):
+                for t in prefix_ts:
                     long_sfx = min(t, self.max_seq - 1 - len(prefix))
                     if long_sfx < 1:
                         continue
