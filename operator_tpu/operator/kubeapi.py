@@ -187,6 +187,43 @@ class _WatchRegistration:
     queue: asyncio.Queue = field(default_factory=asyncio.Queue)
 
 
+async def iter_watch_resumed(
+    api: KubeApi,
+    kind: str,
+    namespace: Optional[str],
+    get_cursor: Callable[[], Optional[str]],
+    set_cursor: Callable[[Optional[str]], None],
+) -> AsyncIterator[tuple[WatchEvent, Optional[str]]]:
+    """The shared resumable-watch discipline for every watch consumer.
+
+    Opens ``api.watch`` at the current cursor and yields
+    ``(event, resourceVersion)`` pairs for non-BOOKMARK events.  Bookmarks
+    refresh the cursor silently; a 410 (WatchExpired) CLEARS the cursor —
+    so the caller's restart path re-lists — before propagating.  The
+    caller applies the event and then advances the cursor itself (advance
+    must follow a successful apply: on an apply failure the restart
+    resumes AT the unapplied event and the server replays it).
+    """
+    try:
+        async for event in api.watch(
+            kind, namespace, resource_version=get_cursor()
+        ):
+            version = (event.object.get("metadata") or {}).get(
+                "resourceVersion"
+            )
+            if event.type == "BOOKMARK":
+                # cursor-refresh only: its object is bare metadata that
+                # would parse into a phantom object downstream
+                if version:
+                    set_cursor(version)
+                continue
+            yield event, version
+    except WatchExpired:
+        # compacted past the cursor: resuming would silently drop events
+        set_cursor(None)
+        raise
+
+
 #: error-injection hook: (op, kind, name) -> Exception to raise, or None
 ErrorHook = Callable[[str, str, str], Optional[Exception]]
 

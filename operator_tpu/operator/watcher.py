@@ -30,7 +30,12 @@ from ..schema.crds import Podmortem
 from ..schema.kube import ContainerStatus, Pod
 from ..utils.config import OperatorConfig
 from ..utils.timing import METRICS, MetricsRegistry
-from .kubeapi import KubeApi, WatchClosed, WatchExpired
+from .kubeapi import (
+    KubeApi,
+    WatchClosed,
+    WatchExpired,
+    iter_watch_resumed,
+)
 from .pipeline import AnalysisPipeline
 
 log = logging.getLogger(__name__)
@@ -84,11 +89,25 @@ class PodmortemCache:
         self._items: dict[tuple[str, str], Podmortem] = {}
         self._primed = False
         self._ready = asyncio.Event()
+        # resume cursor: reconnects resume from the last applied event's
+        # resourceVersion so the apiserver replays the gap instead of the
+        # cache re-listing every CR on every stream recycle
+        self._cursor: Optional[str] = None
 
     async def prime(self) -> None:
-        for raw in await self.api.list("Podmortem"):
-            pm = Podmortem.parse(raw)
-            self._items[(pm.metadata.namespace, pm.metadata.name)] = pm
+        items, cursor = await self.api.list_rv("Podmortem")
+        fresh: dict[tuple[str, str], Podmortem] = {}
+        for raw in items:
+            try:
+                pm = Podmortem.parse(raw)
+            except Exception:  # noqa: BLE001 - one bad CR must not wipe the cache
+                log.exception("unparseable Podmortem in list; skipping")
+                continue
+            fresh[(pm.metadata.namespace, pm.metadata.name)] = pm
+        # swap, don't mutate in place: a re-prime after 410 must drop CRs
+        # deleted inside the gap, and a failure above leaves the old cache
+        self._items = fresh
+        self._cursor = cursor
         self._primed = True
         self._ready.set()
 
@@ -103,34 +122,47 @@ class PodmortemCache:
 
     async def run(self, stop: asyncio.Event) -> None:
         """Maintain the cache until ``stop`` is set; resyncs on watch close."""
+        def set_cursor(value: Optional[str]) -> None:
+            self._cursor = value
+
         while not stop.is_set():
             try:
                 if not self._primed:
                     await self.prime()
-                async for event in self.api.watch("Podmortem"):
-                    if event.type == "BOOKMARK":
-                        # cursor-refresh only: its object is bare metadata
-                        # that would otherwise parse into a phantom CR whose
-                        # empty selector matches EVERY pod
-                        continue
+                async for event, version in iter_watch_resumed(
+                    self.api, "Podmortem", None,
+                    lambda: self._cursor, set_cursor,
+                ):
                     try:
                         pm = Podmortem.parse(event.object)
                     except Exception:  # noqa: BLE001 - skip malformed objects
                         log.exception("unparseable Podmortem watch event; skipping")
+                        if version:
+                            self._cursor = version
                         continue
                     key = (pm.metadata.namespace, pm.metadata.name)
                     if event.type == "DELETED":
                         self._items.pop(key, None)
                     else:
                         self._items[key] = pm
+                    if version:
+                        self._cursor = version
                     if stop.is_set():
                         return
             except asyncio.CancelledError:
                 raise
-            except Exception:  # noqa: BLE001 - WatchClosed, ApiError from prime(), ...
-                # a dead cache silently drops every failure; always resync
-                log.warning("podmortem cache interrupted; resyncing", exc_info=True)
+            except WatchExpired:
+                # only a fresh LIST restores a consistent cache (the
+                # helper already cleared the cursor)
+                log.warning("podmortem cache cursor expired; re-listing")
                 self._primed = False
+                await asyncio.sleep(self.resync_delay_s)
+            except Exception:  # noqa: BLE001 - WatchClosed, ApiError from prime(), ...
+                # a dead cache silently drops every failure; resume from the
+                # cursor (or re-list when none survived)
+                log.warning("podmortem cache interrupted; resyncing", exc_info=True)
+                if self._cursor is None:
+                    self._primed = False
                 await asyncio.sleep(self.resync_delay_s)
 
     def matching(self, pod: Pod) -> list[Podmortem]:
@@ -292,38 +324,32 @@ class PodFailureWatcher:
             # single event must still resume from the LIST's version, not
             # relist (the list already observed everything up to it)
             self._cursors[namespace] = cursor
-        try:
-            async for event in self.api.watch(
-                "Pod", namespace, resource_version=cursor
-            ):
-                version = (event.object.get("metadata") or {}).get(
-                    "resourceVersion"
-                )
-                if event.type == "BOOKMARK":
-                    if version:
-                        self._cursors[namespace] = version
-                    continue
-                try:
-                    pod = Pod.parse(event.object)
-                except Exception:  # noqa: BLE001 - skip malformed objects
-                    log.exception("unparseable Pod watch event; skipping")
-                    if version:
-                        self._cursors[namespace] = version
-                    continue
-                await self.handle_pod_event(event.type, pod)
-                # cursor advances only AFTER the handler returns: if it
-                # raises, the restart resumes AT this event and the server
-                # replays it (there is no per-restart sweep to catch a
-                # skipped failure anymore)
+
+        def set_cursor(value: Optional[str]) -> None:
+            self._cursors[namespace] = value
+
+        # 410 (WatchExpired) propagates with the cursor already cleared by
+        # the helper, so the restart path sweeps + relists
+        async for event, version in iter_watch_resumed(
+            self.api, "Pod", namespace,
+            lambda: self._cursors.get(namespace), set_cursor,
+        ):
+            try:
+                pod = Pod.parse(event.object)
+            except Exception:  # noqa: BLE001 - skip malformed objects
+                log.exception("unparseable Pod watch event; skipping")
                 if version:
                     self._cursors[namespace] = version
-                if stop.is_set():
-                    return
-        except WatchExpired:
-            # the apiserver compacted past our cursor: resuming would drop
-            # events silently — clear it so the restart path relists
-            self._cursors[namespace] = None
-            raise
+                continue
+            await self.handle_pod_event(event.type, pod)
+            # cursor advances only AFTER the handler returns: if it
+            # raises, the restart resumes AT this event and the server
+            # replays it (there is no per-restart sweep to catch a
+            # skipped failure anymore)
+            if version:
+                self._cursors[namespace] = version
+            if stop.is_set():
+                return
 
     async def drain(self) -> None:
         """Wait for in-flight pipelines (tests/shutdown)."""

@@ -119,7 +119,12 @@ class AdmissionMixin:
             # restrict to the buckets THIS workload's prompts produce,
             # derived through the real encode/truncate/prefix pipeline so
             # it can never desync from admission
-            probe = workload_params or SamplingParams(max_tokens=1)
+            if workload_params is None:
+                raise ValueError(
+                    "workload_prompts requires workload_params: the "
+                    "truncation budget (max_tokens) decides the buckets"
+                )
+            probe = workload_params
             budget = self.max_seq - max(
                 1, min(probe.max_tokens, self.max_seq // 2)
             )
@@ -133,8 +138,11 @@ class AdmissionMixin:
                     prefix_set.add(
                         _bucket(len(toks) - shared, 64, self.max_seq)
                     )
-                else:
-                    plain_set.add(_bucket(len(toks), 64, self.max_seq))
+                # EVERY prompt's full-length plain bucket is admissible,
+                # prefix-sharer or not: sharing is per-wave all-or-nothing,
+                # so a mixed wave (sharer + non-sharer) takes the PLAIN
+                # program at the longest row's full length
+                plain_set.add(_bucket(len(toks), 64, self.max_seq))
             plain_ts = sorted(plain_set)
             prefix_ts = sorted(prefix_set)
 

@@ -194,3 +194,62 @@ def test_bookmark_refreshes_cursor():
         await asyncio.wait_for(asyncio.gather(task, return_exceptions=True), 5)
 
     run(body())
+
+
+def test_cr_cache_resumes_and_handles_410():
+    """The Podmortem CR cache resumes from its cursor (a CR created while
+    its watch was down appears via replay, without re-listing), and a
+    compacted cursor (410) forces a fresh list that also drops CRs deleted
+    inside the gap."""
+
+    async def body():
+        from operator_tpu.operator.watcher import PodmortemCache
+
+        api = FakeKubeApi()
+        await api.create("Podmortem", _watched_pm().to_dict())
+        cache = PodmortemCache(api, resync_delay_s=0.01)
+        stop = asyncio.Event()
+        task = asyncio.create_task(cache.run(stop))
+        await cache.wait_ready(5)
+        assert len(cache.all()) == 1
+        list_calls = {"n": 0}
+        original = api.list_rv
+
+        async def counting(kind, *a, **kw):
+            if kind == "Podmortem":
+                list_calls["n"] += 1
+            return await original(kind, *a, **kw)
+
+        api.list_rv = counting
+        # gap CR: created entirely while the watch is down -> replay
+        api.close_watches()
+        gap = Podmortem(
+            metadata=ObjectMeta(name="gap", namespace="ns"),
+            spec=PodmortemSpec(
+                pod_selector=LabelSelector(match_labels={"app": "x"})
+            ),
+        )
+        await api.create("Podmortem", gap.to_dict())
+        for _ in range(100):
+            if len(cache.all()) == 2:
+                break
+            await asyncio.sleep(0.02)
+        assert len(cache.all()) == 2, "gap CR not replayed"
+        assert list_calls["n"] == 0, "resume must not re-list"
+        # 410: drop the stream FIRST, then delete + compact inside the gap
+        # so the resume cursor is genuinely stale -> the fresh list must
+        # both pick up changes and forget the deleted CR
+        api.close_watches()
+        await api.delete("Podmortem", "gap", "ns")
+        api.compact_watch_history("Podmortem")
+        for _ in range(200):
+            if len(cache.all()) == 1 and list_calls["n"] >= 1:
+                break
+            await asyncio.sleep(0.02)
+        assert len(cache.all()) == 1, [p.metadata.name for p in cache.all()]
+        assert list_calls["n"] >= 1, "410 must force a re-list"
+        stop.set()
+        api.close_watches()
+        await asyncio.wait_for(asyncio.gather(task, return_exceptions=True), 5)
+
+    run(body())
