@@ -79,7 +79,11 @@ def _flash_prefill_kernel(
     len_ref,  # [B] int32 (SMEM)
     # blocks
     q_ref,  # [1, q_block, 1, G, D] (VMEM)
-    k_hbm,  # [B, S, KH, D] (HBM)
+    k_hbm,  # [B, KH, S, D] (HBM) — head-major: the per-head DMA below
+    # slices a FULL head plane, so the tiled trailing dims (S, D) keep
+    # their extents and bf16's (8,128)x2 tiling stays aligned (a [B, S,
+    # KH, D] layout put KH in the tiled pair and its size-1 slice failed
+    # Mosaic lowering for bf16 — caught by scripts/aot_tpu_check.py)
     v_hbm,
     out_ref,  # [1, q_block, 1, G, D] f32
     # scratch
@@ -119,11 +123,11 @@ def _flash_prefill_kernel(
     def dma(slot, j):
         return (
             pltpu.make_async_copy(
-                k_hbm.at[b, pl.ds(j * kv_block, kv_block), h],
+                k_hbm.at[b, h, pl.ds(j * kv_block, kv_block)],
                 k_buf.at[slot], sem.at[slot, 0],
             ),
             pltpu.make_async_copy(
-                v_hbm.at[b, pl.ds(j * kv_block, kv_block), h],
+                v_hbm.at[b, h, pl.ds(j * kv_block, kv_block)],
                 v_buf.at[slot], sem.at[slot, 1],
             ),
         )
@@ -231,12 +235,17 @@ def _flash_prefill_pallas(
         ],
     )
     q5 = q.reshape(b, t, kh, g, d)
+    # head-major K/V: the kernel DMAs one head's [kv_block, D] plane per
+    # grid step, and with [B, KH, S, D] that slice keeps the tiled (S, D)
+    # pair at full alignment for bf16 (see _flash_prefill_kernel)
+    k_hm = jnp.swapaxes(k, 1, 2)
+    v_hm = jnp.swapaxes(v, 1, 2)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, t, kh, g, d), jnp.float32),
         interpret=interpret,
-    )(lengths, q5, k, v)
+    )(lengths, q5, k_hm, v_hm)
     return out.reshape(b, t, qh * d).astype(q.dtype)
 
 

@@ -1,0 +1,39 @@
+"""AOT cross-compilation of every Pallas kernel for a real v5e target.
+
+Mosaic lowering failures (layout/window asserts) surface at COMPILE time,
+so compiling against an abstract v5e topology on the CPU host validates
+the on-chip-crash risk without a chip (VERDICT r4 item 3 — this caught a
+real one: flash prefill's bf16 K/V head slice broke (8,128)x2 tiling).
+Skips cleanly on jax installs without the TPU compiler (plain CI wheels).
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_all_kernels_aot_compile_for_v5e():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "aot_tpu_check.py")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=str(REPO),
+    )
+    if out.returncode == 42:
+        pytest.skip("this jax install has no TPU compiler")
+    assert out.returncode == 0, out.stdout + out.stderr
+    record = json.loads(out.stdout.strip().splitlines()[-1])
+    assert record["failed"] == 0, record
+    assert all(k["ok"] for k in record["kernels"].values()), record
+    # both production dtypes of every serving kernel must be present
+    for name in (
+        "paged_attention_v1_bf16", "paged_attention_v2_bf16",
+        "flash_prefill_bf16", "similarity_best_window",
+    ):
+        assert name in record["kernels"], record
