@@ -70,15 +70,30 @@ run() {  # run <name> <timeout_s> <cmd...>
   echo "rc=$rc $name" | tee -a "$OUT/series.log"
 }
 
-# kernels FIRST (VERDICT r4 item 3): a short tunnel window validates Mosaic
-# lowering + parity of all four Pallas kernels before any long bench runs
+# ORDER = verdict priority under an uncertain tunnel (r5: two rounds of
+# outage so far): each tier is self-contained evidence, so a short window
+# still yields the north-star numbers even if the series dies mid-flight.
+#
+# tier 1 — de-risk: kernels (VERDICT r4 item 3: Mosaic lowering + parity
+# of all four Pallas kernels) before anything long runs
 run kernels_smoke 900 python scripts/tpu_kernel_smoke.py
+# tier 2 — the north-star evidence itself:
+# headline: TinyLlama bf16, paged, pipeline 2, open-loop SLO sweep
+run bench_main   2400 env BENCH_OPEN_SECONDS=60 BENCH_SWEEP=60,100,150 python bench.py
+# north-star model class: llama-3-8b int8 (~8.2 GB) on the 16 GB chip
+run bench_8b     2400 env BENCH_OPEN=0 BENCH_MODEL=llama-3-8b BENCH_QUANT=1 \
+    BENCH_SLOTS=8 BENCH_REQUESTS=16 BENCH_MAX_SEQ=2048 python bench.py
+# literal BASELINE config 4: 32 slots, 32 concurrent arrivals -> one prefill
+run bench_slots32 900 env BENCH_OPEN=0 BENCH_SLOTS=32 python bench.py
+# the "sustained" half of the north star: >=10 min open loop at 100/min
+# THROUGH the operator pipeline (fake apiserver -> watcher -> pattern
+# engine -> tpu-native provider -> storage), with a leak audit at drain
+run bench_soak  1800 env SOAK_SECONDS=600 SOAK_RATE=100 python scripts/soak.py
+# tier 3 — floors + attribution:
 # the single probe that settles the roofline question (VERDICT r3 weak #5):
 # the fixed weights-streaming leg of the floor profiler
 run floor        600 python scripts/profile_floor.py
 run decode_attr  900 python scripts/profile_decode.py
-# headline: TinyLlama bf16, paged, pipeline 2, open-loop SLO sweep
-run bench_main   2400 env BENCH_OPEN_SECONDS=60 BENCH_SWEEP=60,100,150 python bench.py
 # decode-ahead off (attribution of the pipelining win)
 run bench_nopipe 900 env BENCH_OPEN=0 BENCH_PIPELINE=1 python bench.py
 # bigger pages: 4x fewer grid steps in the paged kernel
@@ -91,13 +106,8 @@ run bench_quant  900 env BENCH_OPEN=0 BENCH_QUANT=1 python bench.py
 run bench_kernel_v2 900 env BENCH_OPEN=0 OPERATOR_TPU_PAGED_KERNEL=v2 python bench.py
 # flash prefill kernel (Pallas) instead of dense/chunked XLA prefill
 run bench_flash  900 env BENCH_OPEN=0 OPERATOR_TPU_FLASH_PREFILL=1 python bench.py
-# literal BASELINE config 4: 32 slots, 32 concurrent arrivals -> one prefill
-run bench_slots32 900 env BENCH_OPEN=0 BENCH_SLOTS=32 python bench.py
 # shared-prefix caching off: attribution of the template-prefill win
 run bench_noprefix 900 env BENCH_OPEN=0 BENCH_PREFIX_CACHE=0 python bench.py
-# north-star model class: llama-3-8b int8 (~8.2 GB) on the 16 GB chip
-run bench_8b     2400 env BENCH_OPEN=0 BENCH_MODEL=llama-3-8b BENCH_QUANT=1 \
-    BENCH_SLOTS=8 BENCH_REQUESTS=16 BENCH_MAX_SEQ=2048 python bench.py
 # layer-scan unrolling: does scan ys-stacking cost decode bandwidth?
 run bench_unroll 900 env BENCH_OPEN=0 OPERATOR_TPU_LAYER_UNROLL=22 python bench.py
 # decode-block straight-lining: does the scan CARRY (cache) get copied?
@@ -114,8 +124,4 @@ run bench_8b_chunked 2400 env BENCH_OPEN=0 BENCH_MODEL=llama-3-8b BENCH_QUANT=1 
 # xplane trace of the timed region for the remaining-gap attribution
 run bench_profile 900 env BENCH_OPEN=0 BENCH_PROFILE=$OUT/xplane python bench.py
 run trace_summary 300 python scripts/analyze_xplane.py "$OUT/xplane" 40
-# the "sustained" half of the north star: >=10 min open loop at 100/min
-# THROUGH the operator pipeline (fake apiserver -> watcher -> pattern
-# engine -> tpu-native provider -> storage), with a leak audit at drain
-run bench_soak  1800 env SOAK_SECONDS=600 SOAK_RATE=100 python scripts/soak.py
 echo "series done $(date +%H:%M:%S)" | tee -a "$OUT/series.log"
