@@ -209,6 +209,25 @@ class Operator:
                 except OversizedRequest:
                     log.warning("minimal warmup also exceeds the KV cache; "
                                 "serving cold")
+            # custom promptTemplate preambles from tpu-native AIProvider
+            # CRs that already exist register BEFORE the grid precompile,
+            # so their prefixed buckets are warm when readiness flips (CRs
+            # created later register lazily on first use,
+            # TPUNativeProvider).  The RAW template is used — build_prompt
+            # renders it verbatim, so a stripped preamble would never
+            # match real prompts
+            if self.config.prefix_cache:
+                try:
+                    for raw in await self.api.list("AIProvider"):
+                        spec = raw.get("spec") or {}
+                        if spec.get("providerId") != "tpu-native":
+                            continue  # other backends never hit this engine
+                        template = spec.get("promptTemplate") or ""
+                        if template.strip():
+                            await engine.add_prefix(template.split("{", 1)[0])
+                except Exception:  # noqa: BLE001 - an optimisation must never block startup
+                    log.warning("AIProvider template prefix scan failed",
+                                exc_info=True)
             # grid precompile: the template probe above warmed ONE bucket;
             # every other (n_pad, t_pad) program a wave can select would
             # otherwise compile in-band as a multi-second p99 outlier (the
@@ -237,7 +256,10 @@ class Operator:
         # never leave explanations on a CLOSED engine while HTTP callers get
         # the new one
         self.providers.register(
-            "tpu-native", TPUNativeProvider(engine, model_id=model_id)
+            "tpu-native", TPUNativeProvider(
+                engine, model_id=model_id,
+                register_template_prefixes=self.config.prefix_cache,
+            )
         )
         self.completion_server = server
         self.engine_warmth = ENGINE_READY

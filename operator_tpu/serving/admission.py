@@ -97,8 +97,10 @@ class AdmissionMixin:
 
         vocab = self.config.vocab_size
         filler = 7 % vocab
-        prefix = list(self._prefix_tokens) if self.paged else []
-        if prefix and prefix[0] == filler:
+        prefixes = (
+            [list(p["tokens"]) for p in self._prefixes] if self.paged else []
+        )
+        while any(p[0] == filler for p in prefixes if p):
             filler = (filler + 1) % vocab
         short = 8  # filler rows: only row 0 drives the t_pad bucket
         n_pads = self._admission_n_pads()
@@ -112,9 +114,12 @@ class AdmissionMixin:
             return sorted(set(ts))
 
         plain_ts = t_buckets(self.max_seq - 1)
-        prefix_ts = (
-            t_buckets(self.max_seq - 1 - len(prefix)) if prefix else []
-        )
+        # per registered prefix: its suffix t buckets (distinct prefix
+        # LENGTHS specialise distinct programs; same-length prefixes share)
+        prefix_ts = {
+            i: t_buckets(self.max_seq - 1 - len(ptoks))
+            for i, ptoks in enumerate(prefixes)
+        }
         if workload_prompts is not None:
             # restrict to the buckets THIS workload's prompts produce,
             # derived through the real encode/truncate/prefix pipeline so
@@ -128,23 +133,27 @@ class AdmissionMixin:
             budget = self.max_seq - max(
                 1, min(probe.max_tokens, self.max_seq // 2)
             )
-            plain_set, prefix_set = set(), set()
+            plain_set: set = set()
+            prefix_sets: dict = {i: set() for i in range(len(prefixes))}
             for prompt in workload_prompts:
                 toks = self._truncate_prompt(
                     self.tokenizer.encode(prompt), budget
                 )
-                shared = self._wave_shared_prefix([toks], [probe])
-                if shared:
-                    prefix_set.add(
-                        _bucket(len(toks) - shared, 64, self.max_seq)
-                    )
+                for i, ptoks in enumerate(prefixes):
+                    if (
+                        len(toks) - 1 >= len(ptoks)
+                        and toks[: len(ptoks)] == ptoks
+                    ):
+                        prefix_sets[i].add(
+                            _bucket(len(toks) - len(ptoks), 64, self.max_seq)
+                        )
                 # EVERY prompt's full-length plain bucket is admissible,
                 # prefix-sharer or not: sharing is per-wave all-or-nothing,
                 # so a mixed wave (sharer + non-sharer) takes the PLAIN
                 # program at the longest row's full length
                 plain_set.add(_bucket(len(toks), 64, self.max_seq))
             plain_ts = sorted(plain_set)
-            prefix_ts = sorted(prefix_set)
+            prefix_ts = {i: sorted(v) for i, v in prefix_sets.items()}
 
         guided_variants = [False] + ([True] if level == "full" else [])
         base = dict(max_tokens=1, stop_on_eos=False)
@@ -154,8 +163,9 @@ class AdmissionMixin:
                 **base,
                 guided_choice=("warm", "cold") if guided else None,
             )
-            # plain grid: first token diverges from the shared prefix so
-            # _wave_shared_prefix refuses and the plain program is selected
+            # plain grid: first token diverges from every registered
+            # prefix so _wave_prefix_match refuses and the plain program
+            # is selected
             for t in plain_ts:
                 long_row = [filler] * min(t, self.max_seq - 1)
                 for n in n_pads:
@@ -163,15 +173,16 @@ class AdmissionMixin:
                         [filler] * short for _ in range(n - 1)
                     ]
                     waves.append((rows, params))
-            # shared-prefix grid: every row starts with the cached prefix
-            if prefix:
-                for t in prefix_ts:
-                    long_sfx = min(t, self.max_seq - 1 - len(prefix))
+            # shared-prefix grid, per registered prefix: every row starts
+            # with THAT prefix
+            for i, ptoks in enumerate(prefixes):
+                for t in prefix_ts.get(i, []):
+                    long_sfx = min(t, self.max_seq - 1 - len(ptoks))
                     if long_sfx < 1:
                         continue
                     for n in n_pads:
-                        rows = [prefix + [filler] * long_sfx] + [
-                            prefix + [filler] * short for _ in range(n - 1)
+                        rows = [ptoks + [filler] * long_sfx] + [
+                            ptoks + [filler] * short for _ in range(n - 1)
                         ]
                         waves.append((rows, params))
 
@@ -233,8 +244,8 @@ class AdmissionMixin:
         params = SamplingParams(**base)
         for n in range(1, self.max_slots + 1):
             drive([[filler] * short] * n, params)
-            if prefix:
-                drive([prefix + [filler] * short] * n, params)
+            if prefixes:
+                drive([prefixes[0] + [filler] * short] * n, params)
         result = {
             "level": level,
             "programs": self._program_count() - before,
@@ -284,11 +295,13 @@ class AdmissionMixin:
         the REAL admission path (bucket selection included)."""
         page_grants: list[list[int]] = []
         if self.paged:
-            # shared-prefix reuse: when EVERY prompt starts with the cached
-            # prefix, rows reference the generator-owned prefix pages and
-            # allocate (and later prefill) only their suffix
-            shared = self._wave_shared_prefix(token_lists, params_list)
-            pool = self.allocator.num_pages - 1 - len(self._prefix_pages)
+            # shared-prefix reuse: when EVERY prompt starts with one
+            # registered prefix, rows reference its generator-owned pages
+            # and allocate (and later prefill) only their suffix
+            shared, prefix_pages = self._wave_prefix_match(
+                token_lists, params_list
+            )
+            pool = self.allocator.num_pages - 1 - self.prefix_held_pages
             for toks, sampling in zip(token_lists, params_list):
                 total = min(len(toks) + sampling.max_tokens, self.max_seq)
                 need = -(-total // self.page_size) - shared // self.page_size
@@ -309,7 +322,7 @@ class AdmissionMixin:
             try:
                 return self._admit_batch(
                     token_lists, params_list, page_grants, started,
-                    prefix_shared=shared,
+                    prefix_shared=shared, prefix_pages=prefix_pages,
                 )
             except BaseException:
                 for grant in page_grants:  # don't leak pages on prefill failure
@@ -347,6 +360,7 @@ class AdmissionMixin:
         page_grants: list[list[int]],
         started: float,
         prefix_shared: int = 0,
+        prefix_pages: "list[int] | None" = None,
     ) -> list[int]:
         jnp = self._jnp
         free = self.free_slots()
@@ -421,10 +435,11 @@ class AdmissionMixin:
                 )
             staged, row_tables = self._stage_page_tables(
                 n, n_pad, slot_ids, page_grants, lengths,
-                prefix_shared=prefix_shared,
+                prefix_shared=prefix_shared, prefix_pages=prefix_pages,
             )
             prefix_table = jnp.asarray(
-                self._prefix_pages[: prefix_shared // self.page_size], jnp.int32
+                (prefix_pages or [])[: prefix_shared // self.page_size],
+                jnp.int32,
             )
             outs = self._prefix_fns[pkey](
                 self.params, staged, prefix_table, jnp.asarray(ids),
@@ -505,58 +520,75 @@ class AdmissionMixin:
         if len(ids) <= budget:
             return ids
         head = 0
-        if self.paged and self._prefix_tokens:
-            for a, b in zip(ids, self._prefix_tokens):
-                if a != b:
-                    break
-                head += 1
+        if self.paged and self._prefixes:
+            # keep the longest registered-prefix run as the head (the
+            # instructions), whichever template produced this prompt
+            for entry in self._prefixes:
+                common = 0
+                for a, b in zip(ids, entry["tokens"]):
+                    if a != b:
+                        break
+                    common += 1
+                head = max(head, common)
             head = min(head, budget // 2)
             head = (head // self.page_size) * self.page_size
         return ids[:head] + ids[-(budget - head):]
 
-    def _wave_shared_prefix(
+    def _wave_prefix_match(
         self, token_lists: list, params_list: "Sequence[SamplingParams]"
-    ) -> int:
-        """Whole-page prefix-token count shared by EVERY prompt in the
-        wave (0 = at least one prompt diverges before a full page).
+    ) -> "tuple[int, list[int]]":
+        """(shared token count, that prefix's pages) for the LONGEST
+        registered prefix EVERY prompt in the wave fully matches —
+        (0, []) when no prefix covers the whole wave.
 
         LoRA waves never share: adapters modify the K/V projections, so
         the base-model prefix KV would not equal what a full prefill with
         the adapter computes — reuse must stay EXACT."""
-        if not (self.paged and self._prefix_tokens and token_lists):
-            return 0
+        if not (self.paged and self._prefixes and token_lists):
+            return 0, []
         if any(p.adapter for p in params_list):
-            return 0
+            return 0, []
         if any(not toks for toks in token_lists):
             # encode() normally guarantees >=1 token (BOS), but the page
             # arithmetic below must not hinge on tokenizer behavior: an
             # empty row would make len(toks)-1 negative and the floored
             # page multiple would slice token_lists from the tail
-            return 0
-        shared = len(self._prefix_tokens)
-        for toks in token_lists:
-            common = 0
-            for a, b in zip(toks, self._prefix_tokens):
-                if a != b:
-                    break
-                common += 1
-            # every row must keep >=1 suffix token: its first sampled
-            # token needs a logit row in the suffix program
-            shared = min(shared, common, len(toks) - 1)
-        shared = (shared // self.page_size) * self.page_size
-        # all-or-nothing: the suffix program is specialised on the static
-        # shared length, so interior values (e.g. the page-floored half
-        # budget a truncated long prompt keeps, _truncate_prompt) would
-        # each compile their OWN (n_pad, t_sfx, shared) program — an
-        # unbounded compile surface that defeats the warmup grid
-        # (precompile_grid) and turns rare long prompts into mid-run
-        # multi-second p99 outliers.  A wave that cannot reuse the WHOLE
-        # cached prefix takes the precompiled plain program instead.
-        return shared if shared == len(self._prefix_tokens) else 0
+            return 0, []
+        best, best_pages = 0, []
+        for entry in self._prefixes:
+            ptoks = entry["tokens"]
+            # all-or-nothing makes partial-run counting useless: a C-speed
+            # slice equality per row decides coverage (every row must also
+            # keep >=1 suffix token: its first sampled token needs a logit
+            # row in the suffix program)
+            shared = len(ptoks)
+            for toks in token_lists:
+                if len(toks) - 1 < len(ptoks) or toks[: len(ptoks)] != ptoks:
+                    shared = 0
+                    break  # this prefix can't cover the whole wave
+            # all-or-nothing PER PREFIX: the suffix program is specialised
+            # on the static shared length, so interior values (e.g. the
+            # page-floored half budget a truncated long prompt keeps,
+            # _truncate_prompt) would each compile their OWN
+            # (n_pad, t_sfx, shared) program — an unbounded compile
+            # surface that defeats the warmup grid (precompile_grid) and
+            # turns rare long prompts into mid-run multi-second p99
+            # outliers.  A wave that cannot reuse a WHOLE cached prefix
+            # takes the precompiled plain program instead.
+            if shared and shared > best:
+                best, best_pages = shared, entry["pages"]
+        return best, best_pages
+
+    def _wave_shared_prefix(
+        self, token_lists: list, params_list: "Sequence[SamplingParams]"
+    ) -> int:
+        """Shared token count alone (see :meth:`_wave_prefix_match`)."""
+        return self._wave_prefix_match(token_lists, params_list)[0]
 
     def _stage_page_tables(
         self, n: int, n_pad: int, slot_ids, page_grants, lengths,
         prefix_shared: int = 0,
+        prefix_pages: "list[int] | None" = None,
     ):
         """Build the wave's page-table rows and a STAGED cache carrying
         them (shared by one-shot and chunked prefill); padding rows
@@ -577,9 +609,9 @@ class AdmissionMixin:
         for row, grant in enumerate(page_grants):
             if n_prefix:
                 # shared-prefix wave: every row's table starts with the
-                # generator-owned prefix pages (read-only; never in the
-                # grant, so slot teardown cannot free them)
-                row_tables[row, :n_prefix] = self._prefix_pages[:n_prefix]
+                # MATCHED prefix's generator-owned pages (read-only; never
+                # in the grant, so slot teardown cannot free them)
+                row_tables[row, :n_prefix] = (prefix_pages or [])[:n_prefix]
             row_tables[row, n_prefix: n_prefix + len(grant)] = grant
         for row in range(n, n_pad):
             row_tables[row] = row_tables[0]

@@ -217,6 +217,16 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
 
         self.paged = paged
         self.page_size = page_size
+        # ---- shared-prefix KV cache (add_shared_prefix): each registered
+        # prompt prefix is prefilled ONCE into generator-owned pages;
+        # admitted prompts that start with one reference those pages
+        # read-only and prefill only their suffix.  Registry entries:
+        # {"text", "tokens", "pages"} in registration order (the default
+        # template first, then custom AIProvider promptTemplates).
+        # Initialised unconditionally: reset() and the compat properties
+        # read it in contiguous (non-paged) mode too, where it stays empty
+        self._prefixes: list[dict] = []
+        self._prefix_fns: dict[tuple, Any] = {}  # (n_pad, t_sfx, shared, guided)
         if paged:
             from ..ops.paged_attention import PagedKVCache
 
@@ -228,14 +238,6 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
             self.allocator = PageAllocator(num_pages)
             self.cache = None
             self._alloc_decode_state()
-            # ---- shared-prefix KV cache (set_shared_prefix): one prompt
-            # prefix prefilled ONCE into generator-owned pages; admitted
-            # prompts that start with it reference those pages read-only
-            # and prefill only their suffix
-            self._prefix_tokens: list[int] = []
-            self._prefix_pages: list[int] = []
-            self._prefix_text: Optional[str] = None
-            self._prefix_fns: dict[tuple, Any] = {}  # (n_pad, t_sfx, shared, guided)
             if mesh is not None:
                 s = self._shardings
                 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -527,55 +529,128 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
     # shared-prefix KV cache (automatic prefix caching, paged mode)
     # ------------------------------------------------------------------
 
+    #: registered-prefix cap: each entry owns up to ~max_seq/page_size KV
+    #: pages for the engine's lifetime — a runaway CR set must not eat the
+    #: pool (realistic deployments have a handful of AIProvider templates)
+    MAX_SHARED_PREFIXES = 8
+
+    @property
+    def _prefix_tokens(self) -> list:
+        """PRIMARY (first-registered) prefix's tokens — compatibility view
+        for single-prefix call sites; multi-prefix logic iterates
+        ``self._prefixes``."""
+        return self._prefixes[0]["tokens"] if self._prefixes else []
+
+    @property
+    def _prefix_pages(self) -> list:
+        return self._prefixes[0]["pages"] if self._prefixes else []
+
+    @property
+    def prefix_held_pages(self) -> int:
+        """KV pages owned by ALL registered prefixes (leak-audit and page
+        pool accounting: these are held for the engine's lifetime by
+        design, never in any slot's grant)."""
+        return sum(len(p["pages"]) for p in self._prefixes)
+
+    def _prefix_keep_len(self, tokens: list) -> int:
+        """Page-floored cacheable length of a prefix's tokens: leave at
+        least one page of room for every suffix + generation, and at
+        least one suffix token so the sampled first token always has a
+        logit row (admission additionally enforces this per wave)."""
+        max_keep = self.max_seq - max(self.page_size, 64)
+        return (
+            min(len(tokens) - 1, max_keep) // self.page_size
+        ) * self.page_size
+
     def set_shared_prefix(self, text: str) -> int:
-        """Prefill ``text``'s KV ONCE into generator-owned pages; later
-        prompts that start with it skip recomputing that prefix.
-
-        The serving workload this system exists for shares one prompt
-        template across every request (SURVEY.md §2.2: 32 concurrent
-        failure events -> one prefill), so the template's static preamble
-        is prefilled once and every admission forwards only its suffix —
-        the vLLM "automatic prefix caching" idea reduced to the one shared
-        prefix that actually occurs, with no radix tree and no refcounts:
-        the prefix pages are OWNED by the generator (never in any slot's
-        grant, so sequence teardown can never free them).
-
-        Sharing is decided per admission wave by TOKEN comparison (BPE
-        boundaries need not align with the text prefix) and rounded down
-        to whole pages; a wave with any non-matching prompt falls back to
-        the ordinary full prefill.  Over-budget prompts keep the fast
-        path: admission truncation drops their MIDDLE, preserving the
-        prefix head and the evidence tail (``_truncate_prompt``).  Paged
-        mode only.  Returns the number of prefix tokens cached (0 =
-        nothing cached).
-        """
-        jnp = self._jnp
+        """Replace every registered prefix with this one (idle engine
+        required: live slots' tables may reference the released pages).
+        An UNCACHEABLE text (too short) leaves the existing registry
+        intact rather than clearing it first.  See
+        :meth:`add_shared_prefix` for semantics."""
         if not self.paged:
             log.warning("set_shared_prefix needs paged KV; ignoring")
             return 0
         if self.num_active:
-            # live slots' page tables may reference the CURRENT prefix
-            # pages; releasing them mid-decode would hand another wave
-            # pages a live sequence still attends over
             raise RuntimeError(
                 "set_shared_prefix requires an idle engine "
                 f"({self.num_active} sequences active)"
             )
+        if self._prefix_keep_len(self.tokenizer.encode(text)) < self.page_size:
+            log.warning("shared prefix shorter than one page; not caching")
+            return 0
+        self.clear_shared_prefixes()
+        return self.add_shared_prefix(text)
+
+    def clear_shared_prefixes(self) -> None:
+        """Release every registered prefix's pages (idle engine only)."""
+        if self.num_active:
+            raise RuntimeError(
+                "clear_shared_prefixes requires an idle engine "
+                f"({self.num_active} sequences active)"
+            )
+        for entry in self._prefixes:
+            self.allocator.release(entry["pages"])
+        self._prefixes = []
+        self._prefix_fns.clear()
+
+    def add_shared_prefix(self, text: str) -> int:
+        """Prefill ``text``'s KV ONCE into generator-owned pages; later
+        prompts that start with it skip recomputing that prefix.
+
+        The serving workload this system exists for shares a prompt
+        template across every request (SURVEY.md §2.2: 32 concurrent
+        failure events -> one prefill), so each template's static preamble
+        is prefilled once and every admission forwards only its suffix —
+        the vLLM "automatic prefix caching" idea reduced to the FEW shared
+        prefixes that actually occur (the default template plus custom
+        AIProvider promptTemplates), with no radix tree and no refcounts:
+        prefix pages are OWNED by the generator (never in any slot's
+        grant, so sequence teardown can never free them).
+
+        Sharing is decided per admission wave by TOKEN comparison (BPE
+        boundaries need not align with the text prefix) against every
+        registered prefix — the longest one EVERY row fully matches wins,
+        rounded down to whole pages; a wave matching none falls back to
+        the ordinary full prefill.  Over-budget prompts keep the fast
+        path: admission truncation drops their MIDDLE, preserving the
+        prefix head and the evidence tail (``_truncate_prompt``).
+
+        Safe while serving: registration only ALLOCATES pages and updates
+        the cache functionally (release paths — set/clear — require an
+        idle engine).  Registration is idempotent by cached tokens.  Paged
+        mode only.  Returns the number of prefix tokens cached (0 =
+        nothing cached)."""
+        jnp = self._jnp
+        if not self.paged:
+            log.warning("add_shared_prefix needs paged KV; ignoring")
+            return 0
         tokens = self.tokenizer.encode(text)
-        # leave at least one page of room for every suffix + generation,
-        # and at least one suffix token so the sampled first token always
-        # has a logit row (admission additionally enforces this per wave)
-        max_keep = self.max_seq - max(self.page_size, 64)
-        n_keep = (min(len(tokens) - 1, max_keep) // self.page_size) * self.page_size
+        n_keep = self._prefix_keep_len(tokens)
         if n_keep < self.page_size:
             log.warning("shared prefix shorter than one page; not caching")
             return 0
-        if self._prefix_pages:
-            self.allocator.release(self._prefix_pages)
-            self._prefix_pages = []
-            self._prefix_tokens = []
-            self._prefix_fns.clear()
-        pages = self.allocator.allocate(n_keep // self.page_size)
+        for entry in self._prefixes:
+            if entry["tokens"] == tokens[:n_keep]:
+                return n_keep  # idempotent: already cached
+        if len(self._prefixes) >= self.MAX_SHARED_PREFIXES:
+            log.warning(
+                "shared-prefix registry full (%d); %r not cached",
+                self.MAX_SHARED_PREFIXES, text[:60],
+            )
+            return 0
+        need = n_keep // self.page_size
+        if self.allocator.available - need < self.pages_per_seq:
+            # prefixes must never starve admission: keep at least one full
+            # sequence's worth of pages grantable (registration is an
+            # optimisation — a refused one costs full prefill, not errors)
+            log.warning(
+                "shared prefix %r needs %d pages but only %d are free "
+                "(one-sequence reserve %d); not cached",
+                text[:60], need, self.allocator.available, self.pages_per_seq,
+            )
+            return 0
+        pages = self.allocator.allocate(need)
         config, jax = self.config, self._jax
         score_shards = self._prefill_score_shards() if self.mesh is not None else 1
 
@@ -622,10 +697,11 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
         except BaseException:
             self.allocator.release(pages)
             raise
-        self._prefix_tokens = tokens[:n_keep]
-        self._prefix_pages = pages
-        self._prefix_text = text
-        log.info("shared prefix cached: %d tokens in %d pages", n_keep, len(pages))
+        self._prefixes.append(
+            {"text": text, "tokens": tokens[:n_keep], "pages": pages}
+        )
+        log.info("shared prefix cached: %d tokens in %d pages (%d registered)",
+                 n_keep, len(pages), len(self._prefixes))
         return n_keep
 
     # ------------------------------------------------------------------
@@ -700,10 +776,10 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
         self._guided_aut_np[:] = 0
         self.guided_aut = None
         self.guided_state = None
+        prefix_texts = [p["text"] for p in self._prefixes]
         if self.paged:
             self.allocator = PageAllocator(self.allocator.num_pages)
-            self._prefix_tokens = []
-            self._prefix_pages = []
+            self._prefixes = []
             self._prefix_fns.clear()
         self._alloc_decode_state()
         for i in range(self.max_slots):
@@ -711,19 +787,20 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
             self.slots[i] = _Slot()
         self._host_offsets[:] = 0
         self._sampling_cache = None
-        if self.paged and self._prefix_text:
-            # the page pool was rebuilt: re-prime the shared prefix so
-            # post-recovery admissions keep their fast path.  Guarded: a
+        if self.paged and prefix_texts:
+            # the page pool was rebuilt: re-prime every registered prefix
+            # so post-recovery admissions keep their fast path.  Guarded: a
             # failed re-prime must not fail the RECOVERY — serving without
             # the optimisation beats staying down (_try_recover treats a
             # reset() exception as fatal)
-            try:
-                self.set_shared_prefix(self._prefix_text)
-            except Exception:  # noqa: BLE001
-                log.warning(
-                    "shared-prefix re-prime failed after reset; serving "
-                    "without it", exc_info=True,
-                )
+            for text in prefix_texts:
+                try:
+                    self.add_shared_prefix(text)
+                except Exception:  # noqa: BLE001
+                    log.warning(
+                        "shared-prefix re-prime failed after reset; serving "
+                        "without it", exc_info=True,
+                    )
 
     def free_slots(self) -> list[int]:
         return [
@@ -1268,6 +1345,17 @@ class ServingEngine:
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
             self._executor, lambda: self.generator.precompile_grid(level)
+        )
+
+    async def add_prefix(self, text: str) -> int:
+        """Register a shared prompt prefix (generator.add_shared_prefix)
+        on the decode worker: safe while serving — registration only
+        allocates pages and updates the cache functionally.  Programs for
+        the new prefix's buckets compile in-band on their first waves
+        (restart to fold them into the warmup grid)."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._executor, lambda: self.generator.add_shared_prefix(text)
         )
 
     async def ensure_guided(self, spec: tuple) -> None:

@@ -67,12 +67,47 @@ def _parse_mesh_plan(spec: str, devices: list, model_config):
 class TPUNativeProvider:
     """AIProviderBackend serving explanations from the in-process engine."""
 
-    def __init__(self, engine: ServingEngine, *, model_id: str) -> None:
+    def __init__(
+        self,
+        engine: ServingEngine,
+        *,
+        model_id: str,
+        register_template_prefixes: bool = True,
+    ) -> None:
         self.engine = engine
         self.model_id = model_id
+        #: gate for lazy promptTemplate prefix registration — follows the
+        #: operator's PREFIX_CACHE config (a disabled cache must not grow
+        #: a registry through the side door)
+        self.register_template_prefixes = register_template_prefixes
+        # custom promptTemplate preambles already registered (or refused)
+        # as shared prefixes — one attempt per distinct template
+        self._registered_templates: set[str] = set()
+
+    async def _ensure_template_prefix(self, template: Optional[str]) -> None:
+        """Register a custom template's static preamble as a shared KV
+        prefix, once: later waves of this CR's requests then prefill only
+        their variable remainder (the default template was registered at
+        engine build, serving/provider.py build_serving_engine)."""
+        if not self.register_template_prefixes:
+            return
+        if not template or template in self._registered_templates:
+            return
+        self._registered_templates.add(template)
+        preamble = template.split("{", 1)[0]
+        try:
+            cached = await self.engine.add_prefix(preamble)
+            if cached:
+                log.info("custom template preamble cached: %d tokens", cached)
+        except Exception:  # noqa: BLE001 - an optimisation must never fail a request
+            log.warning("custom template prefix registration failed",
+                        exc_info=True)
 
     async def generate(self, request: AnalysisRequest) -> AIResponse:
         config = request.provider_config
+        await self._ensure_template_prefix(
+            config.prompt_template if config else None
+        )
         prompt = build_prompt(request)
         # per-CR LoRA adapter (multi-LoRA serving): AIProvider
         # spec.additionalConfig.lora_adapter names a registered adapter;
@@ -310,4 +345,7 @@ def build_tpu_native_provider(
     ``providerId: tpu-native`` then multiplexes onto the same batch.
     """
     engine, model_id = build_serving_engine(config)
-    return TPUNativeProvider(engine, model_id=model_id)
+    return TPUNativeProvider(
+        engine, model_id=model_id,
+        register_template_prefixes=(config or OperatorConfig()).prefix_cache,
+    )

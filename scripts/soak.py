@@ -219,7 +219,7 @@ async def main() -> int:
             free = len(allocator._free)
             # minus the trash page and the generator-owned shared-prefix
             # pages (held for the engine's lifetime by design)
-            held = len(getattr(generator, "_prefix_pages", []) or [])
+            held = int(getattr(generator, "prefix_held_pages", 0))
             total = allocator.num_pages - 1 - held
             if free != total:
                 leaks["kv_pages"] = {"free": free, "total": total,

@@ -56,7 +56,7 @@ def test_grid_covers_varied_traffic_with_zero_midrun_compiles():
         assert report["programs"] > 0
         # clean state after the grid: all slots free, all pages back
         assert gen.num_active == 0
-        held = len(gen._prefix_pages)
+        held = gen.prefix_held_pages
         assert len(gen.allocator._free) == gen.allocator.num_pages - 1 - held
         watch.mark()
         _drain(gen, [

@@ -239,3 +239,87 @@ def test_reset_reprimes_prefix(params):
     again = _drain(generator, [PREFIX + "after reset"])
     solo = _drain(_generator(params), [PREFIX + "after reset"])
     assert again == solo
+
+
+# --- multi-prefix registry (round 5: custom AIProvider promptTemplates) ----
+
+PREFIX_B = (
+    "Summarise this incident for an executive audience in plain words, "
+    "avoiding jargon, then list remediation steps in order of priority. "
+)
+
+
+def test_two_prefixes_each_get_exact_reuse(params):
+    """Waves of either template share THEIR prefix and produce exactly the
+    uncached generator's greedy tokens (causal exactness per prefix)."""
+    plain = _generator(params)
+    generator = _generator(params)
+    assert generator.add_shared_prefix(PREFIX) > 0
+    assert generator.add_shared_prefix(PREFIX_B) > 0
+    assert len(generator._prefixes) == 2
+    for pre in (PREFIX, PREFIX_B):
+        prompts = [pre + "pod oomkilled", pre + "disk pressure on node"]
+        toks = [generator.tokenizer.encode(p) for p in prompts]
+        shared, pages = generator._wave_prefix_match(
+            toks, [GREEDY] * len(toks)
+        )
+        assert shared > 0 and pages, pre
+        assert _drain(generator, prompts) == _drain(plain, prompts)
+
+
+def test_mixed_template_wave_takes_plain_path(params):
+    generator = _generator(params)
+    generator.add_shared_prefix(PREFIX)
+    generator.add_shared_prefix(PREFIX_B)
+    toks = [
+        generator.tokenizer.encode(PREFIX + "suffix one"),
+        generator.tokenizer.encode(PREFIX_B + "suffix two"),
+    ]
+    assert generator._wave_shared_prefix(toks, [GREEDY, GREEDY]) == 0
+    # and generation still matches the uncached path
+    prompts = [PREFIX + "suffix one", PREFIX_B + "suffix two"]
+    assert _drain(generator, prompts) == _drain(_generator(params), prompts)
+
+
+def test_longest_matching_prefix_wins(params):
+    generator = _generator(params)
+    longer = PREFIX + "Always cite the exact log line as evidence. "
+    assert generator.add_shared_prefix(PREFIX) > 0
+    n_long = generator.add_shared_prefix(longer)
+    assert n_long > 0
+    toks = [generator.tokenizer.encode(longer + "pod crashed hard")]
+    shared, pages = generator._wave_prefix_match(toks, [GREEDY])
+    assert shared == n_long, (shared, n_long)
+    assert len(pages) == n_long // generator.page_size
+
+
+def test_add_prefix_idempotent_and_capped(params):
+    generator = _generator(params)
+    first = generator.add_shared_prefix(PREFIX)
+    held = generator.prefix_held_pages
+    assert generator.add_shared_prefix(PREFIX) == first  # no duplicate
+    assert generator.prefix_held_pages == held
+    for i in range(generator.MAX_SHARED_PREFIXES + 2):
+        generator.add_shared_prefix(
+            f"registry filler template number {i}: " + "pad " * 30
+        )
+    assert len(generator._prefixes) <= generator.MAX_SHARED_PREFIXES
+    # clear releases every held page (idle engine)
+    generator.clear_shared_prefixes()
+    assert generator.prefix_held_pages == 0
+    assert generator.allocator.available == generator.allocator.num_pages - 1
+
+
+def test_reset_reprimes_all_registered_prefixes(params):
+    generator = _generator(params)
+    generator.add_shared_prefix(PREFIX)
+    generator.add_shared_prefix(PREFIX_B)
+    held = generator.prefix_held_pages
+    generator.reset()
+    assert len(generator._prefixes) == 2
+    assert generator.prefix_held_pages == held
+    # post-recovery waves still share and still match the uncached path
+    prompts = [PREFIX_B + "after recovery"]
+    toks = [generator.tokenizer.encode(prompts[0])]
+    assert generator._wave_shared_prefix(toks, [GREEDY]) > 0
+    assert _drain(generator, prompts) == _drain(_generator(params), prompts)
