@@ -198,6 +198,11 @@ def main() -> None:
     from operator_tpu.serving.prompts import build_prompt
 
     devices, platform = init_devices()
+    from operator_tpu.utils.platform import enable_persistent_compilation_cache
+
+    cache_dir = enable_persistent_compilation_cache()
+    if cache_dir:
+        log(f"persistent XLA cache: {cache_dir}")
     log(f"devices ({platform}): {devices}")
 
     if platform == "cpu-fallback" and "BENCH_MODEL" not in os.environ:

@@ -217,17 +217,27 @@ class Operator:
             # renders it verbatim, so a stripped preamble would never
             # match real prompts
             if self.config.prefix_cache:
+                from ..serving.prompts import template_preamble
+
                 try:
-                    for raw in await self.api.list("AIProvider"):
-                        spec = raw.get("spec") or {}
-                        if spec.get("providerId") != "tpu-native":
-                            continue  # other backends never hit this engine
-                        template = spec.get("promptTemplate") or ""
-                        if template.strip():
-                            await engine.add_prefix(template.split("{", 1)[0])
+                    providers_raw = await self.api.list("AIProvider")
                 except Exception:  # noqa: BLE001 - an optimisation must never block startup
+                    providers_raw = []
                     log.warning("AIProvider template prefix scan failed",
                                 exc_info=True)
+                for raw in providers_raw:
+                    spec = raw.get("spec") or {}
+                    if spec.get("providerId") != "tpu-native":
+                        continue  # other backends never hit this engine
+                    preamble = template_preamble(spec.get("promptTemplate") or "")
+                    if not preamble:
+                        continue  # empty or non-rendering template
+                    try:
+                        await engine.add_prefix(preamble)
+                    except Exception:  # noqa: BLE001 - per CR: one failure must
+                        # not abort the remaining templates' registration
+                        log.warning("template prefix registration failed for "
+                                    "one AIProvider", exc_info=True)
             # grid precompile: the template probe above warmed ONE bucket;
             # every other (n_pad, t_pad) program a wave can select would
             # otherwise compile in-band as a multi-second p99 outlier (the

@@ -102,6 +102,29 @@ def build_warmup_prompt() -> str:
     )
 
 
+def template_preamble(template: str) -> "str | None":
+    """The static preamble of a prompt template — everything above its
+    first ``{`` placeholder — IF the template actually renders.
+
+    The one extraction rule for every shared-prefix registration site
+    (engine build, the operator's startup CR scan, the provider's lazy
+    path): a template whose ``format`` raises falls back to
+    DEFAULT_TEMPLATE in :func:`build_prompt`, so registering ITS preamble
+    would hold KV pages and a registry slot for a prefix no rendered
+    prompt ever starts with — such templates return None."""
+    if not template or not template.strip():
+        return None
+    probe = {
+        "pod_name": "p", "namespace": "n", "severity": "NONE",
+        "patterns": "x", "evidence": "x", "log_tail": "x",
+    }
+    try:
+        template.format(**probe)
+    except (KeyError, IndexError, ValueError):
+        return None
+    return template.split("{", 1)[0]
+
+
 def build_prompt(request: AnalysisRequest) -> str:
     from ..patterns.windows import tail_chars  # local import keeps serving lean
 

@@ -94,7 +94,15 @@ class TPUNativeProvider:
         if not template or template in self._registered_templates:
             return
         self._registered_templates.add(template)
-        preamble = template.split("{", 1)[0]
+        from .prompts import template_preamble
+
+        preamble = template_preamble(template)
+        if preamble is None:
+            # build_prompt will fall back to DEFAULT_TEMPLATE for this
+            # broken template; registering its preamble would hold pages
+            # and a registry slot for a prefix no prompt ever starts with
+            log.warning("promptTemplate does not render; prefix not cached")
+            return
         try:
             cached = await self.engine.add_prefix(preamble)
             if cached:
@@ -193,6 +201,11 @@ def build_serving_engine(
 
     from ..models import get_config, init_params
     from ..models.loader import load_params
+    from ..utils.platform import enable_persistent_compilation_cache
+
+    cache_dir = enable_persistent_compilation_cache()
+    if cache_dir:
+        log.info("persistent XLA compilation cache: %s", cache_dir)
     from ..models.tokenizer import load_tokenizer
 
     config = config or OperatorConfig.from_env()
@@ -325,9 +338,9 @@ def build_serving_engine(
         # prefills only its variable remainder.  CRs with a custom
         # promptTemplate simply fall back to full prefill (the engine
         # compares TOKENS per wave; a non-matching wave costs nothing).
-        from .prompts import DEFAULT_TEMPLATE
+        from .prompts import DEFAULT_TEMPLATE, template_preamble
 
-        static_preamble = DEFAULT_TEMPLATE.split("{", 1)[0]
+        static_preamble = template_preamble(DEFAULT_TEMPLATE)
         try:
             generator.set_shared_prefix(static_preamble)
         except Exception:  # noqa: BLE001 - an optimisation must never block startup

@@ -29,3 +29,37 @@ def pin_cpu_if_requested(force: bool = False) -> bool:
         jax.config.update("jax_platforms", "cpu")
         return True
     return False
+
+
+def enable_persistent_compilation_cache(path: str | None = None) -> str | None:
+    """Enable jax's persistent executable cache so XLA programs survive
+    process restarts (``path`` or env ``OPERATOR_TPU_XLA_CACHE_DIR``; no-op
+    when neither is set).
+
+    The payoff is on TPU, where the serving program grid costs minutes of
+    Mosaic/XLA compiles per process: the experiment series pays it once
+    across all its bench steps, an operator restart re-warms from disk
+    instead of recompiling, and the driver's bench run shares the series'
+    cache.  Returns the cache dir when enabled."""
+    path = (path or os.environ.get("OPERATOR_TPU_XLA_CACHE_DIR", "")).strip()
+    if not path:
+        return None
+    import jax
+
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_enable_compilation_cache", True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # skip sub-second compiles: their disk round-trip costs more than
+        # the recompile (measured on the cpu backend)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except OSError as exc:
+        # an optimisation must never block startup: an unwritable cache
+        # dir (dropped volume mount, read-only fs) just disables it
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "persistent XLA cache disabled: %s unusable (%s)", path, exc
+        )
+        return None
+    return path

@@ -60,6 +60,10 @@ async def main() -> int:
     pin_cpu_if_requested()
     import jax
 
+    from operator_tpu.utils.platform import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
+
     from operator_tpu.utils.compilewatch import CompileWatcher
 
     compile_watch = CompileWatcher()

@@ -15,6 +15,10 @@ cd "$(dirname "$0")/.."
 # consumers can detect a SIGKILL'd (e.g. OOM-killed) series — treat the
 # flag as stale when `kill -0 $(cat RUNNING)` fails
 trap 'rm -f "$OUT/RUNNING"' EXIT
+# one persistent XLA-executable cache across every step: each bench step is
+# a fresh process that would otherwise re-pay the whole program grid's
+# Mosaic/XLA compiles; the driver's own bench run shares it too
+export OPERATOR_TPU_XLA_CACHE_DIR="$OUT/xla_cache"
 
 wait_chip() {  # block until the TPU answers a device probe (a step killed at
   # its timebox can leave the tunnel holding the chip for a while; starting
