@@ -166,6 +166,10 @@ class Operator:
                 from ..patterns.semantic import build_embedder
 
                 embedder = build_embedder(None)
+            tpu_provider = TPUNativeProvider(
+                engine, model_id=model_id,
+                register_template_prefixes=self.config.prefix_cache,
+            )
             server = CompletionServer(
                 engine,
                 model_id=model_id,
@@ -173,6 +177,9 @@ class Operator:
                 port=self.config.completion_api_port,
                 api_token=self.config.completion_api_token or None,
                 embedder=embedder,
+                # the reference's ai-interface contract, served verbatim
+                # (POST /api/v1/analysis/analyze)
+                analysis_backend=tpu_provider,
             )
             await server.start()
             # warmup: one throwaway generation compiles the prefill + decode
@@ -266,10 +273,7 @@ class Operator:
         # never leave explanations on a CLOSED engine while HTTP callers get
         # the new one
         self.providers.register(
-            "tpu-native", TPUNativeProvider(
-                engine, model_id=model_id,
-                register_template_prefixes=self.config.prefix_cache,
-            )
+            "tpu-native", tpu_provider
         )
         self.completion_server = server
         self.engine_warmth = ENGINE_READY

@@ -45,9 +45,17 @@ def main() -> None:
         pin_cpu_if_requested()
 
     from .httpserver import serve_forever
-    from .provider import build_serving_engine
+    from .provider import TPUNativeProvider, build_serving_engine
+
+    from ..utils.config import OperatorConfig
 
     engine, model_id = build_serving_engine()
+    analysis_backend = TPUNativeProvider(
+        engine, model_id=model_id,
+        # same PREFIX_CACHE gate operator mode wires: a disabled cache
+        # must not grow the registry through the analyze route
+        register_template_prefixes=OperatorConfig.from_env().prefix_cache,
+    )
 
     # /v1/embeddings: MiniLM when a checkpoint is mounted, lexical hashing
     # otherwise — the one shared ladder (patterns/semantic.py)
@@ -64,6 +72,7 @@ def main() -> None:
                 port=args.port,
                 api_token=os.environ.get("OPERATOR_TPU_API_TOKEN") or None,
                 embedder=embedder,
+                analysis_backend=analysis_backend,
             )
         )
     except KeyboardInterrupt:

@@ -155,9 +155,16 @@ class CompletionServer:
         max_tokens_cap: int = 2048,
         embedder: Optional[Any] = None,  # .embed(texts)->ndarray, .dim
         embedding_model_id: str = "log-embedder",
+        analysis_backend: Optional[Any] = None,  # .generate(AnalysisRequest)
     ) -> None:
         self.engine = engine
         self.model_id = model_id
+        #: wire parity with the reference's ai-interface contract
+        #: (AIInterfaceRestClient.java:37-39): when a backend is wired,
+        #: POST /api/v1/analysis/analyze serves AnalysisRequest->AIResponse
+        #: verbatim, so tools written against the reference's service point
+        #: here unchanged
+        self.analysis_backend = analysis_backend
         self.host = host
         self.port = port
         self.api_token = api_token
@@ -329,6 +336,8 @@ class CompletionServer:
                     "owned_by": "operator-tpu",
                 })
             return 200, {"object": "list", "data": models}
+        if method == "POST" and path == "/api/v1/analysis/analyze":
+            return await self._analyze(self._parse_json(body))
         if method == "POST" and path == "/v1/embeddings":
             return await self._embeddings(self._parse_json(body))
         if method == "POST" and path == "/v1/completions":
@@ -584,6 +593,31 @@ class CompletionServer:
         }
 
 
+    # -- reference ai-interface contract -------------------------------------
+
+    async def _analyze(self, req: dict) -> dict:
+        """The reference's ai-interface route, byte-compatible: POST an
+        AnalysisRequest (AnalysisResult + AIProviderConfig [+ failure
+        data]), get an AIResponse back (reference
+        AIInterfaceRestClient.java:37-39, AIInterfaceClient.java:45-59).
+        Tools written against the reference's service point here
+        unchanged; the compute is the in-process engine instead of an
+        external LLM API."""
+        if self.analysis_backend is None:
+            raise ApiError(
+                404,
+                "analysis backend not wired (operator mode serves it; "
+                "see CompletionServer(analysis_backend=...))",
+            )
+        from ..schema.analysis import AnalysisRequest
+
+        try:
+            request = AnalysisRequest.parse(req)
+        except Exception as exc:  # noqa: BLE001 - schema violation -> client error
+            raise ApiError(400, f"not an AnalysisRequest: {exc}") from None
+        response = await self.analysis_backend.generate(request)
+        return 200, response.to_dict()
+
     # -- embeddings ----------------------------------------------------------
 
     async def _embeddings(self, req: dict):
@@ -791,11 +825,12 @@ async def serve_forever(
     port: int = 8000,
     api_token: Optional[str] = None,
     embedder: Optional[Any] = None,
+    analysis_backend: Optional[Any] = None,
 ) -> None:
     """Run the completion API until cancelled (SIGINT/SIGTERM via CLI)."""
     server = CompletionServer(
         engine, model_id=model_id, host=host, port=port, api_token=api_token,
-        embedder=embedder,
+        embedder=embedder, analysis_backend=analysis_backend,
     )
     await server.start()
     try:

@@ -120,7 +120,10 @@ def template_preamble(template: str) -> "str | None":
     }
     try:
         template.format(**probe)
-    except (KeyError, IndexError, ValueError):
+    except Exception:  # noqa: BLE001 - ANY render failure (KeyError,
+        # AttributeError from '{x.y}', TypeError from '{x[0]}' on str, ...)
+        # means build_prompt will fall back to DEFAULT_TEMPLATE, and the
+        # caller sites must never be taken down by a malformed CR template
         return None
     return template.split("{", 1)[0]
 

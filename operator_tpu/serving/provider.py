@@ -97,7 +97,7 @@ class TPUNativeProvider:
         from .prompts import template_preamble
 
         preamble = template_preamble(template)
-        if preamble is None:
+        if not preamble:
             # build_prompt will fall back to DEFAULT_TEMPLATE for this
             # broken template; registering its preamble would hold pages
             # and a registry slot for a prefix no prompt ever starts with

@@ -42,10 +42,13 @@ def server_port():
     async def run():
         from operator_tpu.patterns.semantic import HashingEmbedder
 
+        from operator_tpu.serving.provider import TPUNativeProvider
+
         engine = ServingEngine(generator, admission_wait_s=0.005)
         server = CompletionServer(
             engine, model_id="tiny-test", host="127.0.0.1", port=0,
             api_token="sekrit", embedder=HashingEmbedder(dim=64),
+            analysis_backend=TPUNativeProvider(engine, model_id="tiny-test"),
         )
         await server.start()
         started["port"] = server.bound_port
@@ -468,3 +471,62 @@ def test_streaming_oversized_request_maps_to_400_before_headers():
             {"prompt": "x" * 4096, "stream": True}, chat=False, writer=writer))
     assert err.value.status == 400 and "KV pages" in str(err.value)
     assert not writer.chunks  # no 200/SSE bytes hit the socket
+
+
+# --- the reference's ai-interface contract (round 5) -----------------------
+
+
+def _analysis_request_body():
+    """A wire AnalysisRequest built by the REAL pattern engine from a
+    recorded failure log (the exact payload the reference's operator POSTs,
+    AIInterfaceClient.java:45-59)."""
+    import pathlib
+
+    from operator_tpu.patterns.engine import PatternEngine
+    from operator_tpu.schema.analysis import (
+        AIProviderConfig, AnalysisRequest, PodFailureData,
+    )
+
+    fixtures = pathlib.Path(__file__).parent / "fixtures"
+    log_text = sorted(fixtures.glob("*.log"))[0].read_text()[-2000:]
+    failure = PodFailureData(logs=log_text)
+    result = PatternEngine().analyze(failure)
+    return AnalysisRequest(
+        analysis_result=result,
+        provider_config=AIProviderConfig(
+            provider_id="tpu-native", model_id="tiny-test", max_tokens=8,
+            temperature=0.0,
+        ),
+        failure_data=failure,
+    ).to_dict()
+
+
+def test_analyze_route_serves_the_reference_contract(server_port):
+    status, body = _request(
+        server_port, "POST", "/api/v1/analysis/analyze",
+        body=_analysis_request_body(),
+    )
+    assert status == 200, body
+    # AIResponse shape (reference reads .getExplanation())
+    assert body.get("providerId") == "tpu-native"
+    assert body.get("modelId") == "tiny-test"
+    assert body.get("explanation") or body.get("error"), body
+    if body.get("explanation"):
+        # EOS may stop generation early; the cap is what the config set
+        assert 1 <= body.get("completionTokens") <= 8
+
+
+def test_analyze_route_requires_auth(server_port):
+    status, body = _request(
+        server_port, "POST", "/api/v1/analysis/analyze",
+        body=_analysis_request_body(), token=None,
+    )
+    assert status == 401
+
+
+def test_analyze_route_rejects_non_request_body(server_port):
+    status, body = _request(
+        server_port, "POST", "/api/v1/analysis/analyze",
+        body={"analysisResult": "not-an-object"},
+    )
+    assert status == 400, body
