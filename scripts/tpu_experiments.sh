@@ -128,4 +128,7 @@ run bench_8b_chunked 2400 env BENCH_OPEN=0 BENCH_MODEL=llama-3-8b BENCH_QUANT=1 
 # xplane trace of the timed region for the remaining-gap attribution
 run bench_profile 900 env BENCH_OPEN=0 BENCH_PROFILE=$OUT/xplane python bench.py
 run trace_summary 300 python scripts/analyze_xplane.py "$OUT/xplane" 40
+# the 8B v5e AOT memory record (compiler-confirmed HBM budget): needs the
+# axon compile service, which is only reliably up when the tunnel is
+run aot_8b      1200 python scripts/aot_8b_check.py
 echo "series done $(date +%H:%M:%S)" | tee -a "$OUT/series.log"
