@@ -402,6 +402,13 @@ class AdmissionMixin:
             slot_ids[row] = slot_ids[0]
             adapter_idx[row] = adapter_idx[0]
 
+        # fast-path observability: operators verify the prefix cache is
+        # actually taken in production from these two counters (a custom
+        # template that silently stopped matching shows up as plain waves)
+        self.metrics.incr(
+            "prefill_waves_prefix" if prefix_shared else "prefill_waves_plain"
+        )
+
         # guided decoding: stack the automata this wave + active slots need
         wave_specs = [self._guided_spec(p) for p in params_list]
         if any(wave_specs) or self._guided_tables is not None:

@@ -323,3 +323,18 @@ def test_reset_reprimes_all_registered_prefixes(params):
     toks = [generator.tokenizer.encode(prompts[0])]
     assert generator._wave_shared_prefix(toks, [GREEDY]) > 0
     assert _drain(generator, prompts) == _drain(_generator(params), prompts)
+
+
+def test_wave_path_counters(params):
+    """Operators verify the fast path from metrics: prefix-shared waves
+    and plain waves increment distinct counters."""
+    from operator_tpu.utils.timing import MetricsRegistry
+
+    metrics = MetricsRegistry()
+    generator = _generator(params, metrics=metrics)
+    generator.add_shared_prefix(PREFIX)
+    _drain(generator, [PREFIX + "fast path"])
+    _drain(generator, ["something else entirely"])
+    counters = metrics.snapshot()["counters"]
+    assert counters.get("prefill_waves_prefix", 0) >= 1
+    assert counters.get("prefill_waves_plain", 0) >= 1
