@@ -3,7 +3,7 @@
 
 Reads every ``bench_*.log`` (the JSON line bench.py prints), the floor
 and attribution logs, and writes a comparison table — the round's
-evidence in one place (``docs/R4_RESULTS.md`` when run after each series step).  No jax import; safe to run anywhere.
+evidence in one place (``docs/R5_RESULTS.md`` when run after each series step).  No jax import; safe to run anywhere.
 """
 
 from __future__ import annotations
