@@ -29,6 +29,13 @@ from .lora import (
     stack_adapters,
     zero_lora,
 )
-from .train import TrainState, make_optimizer, make_train_step, next_token_loss
+from .train import (
+    TrainState,
+    load_train_state,
+    make_optimizer,
+    make_train_step,
+    next_token_loss,
+    save_train_state,
+)
 
 __all__ = [name for name in dir() if not name.startswith("_")]

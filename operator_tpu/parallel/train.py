@@ -11,6 +11,7 @@ alone (the scaling-book recipe; no hand-written collectives).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Optional
@@ -69,8 +70,32 @@ def make_train_step(
     p_shardings = param_shardings(mesh, config)
     data_sharding = NamedSharding(mesh, batch_spec())
 
+    def constrain_opt(opt_state):
+        """Pin the adam moments to the PARAM placements: left to
+        propagation, XLA may replicate mu/nu — 2x the weight memory on
+        every device, an OOM at 8B scale — and init/step programs may
+        pick different layouts (resharding each step)."""
+        def pin(tree):
+            return jax.tree.map(
+                lambda x, sh: jax.lax.with_sharding_constraint(x, sh),
+                tree, p_shardings,
+            )
+        constrained = []
+        for part in opt_state:
+            if isinstance(part, optax.ScaleByAdamState):
+                part = part._replace(mu=pin(part.mu), nu=pin(part.nu))
+            constrained.append(part)
+        return tuple(constrained)
+
+    @jax.jit
     def init_state(params: Params) -> TrainState:
-        return TrainState(params=params, opt_state=optimizer.init(params),
+        # jitted so the optimizer moments inherit the params' MESH
+        # placement: a plain optimizer.init materialises both full moment
+        # trees on one device — an OOM at 8B scale, and committed
+        # single-device scalars that conflict with mesh-placed leaves on
+        # the next step (seen via checkpoint restore)
+        return TrainState(params=params,
+                          opt_state=constrain_opt(optimizer.init(params)),
                           step=jnp.zeros((), jnp.int32))
 
     @partial(
@@ -84,11 +109,47 @@ def make_train_step(
         )
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
-        # keep the placement stable across steps
+        # keep the placement stable across steps (params AND moments)
         new_params = jax.lax.with_sharding_constraint(new_params, p_shardings)
-        return TrainState(new_params, new_opt, state.step + 1), loss
+        return TrainState(new_params, constrain_opt(new_opt), state.step + 1), loss
 
     return init_state, train_step
+
+
+def save_train_state(state: TrainState, path: str) -> None:
+    """Durable fine-tune checkpoint (params + optimizer state + step) via
+    orbax — the resume story for the training flows, alongside the
+    HF-layout weight save (models/loader.save_params) that serving
+    reloads.  Works for sharded states: orbax records each leaf's
+    sharding and restore re-places onto the same mesh layout."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as checkpointer:
+        # force: the resume story saves to a fixed path every N steps —
+        # the default raises on an existing destination
+        checkpointer.save(os.path.abspath(path), state, force=True)
+        checkpointer.wait_until_finished()
+
+
+def load_train_state(path: str, reference: TrainState) -> TrainState:
+    """Restore a checkpoint saved by :func:`save_train_state`.
+
+    ``reference`` supplies the tree structure, dtypes, and TARGET
+    shardings (e.g. a fresh ``init_state(params)`` on the current mesh) —
+    restore places every leaf straight onto the reference's devices, so
+    resuming on a different mesh factorisation just means passing a
+    reference built on the new mesh."""
+    import orbax.checkpoint as ocp
+
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(
+            jnp.shape(x), jnp.asarray(x).dtype,
+            sharding=getattr(x, "sharding", None),
+        ),
+        reference,
+    )
+    with ocp.StandardCheckpointer() as checkpointer:
+        return checkpointer.restore(os.path.abspath(path), abstract)
 
 
 jax.tree_util.register_pytree_node(
