@@ -534,3 +534,60 @@ def test_guided_chunked_prefill_on_mesh(params):
             results[slot_id] = result
     assert results[slots[0]].text in CHOICES
     assert len(results[slots[1]].token_ids) == 8  # unconstrained neighbour
+
+
+class TestRegexDfaProperty:
+    """Property check: for regexes drawn from the SUPPORTED grammar, the
+    byte DFA agrees with python's `re` fullmatch on arbitrary inputs —
+    the guided decoder's correctness rests on this equivalence."""
+
+    @staticmethod
+    def _dfa_fullmatch(transition, accepting, text: str) -> bool:
+        state = 0
+        for byte in text.encode():
+            state = transition[state, byte] if state >= 0 else -1
+            if state < 0:
+                return False
+        return bool(accepting[state])
+
+    def test_random_patterns_agree_with_re(self):
+        from hypothesis import given, settings, strategies as st
+
+        from operator_tpu.serving.regex_dfa import _compile_byte_dfa
+
+        literal = st.text(alphabet="abcXY01", min_size=1, max_size=3)
+        klass = st.sampled_from(
+            [r"[abc]", r"[a-f]", r"[^ab]", r"\d", r"\w", r"."]
+        )
+        atom = st.one_of(literal, klass)
+        repeated = st.tuples(
+            atom, st.sampled_from(["", "?", "*", "+", "{1,2}", "{2}"])
+        ).map(lambda t: (f"(?:{t[0]})" if len(t[0]) > 1 else t[0]) + t[1])
+        seq = st.lists(repeated, min_size=1, max_size=4).map("".join)
+        pattern_s = st.lists(seq, min_size=1, max_size=3).map("|".join)
+        subject = st.text(
+            alphabet="abcdefXY01z*. ", min_size=0, max_size=8
+        )
+
+        @settings(max_examples=150, deadline=None)
+        @given(pattern=pattern_s, samples=st.lists(subject, max_size=4))
+        def check(pattern, samples):
+            import re as _re
+
+            try:
+                compiled = _re.compile(pattern)
+            except _re.error:
+                return
+            try:
+                transition, accepting = _compile_byte_dfa(pattern, 1 << 14)
+            except ValueError:
+                return  # over the state budget / unsupported corner
+            # the DFA supports a non-capturing subset; patterns that
+            # compile must then AGREE on every subject, including ones
+            # derived from the pattern's own literals
+            for sample in samples + [pattern.replace("|", "")[:6]]:
+                expect = bool(compiled.fullmatch(sample))
+                got = self._dfa_fullmatch(transition, accepting, sample)
+                assert got == expect, (pattern, sample, got, expect)
+
+        check()
