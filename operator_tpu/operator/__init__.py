@@ -18,6 +18,8 @@ from .kubeapi import (
 from .patternsync import GitSyncService, PatternLibraryReconciler, SyncOutcome
 from .pipeline import AnalysisPipeline
 from .providers import (
+    BreakerBoard,
+    CircuitBreaker,
     OpenAICompatProvider,
     ProviderError,
     ProviderRegistry,

@@ -244,6 +244,12 @@ class FakeKubeApi(KubeApi):
         self._history: dict[str, list[tuple[int, WatchEvent]]] = {}
         self._trimmed_through: dict[str, int] = {}
         self.error_hooks: list[ErrorHook] = []
+        #: opt-in chaos seam (utils/faultinject.py FaultPlan): consulted on
+        #: every API op ("kube.<op>"), at watch-stream open
+        #: ("kube.watch_open.<kind>") and per delivered watch event
+        #: ("kube.watch.<kind>") — declarative 409 storms, 410 relists and
+        #: disconnect storms that replay deterministically
+        self.fault_plan = None
 
     # --- error injection --------------------------------------------------
     def inject_errors(self, op: str, error_factory: Callable[[], Exception], times: int = 1) -> None:
@@ -267,6 +273,8 @@ class FakeKubeApi(KubeApi):
             exc = hook(op, kind, name)
             if exc is not None:
                 raise exc
+        if self.fault_plan is not None:
+            self.fault_plan.apply(f"kube.{op}", kind=kind, name=name)
 
     # --- store helpers ----------------------------------------------------
     def _bucket(self, kind: str) -> dict[tuple[str, str], dict]:
@@ -430,6 +438,13 @@ class FakeKubeApi(KubeApi):
         namespace: Optional[str] = None,
         resource_version: Optional[str] = None,
     ) -> AsyncIterator[WatchEvent]:
+        if self.fault_plan is not None:
+            # stream-open faults: inject a 410 on a resume attempt
+            # (WatchExpired forces the consumer's relist path) or refuse the
+            # connection (WatchClosed) before any replay happens
+            self.fault_plan.apply(
+                f"kube.watch_open.{kind}", resource_version=resource_version
+            )
         replayed: list[WatchEvent] = []
         if resource_version is not None:
             since = int(resource_version)
@@ -454,11 +469,18 @@ class FakeKubeApi(KubeApi):
         self._watches.append(registration)
         try:
             for event in replayed:
+                if self.fault_plan is not None:
+                    # per-event faults ("drop the stream after N events"):
+                    # WatchClosed/WatchExpired here reaches the consumer
+                    # exactly as a server-side stream death would
+                    self.fault_plan.apply(f"kube.watch.{kind}", event=event.type)
                 yield event
             while True:
                 event = await registration.queue.get()
                 if isinstance(event, Exception):
                     raise WatchClosed(str(event)) from event
+                if self.fault_plan is not None:
+                    self.fault_plan.apply(f"kube.watch.{kind}", event=event.type)
                 yield event
         finally:
             if registration in self._watches:

@@ -66,6 +66,9 @@ class SyncOutcome:
 class GitSyncService:
     def __init__(self, config: Optional[OperatorConfig] = None) -> None:
         self.config = config or OperatorConfig()
+        #: opt-in chaos seam (utils/faultinject.py): consulted per git verb
+        #: under "git.<verb>" — e.g. fail a clone twice then let it succeed
+        self.fault_plan = None
 
     # ------------------------------------------------------------------
     async def _git(
@@ -96,6 +99,11 @@ class GitSyncService:
         arg_list = list(args)
         verb_args = arg_list[2:] if arg_list[:1] == ["-C"] else arg_list
         verb = next((a for a in verb_args if not a.startswith("-")), "command")
+        if self.fault_plan is not None:
+            # chaos seam: injected GitSyncError/OSError surfaces exactly as
+            # a real subprocess failure would (SyncOutcome.error populated,
+            # per-repo status entry "Failed")
+            self.fault_plan.apply(f"git.{verb}", cwd=cwd)
         try:
             proc = await asyncio.create_subprocess_exec(
                 self.config.git_binary,

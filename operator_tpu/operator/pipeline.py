@@ -15,19 +15,21 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 from ..patterns.engine import PatternEngine
 from ..schema.analysis import AIResponse, AnalysisRequest, AnalysisResult, PodFailureData
-from ..schema.crds import AIProvider, Podmortem
+from ..schema.crds import AIProvider, Podmortem, parse_refresh_interval
 from ..schema.kube import Event as KubeEvent
 from ..schema.kube import Pod
 from ..schema.meta import now_iso
 from ..utils.config import OperatorConfig
+from ..utils.deadline import Deadline
 from ..utils.timing import METRICS, MetricsRegistry
 from .events import EventService
 from .kubeapi import ApiError, KubeApi, NotFoundError
 from .providers import (
+    BreakerBoard,
     ProviderError,
     ProviderRegistry,
     ResponseCache,
@@ -88,6 +90,7 @@ class AnalysisPipeline:
         storage: Optional[AnalysisStorageService] = None,
         providers: Optional[ProviderRegistry] = None,
         metrics: Optional[MetricsRegistry] = None,
+        clock: Optional[Callable[[], float]] = None,
     ) -> None:
         self.api = api
         self.engine = engine
@@ -98,6 +101,28 @@ class AnalysisPipeline:
         self.metrics = metrics or METRICS
         self.cache = ResponseCache()
         self.dedupe = FailureDedupe()
+        # deadline budgets + per-provider circuit breakers share one
+        # injectable clock so chaos tests replay deterministically
+        self._clock = clock or time.monotonic
+        self.breakers = BreakerBoard(
+            self.config.breaker_failure_threshold,
+            self.config.breaker_reset_s,
+            clock=self._clock,
+        )
+
+    def _deadline_for(self, podmortem: Podmortem) -> Deadline:
+        """One CR's analysis envelope: spec.analysisDeadline when set, else
+        the operator default (the reference's 180 s LLM budget).  PER CR —
+        a fan-out group's first analysis legitimately spending its whole
+        envelope must not starve the remaining CRs down to zero-budget
+        no-result runs."""
+        total_s = self.config.analysis_deadline_s
+        if podmortem.spec.analysis_deadline:
+            total_s = float(parse_refresh_interval(
+                podmortem.spec.analysis_deadline,
+                default_seconds=int(self.config.analysis_deadline_s),
+            ))
+        return Deadline.start(total_s, clock=self._clock)
 
     # ------------------------------------------------------------------
     async def process_failure_group(
@@ -123,11 +148,18 @@ class AnalysisPipeline:
             self.dedupe.mark_done(key)
             self.metrics.incr("dedupe_durable_hits")
             return []
+        # each CR's deadline budget is BORN when its analysis starts under
+        # this claim: collection, parse, AI — one envelope per CR (the
+        # fan-out is sequential, so a shared group envelope would hand
+        # later CRs whatever the first one left, possibly nothing)
         try:
             results = []
             for podmortem in podmortems:
                 results.append(
-                    await self.process_pod_failure(pod, podmortem, failure_time=failure_time)
+                    await self.process_pod_failure(
+                        pod, podmortem, failure_time=failure_time,
+                        deadline=self._deadline_for(podmortem),
+                    )
                 )
         except BaseException:
             self.dedupe.release(key)
@@ -145,40 +177,90 @@ class AnalysisPipeline:
         podmortem: Podmortem,
         *,
         failure_time: Optional[str] = None,
+        deadline: Optional[Deadline] = None,
     ) -> Optional[AnalysisResult]:
         """The hot path (reference call stack §3.2).  Returns the analysis
-        result, or None when collection failed outright."""
+        result, or None when collection failed outright.  Every stage spends
+        the one ``deadline`` envelope (born at claim; a fresh default is
+        created for direct callers)."""
         started = time.perf_counter()
+        if deadline is None:
+            deadline = self._deadline_for(podmortem)
         self.metrics.incr("failures_detected")
         await self.events.emit_failure_detected(pod, podmortem)
 
-        # -- collect -----------------------------------------------------
+        # -- collect (gets a SLICE of the budget) --------------------------
+        collect_s = deadline.slice(
+            self.config.collect_budget_fraction, floor_s=1.0
+        )
         try:
             with self.metrics.timed("collect"):
-                failure = await self.collect_failure_data(pod)
+                failure = await asyncio.wait_for(
+                    self.collect_failure_data(pod), timeout=collect_s
+                )
+        except asyncio.TimeoutError:
+            log.error("log collection for %s exceeded its %.1fs budget slice",
+                      pod.qualified_name(), collect_s)
+            await self.events.emit_analysis_error(
+                pod, podmortem,
+                f"log collection exceeded its {collect_s:.1f}s budget slice",
+            )
+            self.metrics.incr("collect_timeouts")
+            return None
         except ApiError as exc:
             log.error("failed collecting failure data for %s: %s", pod.qualified_name(), exc)
             await self.events.emit_analysis_error(pod, podmortem, f"log collection failed: {exc}")
             self.metrics.incr("collect_errors")
             return None
 
-        # -- parse (CPU/TPU pattern match) --------------------------------
+        # -- parse (CPU/TPU pattern match; capped by the remainder) --------
+        parse_s = min(self.config.parse_timeout_s, max(0.1, deadline.remaining()))
         try:
             with self.metrics.timed("parse"):
                 result = await asyncio.wait_for(
                     asyncio.to_thread(self.engine.analyze, failure),
-                    timeout=self.config.parse_timeout_s,
+                    timeout=parse_s,
                 )
+        except asyncio.TimeoutError:
+            # attribute the timeout honestly: a deadline-bound cap means
+            # the BUDGET killed the parse, not the pattern engine
+            budget_bound = parse_s < self.config.parse_timeout_s
+            message = (
+                f"pattern analysis exceeded the remaining deadline budget "
+                f"({parse_s:.1f}s)"
+                if budget_bound
+                else f"pattern analysis timed out after {parse_s:.0f}s"
+            )
+            log.error("%s (%s)", message, pod.qualified_name())
+            await self.events.emit_analysis_error(pod, podmortem, message)
+            self.metrics.incr("deadline_exceeded" if budget_bound else "parse_errors")
+            return None
         except Exception as exc:  # noqa: BLE001 - degrade, never crash the watch
             log.exception("pattern analysis failed for %s", pod.qualified_name())
             await self.events.emit_analysis_error(pod, podmortem, f"pattern analysis failed: {exc}")
             self.metrics.incr("parse_errors")
             return None
 
-        # -- explain ------------------------------------------------------
+        # -- explain (the AI leg gets whatever budget is left) -------------
         ai_response: Optional[AIResponse] = None
         if podmortem.spec.ai_analysis_enabled and podmortem.spec.ai_provider_ref is not None:
-            ai_response = await self._generate_explanation(pod, podmortem, result, failure)
+            if deadline.expired:
+                # the budget died before the AI leg even started: degrade
+                # to pattern-only NOW instead of dispatching a doomed call
+                message = (
+                    f"analysis deadline ({deadline.total_s:.0f}s) exhausted "
+                    "before AI generation; storing pattern-only result"
+                )
+                log.warning("%s (%s)", message, pod.qualified_name())
+                await self.events.emit_analysis_error(pod, podmortem, message)
+                ai_response = AIResponse(
+                    error=message, deadline_outcome="deadline-exceeded"
+                )
+            else:
+                ai_response = await self._generate_explanation(
+                    pod, podmortem, result, failure, deadline=deadline
+                )
+            self._record_deadline_outcome(ai_response)
         elif podmortem.spec.ai_analysis_enabled:
             log.info("podmortem %s has no aiProviderRef; storing pattern-only result",
                      podmortem.qualified_name())
@@ -241,12 +323,31 @@ class AnalysisPipeline:
         return PodFailureData(pod=pod, logs=logs, events=events, collection_time=now_iso())
 
     # ------------------------------------------------------------------
+    def _record_deadline_outcome(self, ai_response: Optional[AIResponse]) -> None:
+        """One place turns the AI leg's budget outcome into counters (the
+        Prometheus surface: podmortem_deadline_*_total).  Backends that
+        produced text without reporting an outcome count as completed."""
+        if ai_response is None:
+            return
+        if ai_response.deadline_outcome is None and ai_response.explanation:
+            ai_response.deadline_outcome = "completed"
+        outcome = ai_response.deadline_outcome
+        if outcome == "completed":
+            self.metrics.incr("deadline_completed")
+        elif outcome == "truncated":
+            self.metrics.incr("deadline_truncated")
+        elif outcome == "deadline-exceeded":
+            self.metrics.incr("deadline_exceeded")
+
+    # ------------------------------------------------------------------
     async def _generate_explanation(
         self,
         pod: Pod,
         podmortem: Podmortem,
         result: AnalysisResult,
         failure: PodFailureData,
+        *,
+        deadline: Optional[Deadline] = None,
     ) -> AIResponse:
         ref = podmortem.spec.ai_provider_ref
         namespace = ref.namespace or podmortem.metadata.namespace or "default"
@@ -264,8 +365,10 @@ class AnalysisPipeline:
 
         provider = AIProvider.parse(provider_dict)
         provider_config = await resolve_provider_config(self.api, provider)
+        remaining = deadline.remaining() if deadline is not None else None
         request = AnalysisRequest(
-            analysis_result=result, provider_config=provider_config, failure_data=failure
+            analysis_result=result, provider_config=provider_config,
+            failure_data=failure, deadline_s=remaining,
         )
 
         cache_key = None
@@ -277,32 +380,79 @@ class AnalysisPipeline:
                 cached_copy = AIResponse(**{**cached.__dict__, "cached": True})
                 return cached_copy
 
+        # circuit breaker: a dead backend must stop burning the deadline
+        # budget — skip the call outright while its breaker is open and
+        # fall through the existing degradation ladder (pattern-only store).
+        # Keyed by providerId AND apiUrl: two CRs sharing a providerId but
+        # pointing at different HTTP endpoints are different backends, and
+        # one dead endpoint must not blackhole the healthy one.
+        breaker_key = provider_config.provider_id or "template"
+        if provider_config.api_url:
+            breaker_key = f"{breaker_key}@{provider_config.api_url}"
+        breaker = self.breakers.for_provider(breaker_key)
+        if not breaker.allow():
+            message = f"circuit open for provider {breaker_key}: AI call skipped"
+            log.warning("%s (%s)", message, pod.qualified_name())
+            await self.events.emit_analysis_error(pod, podmortem, message)
+            self.metrics.incr("circuit_open_skips")
+            return AIResponse(error=message, provider_id=provider_config.provider_id)
+
         try:
             backend = self.providers.resolve(provider_config.provider_id)
         except ProviderError as exc:
             await self.events.emit_analysis_error(pod, podmortem, str(exc))
             self.metrics.incr("provider_errors")
+            if breaker.record_failure():
+                self.metrics.incr("circuit_opened")
             return AIResponse(error=str(exc))
 
+        # the AI leg gets the REMAINDER of the envelope, never more than
+        # the flat reference budget (ai_timeout_s, application.properties)
+        timeout_s = self.config.ai_timeout_s
+        if remaining is not None:
+            timeout_s = min(timeout_s, remaining)
         try:
             with self.metrics.timed("ai_generate"):
                 response = await asyncio.wait_for(
-                    backend.generate(request), timeout=self.config.ai_timeout_s
+                    backend.generate(request), timeout=timeout_s
                 )
         except asyncio.TimeoutError:
-            message = f"AI generation timed out after {self.config.ai_timeout_s:.0f}s"
+            budget_bound = remaining is not None and remaining < self.config.ai_timeout_s
+            message = (
+                f"AI generation exceeded the remaining deadline budget "
+                f"({timeout_s:.1f}s)"
+                if budget_bound
+                else f"AI generation timed out after {timeout_s:.0f}s"
+            )
             await self.events.emit_analysis_error(pod, podmortem, message)
             self.metrics.incr("ai_timeouts")
-            return AIResponse(error=message, provider_id=provider_config.provider_id)
+            # budget-bound timeouts are OUR deadline pressure, not backend
+            # health: counting them would trip the breaker on a healthy
+            # backend whenever upstream stages run long
+            if not budget_bound and breaker.record_failure():
+                self.metrics.incr("circuit_opened")
+            return AIResponse(
+                error=message, provider_id=provider_config.provider_id,
+                deadline_outcome="deadline-exceeded" if budget_bound else None,
+            )
         except Exception as exc:  # noqa: BLE001 - degrade to pattern-only
             log.exception("AI generation failed for %s", pod.qualified_name())
             await self.events.emit_analysis_error(pod, podmortem, f"AI generation failed: {exc}")
             self.metrics.incr("ai_errors")
+            if breaker.record_failure():
+                self.metrics.incr("circuit_opened")
             return AIResponse(error=str(exc), provider_id=provider_config.provider_id)
 
         if response.error:
             await self.events.emit_analysis_error(pod, podmortem, response.error)
             self.metrics.incr("ai_errors")
-        elif cache_key is not None:
-            self.cache.put(cache_key, response)
+            # backend-attributed failures only: a deadline-exceeded outcome
+            # means the BUDGET killed the leg, not the provider
+            if response.deadline_outcome != "deadline-exceeded" and \
+                    breaker.record_failure():
+                self.metrics.incr("circuit_opened")
+        else:
+            breaker.record_success()
+            if cache_key is not None:
+                self.cache.put(cache_key, response)
         return response

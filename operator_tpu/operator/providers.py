@@ -25,6 +25,7 @@ import asyncio
 import hashlib
 import json
 import logging
+import time
 import urllib.error
 import urllib.request
 from collections import OrderedDict
@@ -33,6 +34,7 @@ from typing import Callable, Optional, Protocol
 from ..schema.analysis import AIProviderConfig, AIResponse, AnalysisRequest
 from ..schema.crds import AIProvider
 from ..schema.kube import Secret
+from ..utils.deadline import Deadline
 from .kubeapi import ApiError, KubeApi, NotFoundError
 
 log = logging.getLogger(__name__)
@@ -44,6 +46,114 @@ class AIProviderBackend(Protocol):
 
 class ProviderError(Exception):
     pass
+
+
+# --------------------------------------------------------------------------
+# per-provider circuit breaker
+# --------------------------------------------------------------------------
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker for one AI backend.
+
+    States: ``closed`` (calls flow) → after ``failure_threshold``
+    consecutive failures ``open`` (calls skipped: a dead backend must stop
+    burning the deadline budget — the pipeline falls through the existing
+    degradation ladder and stores pattern-only results) → after
+    ``reset_s`` ``half-open`` (exactly ONE probe flows) → probe success
+    closes, probe failure re-opens for another window.
+
+    The clock is injectable so chaos tests drive the state machine
+    deterministically (tests/test_chaos.py).
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half-open"
+
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        reset_s: float = 30.0,
+        clock: Optional[Callable[[], float]] = None,
+    ) -> None:
+        self.failure_threshold = max(1, failure_threshold)
+        self.reset_s = reset_s
+        self._clock = clock or time.monotonic
+        self.state = self.CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_at = 0.0
+
+    def allow(self) -> bool:
+        """May a call be attempted now?  Transitions open → half-open when
+        the reset window elapsed (that caller IS the probe; concurrent
+        callers in half-open are refused until the probe resolves).  A
+        probe whose caller died without ever reporting (cancelled task,
+        operator shutdown mid-call) must not wedge the breaker: after
+        another full window in half-open a fresh probe is admitted."""
+        now = self._clock()
+        if self.state == self.OPEN:
+            if now - self._opened_at >= self.reset_s:
+                self.state = self.HALF_OPEN
+                self._probe_at = now
+                return True
+            return False
+        if self.state == self.HALF_OPEN:
+            if now - self._probe_at >= self.reset_s:
+                self._probe_at = now
+                return True
+            return False
+        return True
+
+    def record_success(self) -> None:
+        self._consecutive_failures = 0
+        self.state = self.CLOSED
+
+    def record_failure(self) -> bool:
+        """Returns True when THIS failure opened (or re-opened) the
+        breaker — the caller's cue to count/emit the trip once."""
+        if self.state == self.HALF_OPEN:
+            self.state = self.OPEN
+            self._opened_at = self._clock()
+            return True
+        self._consecutive_failures += 1
+        if (
+            self.state == self.CLOSED
+            and self._consecutive_failures >= self.failure_threshold
+        ):
+            self.state = self.OPEN
+            self._opened_at = self._clock()
+            return True
+        return False
+
+
+class BreakerBoard:
+    """One CircuitBreaker per providerId, created on first use."""
+
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        reset_s: float = 30.0,
+        clock: Optional[Callable[[], float]] = None,
+    ) -> None:
+        self.failure_threshold = failure_threshold
+        self.reset_s = reset_s
+        self._clock = clock
+        self._breakers: dict[str, CircuitBreaker] = {}
+
+    def for_provider(self, provider_id: Optional[str]) -> CircuitBreaker:
+        pid = provider_id or "template"
+        breaker = self._breakers.get(pid)
+        if breaker is None:
+            breaker = CircuitBreaker(
+                self.failure_threshold, self.reset_s, clock=self._clock
+            )
+            self._breakers[pid] = breaker
+        return breaker
+
+    def states(self) -> dict[str, str]:
+        return {pid: b.state for pid, b in self._breakers.items()}
 
 
 # --------------------------------------------------------------------------
@@ -226,6 +336,9 @@ class OpenAICompatProvider:
     def __init__(self, opener: Optional[Callable] = None) -> None:
         # injectable for tests; defaults to urllib
         self._opener = opener or urllib.request.urlopen
+        #: opt-in chaos seam (utils/faultinject.py): consulted before each
+        #: outbound attempt under site "http.provider"
+        self.fault_plan = None
 
     async def generate(self, request: AnalysisRequest) -> AIResponse:
         config = request.provider_config or AIProviderConfig()
@@ -253,11 +366,11 @@ class OpenAICompatProvider:
         if config.auth_token:
             headers["Authorization"] = f"Bearer {config.auth_token}"
 
-        def call() -> AIResponse:
+        def call(timeout_s: float) -> AIResponse:
             req = urllib.request.Request(
                 url, data=json.dumps(body).encode(), headers=headers, method="POST"
             )
-            with self._opener(req, timeout=config.timeout_seconds) as resp:
+            with self._opener(req, timeout=timeout_s) as resp:
                 payload = json.loads(resp.read().decode())
             text = payload["choices"][0]["message"]["content"]
             usage = payload.get("usage", {})
@@ -267,12 +380,36 @@ class OpenAICompatProvider:
                 model_id=config.model_id,
                 prompt_tokens=usage.get("prompt_tokens"),
                 completion_tokens=usage.get("completion_tokens"),
+                deadline_outcome=(
+                    "completed" if request.deadline_s is not None else None
+                ),
             )
 
+        # deadline budget: the CR's per-attempt read timeout never reaches
+        # past the residue, and the retry loop stops once it is spent —
+        # retrying a dead backend must not eat the whole analysis envelope
+        budget = (
+            Deadline.start(request.deadline_s)
+            if request.deadline_s is not None
+            else None
+        )
         last_error: Optional[str] = None
         for attempt in range(max(1, config.max_retries)):
+            timeout_s = float(config.timeout_seconds)
+            if budget is not None:
+                residue = budget.remaining()
+                if residue <= 0.0:
+                    return AIResponse(
+                        error=f"deadline exceeded after {attempt} attempt(s): "
+                              f"{last_error or 'no attempt completed in budget'}",
+                        provider_id=config.provider_id, model_id=config.model_id,
+                        deadline_outcome="deadline-exceeded",
+                    )
+                timeout_s = min(timeout_s, residue)
             try:
-                return await asyncio.to_thread(call)
+                if self.fault_plan is not None:
+                    self.fault_plan.apply("http.provider", attempt=attempt)
+                return await asyncio.to_thread(call, timeout_s)
             except (urllib.error.URLError, OSError, KeyError, ValueError) as exc:
                 last_error = str(exc)
                 log.warning("provider %s attempt %d failed: %s",

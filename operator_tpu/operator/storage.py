@@ -136,6 +136,11 @@ class AnalysisStorageService:
             analysis_status = "Failed"
         else:
             analysis_status = "PatternOnly"
+        deadline_outcome = ai_response.deadline_outcome if ai_response else None
+        if deadline_outcome == "deadline-exceeded":
+            # the budget — not the backend — killed the AI leg; operators
+            # alert on this string (and podmortem_deadline_exceeded_total)
+            analysis_status = "deadline-exceeded"
         entry = PodFailureStatus(
             pod_name=pod.metadata.name,
             pod_namespace=pod.metadata.namespace,
@@ -143,6 +148,7 @@ class AnalysisStorageService:
             analysis_status=analysis_status,
             explanation=explanation,
             severity=result.summary.highest_severity,
+            deadline_outcome=deadline_outcome,
         )
 
         async def attempt() -> bool:

@@ -206,6 +206,11 @@ class AnalysisRequest:
     analysis_result: Optional[AnalysisResult] = None
     provider_config: Optional[AIProviderConfig] = None
     failure_data: Optional[PodFailureData] = None
+    #: residual deadline budget (seconds) at dispatch time
+    #: (utils/deadline.py): backends must finish inside it — the tpu-native
+    #: engine clamps max_tokens to the roofline fit, the HTTP provider
+    #: clamps its read timeout.  None = no budget (legacy callers).
+    deadline_s: Optional[float] = None
 
     def to_dict(self) -> dict[str, Any]:
         return to_dict(self)
@@ -227,6 +232,10 @@ class AIResponse:
     completion_tokens: Optional[int] = None
     cached: bool = False
     error: Optional[str] = None
+    #: deadline-budget outcome: "completed" | "truncated" (output clamped
+    #: to fit the residual budget) | "deadline-exceeded" (no AI text;
+    #: pipeline degrades to pattern-only).  None = budget not involved.
+    deadline_outcome: Optional[str] = None
 
     def to_dict(self) -> dict[str, Any]:
         return to_dict(self)

@@ -61,6 +61,10 @@ _POD_FAILURE_STATUS = _obj(
         "analysisStatus": _STR,
         "explanation": _STR,
         "severity": _STR,
+        "deadlineOutcome": {
+            "type": "string",
+            "enum": ["completed", "truncated", "deadline-exceeded"],
+        },
     }
 )
 
@@ -72,6 +76,13 @@ def podmortem_crd() -> dict[str, Any]:
             "podSelector": _LABEL_SELECTOR,
             "aiProviderRef": _obj({"name": _STR, "namespace": _STR}),
             "aiAnalysisEnabled": {"type": "boolean", "default": True},
+            # end-to-end analysis budget ("90s"/"2m"/"1h30m", or bare
+            # seconds); unset = the operator's 180 s default (the
+            # reference's LLM envelope).  Every compound term requires a
+            # unit — exactly the grammar parse_refresh_interval accepts,
+            # so a value the apiserver admits can never silently fall
+            # back to the default
+            "analysisDeadline": {"type": "string", "pattern": r"^\d+$|^(\s*\d+\s*[smhd])+\s*$"},
         }
     )
     status_schema = _obj(

@@ -43,6 +43,12 @@ class PodmortemSpec:
     pod_selector: LabelSelector = field(default_factory=LabelSelector)
     ai_provider_ref: Optional[AIProviderRef] = None
     ai_analysis_enabled: bool = True  # default true (podmortem-crd.yaml:50-53)
+    #: end-to-end budget for one failure's analysis ("90s"/"2m"/"1h30m",
+    #: parse_refresh_interval grammar); None = the operator default, which
+    #: mirrors the reference's 180 s external-LLM envelope
+    #: (application.properties:8-9).  Enforced at every hop: collection
+    #: slice, parse cap, AI remainder, engine admission clamp.
+    analysis_deadline: Optional[str] = None
 
 
 @dataclass
@@ -53,9 +59,13 @@ class PodFailureStatus:
     pod_name: Optional[str] = None
     pod_namespace: Optional[str] = None
     failure_time: Optional[str] = None
-    analysis_status: Optional[str] = None  # Analyzed|PatternOnly|Failed
+    analysis_status: Optional[str] = None  # Analyzed|PatternOnly|Failed|deadline-exceeded
     explanation: Optional[str] = None
     severity: Optional[str] = None
+    #: deadline-budget outcome for the AI leg (utils/deadline.py):
+    #: completed | truncated (max_tokens clamped to fit the residual
+    #: budget) | deadline-exceeded (degraded to pattern-only)
+    deadline_outcome: Optional[str] = None
 
 
 @dataclass

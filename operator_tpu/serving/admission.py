@@ -13,6 +13,7 @@ Mixed into :class:`serving.engine.BatchedGenerator`.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import time
 from typing import Sequence
@@ -27,6 +28,76 @@ log = logging.getLogger(__name__)
 
 class AdmissionMixin:
     """Wave formation + the warmup grid (see module doc)."""
+
+    # ------------------------------------------------------------------
+    # deadline budget (utils/deadline.py): admission is the enforcement
+    # point for the decode leg — the one stage whose cost is predictable
+    # up front (max_tokens x per-token step time)
+    # ------------------------------------------------------------------
+
+    def decode_token_estimate_s(self) -> float:
+        """Expected seconds per decoded token: the MEASURED p50 of the
+        decode_step stage once any block has run, else the constructor's
+        roofline estimate (``roofline_token_s``).  0.0 = unknown — the
+        policy then only rejects already-expired requests (it will not
+        clamp on a guess it doesn't have)."""
+        stats = self.metrics.stage("decode_step")
+        if stats.count:
+            return stats.p50_ms / 1e3
+        return self.roofline_token_s or 0.0
+
+    def deadline_policy(
+        self, params: SamplingParams, *, now: "float | None" = None
+    ) -> "tuple[SamplingParams, str]":
+        """(possibly clamped params, outcome) for one request's budget.
+
+        Outcomes: ``"ok"`` (fits, untouched), ``"truncated"``
+        (``max_tokens`` clamped to the roofline fit, ``deadline_clamped``
+        set so the finish reason reads "deadline"), ``"rejected"`` (the
+        residue cannot fit even one token).  Requests without a deadline
+        always pass untouched."""
+        if params.deadline is None:
+            return params, "ok"
+        now = self._clock() if now is None else now
+        remaining = params.deadline - now
+        if remaining <= 0.0:
+            return params, "rejected"
+        per_token = self.decode_token_estimate_s()
+        if per_token <= 0.0:
+            return params, "ok"
+        fit = int(remaining / per_token)
+        if fit < 1:
+            return params, "rejected"
+        if fit < params.max_tokens:
+            return (
+                dataclasses.replace(
+                    params, max_tokens=fit, deadline_clamped=True
+                ),
+                "truncated",
+            )
+        return params, "ok"
+
+    def _deadline_clamp_wave(
+        self, params_list: "Sequence[SamplingParams]"
+    ) -> list[SamplingParams]:
+        """Apply the deadline policy to a whole admission wave.  Runs at
+        ADMISSION time (after any queue wait eroded the budget), so the
+        clamp reflects the true residue.  A request that expired between
+        the serve loop's expiry sweep and this call gets the minimal
+        one-token clamp instead of failing the co-batched wave — its
+        result still carries finish_reason "deadline"."""
+        out = []
+        for sampling in params_list:
+            clamped, outcome = self.deadline_policy(sampling)
+            if outcome == "rejected":
+                clamped = dataclasses.replace(
+                    sampling, max_tokens=1, deadline_clamped=True
+                )
+                outcome = "truncated"
+            if outcome == "truncated":
+                self.metrics.incr("admission_deadline_truncated")
+            out.append(clamped)
+        return out
 
     def _program_count(self) -> int:
         """Compiled-program cache population (prefill variants + chunked +
@@ -274,6 +345,11 @@ class AdmissionMixin:
         if not prompts:
             return []
         started = time.perf_counter()
+
+        if any(p.deadline is not None for p in params_list):
+            # clamp BEFORE token budgeting: max_tokens decides both the
+            # truncation budget and the page grant below
+            params_list = self._deadline_clamp_wave(params_list)
 
         token_lists = []
         for prompt, sampling in zip(prompts, params_list):

@@ -63,6 +63,7 @@ from .programs import ProgramBuilderMixin
 # re-exported types: the public import surface predates the round-5 module
 # split (every consumer does `from operator_tpu.serving.engine import ...`)
 from .types import (  # noqa: F401
+    DeadlineExceeded,
     GenerationResult,
     OversizedRequest,
     PageAllocator,
@@ -103,6 +104,7 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
         lora_adapters: Optional[dict[str, Any]] = None,
         lora_alpha: float = 16.0,
         prefill_chunk: Optional[int] = None,
+        roofline_token_s: Optional[float] = None,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -114,6 +116,14 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
         self.max_slots = max_slots
         self.max_seq = min(max_seq or config.max_seq_len, config.max_seq_len)
         self.metrics = metrics or METRICS
+        # deadline budgets (admission.deadline_policy): per-token decode
+        # estimate before any block has been measured; the clock is an
+        # attribute so chaos tests can inject a fake one
+        self.roofline_token_s = roofline_token_s
+        self._clock = time.monotonic
+        #: opt-in chaos seam (utils/faultinject.py): consulted per step()
+        #: round — stalls and simulated device errors for recovery tests
+        self.fault_plan = None
         cache_dtype = cache_dtype or jnp.bfloat16
         self.cache_dtype = cache_dtype
         # decode in blocks of K steps per host round-trip (lax.scan): one
@@ -989,6 +999,12 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
         """
         if self.num_active == 0 and not self._inflight_blocks:
             return []
+        if self.fault_plan is not None:
+            # chaos seam: a sleep action stalls this step (we run on the
+            # decode worker, never the event loop); a raise action
+            # simulates a device/tunnel error mid-step, driving the
+            # ServingEngine recovery path (_try_recover -> reset)
+            self.fault_plan.apply("engine.step", active=self.num_active)
         if self._prefill_job is not None:
             # one chunk per round: in-flight decodes stall for at most one
             # chunk's wall time before their next block dispatches
@@ -1141,6 +1157,10 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
         eos = self.tokenizer.eos_id
         ids = [t for t in slot.generated if t != eos]
         text = self.tokenizer.decode(ids)
+        if reason == "length" and slot.params.deadline_clamped:
+            # the length cap was the deadline budget's roofline clamp, not
+            # the caller's max_tokens — surface the difference
+            reason = "deadline"
         result = GenerationResult(
             text=text,
             token_ids=ids,
@@ -1429,6 +1449,18 @@ class ServingEngine:
         if params is not None and params.guided_choice is not None \
                 and params.guided_regex is not None:
             raise ValueError("guided_choice and guided_regex are mutually exclusive")
+        if params is not None and params.deadline is not None:
+            # fail-fast at submit: a budget that cannot fit ONE decoded
+            # token must not consume a queue slot, a prefill, or KV pages.
+            # Truncation is NOT applied here — admission re-runs the policy
+            # with post-queue-wait residue and owns the clamp.
+            _, outcome = self.generator.deadline_policy(params)
+            if outcome == "rejected":
+                self.generator.metrics.incr("admission_deadline_rejected")
+                raise DeadlineExceeded(
+                    "deadline budget cannot fit any decoded output "
+                    f"(remaining {max(0.0, params.deadline - self.generator._clock()):.3f}s)"
+                )
         guided_spec = self.generator._guided_spec(params)
         if guided_spec is not None:
             # builds+caches the automaton; raises ValueError here (to THIS
@@ -1492,13 +1524,26 @@ class ServingEngine:
             if batch:
                 # drop requests whose callers vanished while QUEUED — no
                 # point tokenizing, granting pages, and prefilling a dead
-                # request ahead of live ones (in-place: batch IS _inflight)
-                live = [entry for entry in batch if not entry[2].done()]
-                if len(live) != len(batch):
-                    for entry in batch:
-                        if entry[2].done():
-                            self._partial_by_future.pop(entry[2], None)
-                    batch[:] = live
+                # request ahead of live ones (in-place: batch IS _inflight).
+                # Deadline-carrying entries that EXPIRED while queued are
+                # failed here for the same reason: their budget is gone
+                # before any chip time was spent.
+                now = self.generator._clock()
+                live = []
+                for entry in batch:
+                    _, sampling, future = entry
+                    if future.done():
+                        self._partial_by_future.pop(future, None)
+                        continue
+                    if sampling.deadline is not None and sampling.deadline <= now:
+                        self._partial_by_future.pop(future, None)
+                        self.generator.metrics.incr("admission_deadline_rejected")
+                        future.set_exception(DeadlineExceeded(
+                            "deadline expired while queued for admission"
+                        ))
+                        continue
+                    live.append(entry)
+                batch[:] = live
             if batch and not stalled:
                 admitted = await self._admit(batch)
                 # paged backpressure: requests beyond the KV free list stay

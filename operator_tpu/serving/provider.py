@@ -31,7 +31,12 @@ from typing import Optional
 
 from ..schema.analysis import AIResponse, AnalysisRequest
 from ..utils.config import OperatorConfig
-from .engine import BatchedGenerator, SamplingParams, ServingEngine
+from .engine import (
+    BatchedGenerator,
+    DeadlineExceeded,
+    SamplingParams,
+    ServingEngine,
+)
 from .prompts import build_prompt
 
 log = logging.getLogger(__name__)
@@ -159,6 +164,14 @@ class TPUNativeProvider:
                     error=f"additionalConfig.guided_json: {exc}",
                     provider_id="tpu-native", model_id=self.model_id,
                 )
+        # deadline budget: the pipeline's residual envelope becomes an
+        # absolute admission deadline — the engine clamps max_tokens to the
+        # roofline fit or rejects outright (serving/admission.py)
+        abs_deadline = None
+        if request.deadline_s is not None:
+            abs_deadline = (
+                self.engine.generator._clock() + max(0.0, request.deadline_s)
+            )
         params = SamplingParams(
             max_tokens=(config.max_tokens if config and config.max_tokens else 500),
             temperature=(
@@ -166,6 +179,7 @@ class TPUNativeProvider:
             ),
             adapter=adapter,
             guided_regex=guided_regex,
+            deadline=abs_deadline,
         )
         try:
             # priority 10: pod-failure explanations admit ahead of external
@@ -173,15 +187,28 @@ class TPUNativeProvider:
             result = await self.engine.generate(prompt, params, priority=10)
         except asyncio.CancelledError:
             raise
+        except DeadlineExceeded as exc:
+            # no chip time was spent: admission refused the residue
+            return AIResponse(
+                error=f"deadline exceeded before generation: {exc}",
+                provider_id="tpu-native", model_id=self.model_id,
+                deadline_outcome="deadline-exceeded",
+            )
         except Exception as exc:  # noqa: BLE001 - pipeline degrades to pattern-only
             log.exception("tpu-native generation failed")
             return AIResponse(error=str(exc), provider_id="tpu-native", model_id=self.model_id)
+        outcome = None
+        if abs_deadline is not None:
+            outcome = (
+                "truncated" if result.finish_reason == "deadline" else "completed"
+            )
         return AIResponse(
             explanation=result.text,
             provider_id="tpu-native",
             model_id=self.model_id,
             prompt_tokens=result.prompt_tokens,
             completion_tokens=result.completion_tokens,
+            deadline_outcome=outcome,
         )
 
 

@@ -29,6 +29,16 @@ class SamplingParams:
     #: byte-level DFA, token closure, same device-state machinery).
     #: Mutually exclusive with guided_choice.
     guided_regex: Optional[str] = None
+    #: absolute time.monotonic() deadline for this request (deadline
+    #: budget, utils/deadline.py).  Admission rejects a request whose
+    #: roofline decode estimate cannot fit the residue, or clamps
+    #: max_tokens to what does fit (admission.deadline_policy); an entry
+    #: that expires while queued fails with DeadlineExceeded.  None = no
+    #: budget.
+    deadline: Optional[float] = None
+    #: set by admission when max_tokens was clamped to fit the deadline —
+    #: the finish reason then reads "deadline" instead of "length"
+    deadline_clamped: bool = False
 
 
 @dataclass
@@ -37,7 +47,7 @@ class GenerationResult:
     token_ids: list[int]
     prompt_tokens: int
     completion_tokens: int
-    finish_reason: str  # "stop" | "length"
+    finish_reason: str  # "stop" | "length" | "deadline" (budget-clamped length)
     prefill_ms: float = 0.0
     decode_ms: float = 0.0
 
@@ -84,6 +94,11 @@ class _PrefillJob:
 
 class OversizedRequest(ValueError):
     """A single request needs more KV pages than the whole cache holds."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline budget cannot fit even one decoded token
+    (rejected at submit), or expired while the request was queued."""
 
 
 def _bucket(n: int, floor: int, cap: int) -> int:

@@ -1,6 +1,12 @@
-"""Cross-cutting utilities: config, timing/metrics."""
+"""Cross-cutting utilities: config, timing/metrics, deadline budgets,
+fault injection."""
 
 from .config import OperatorConfig
+from .deadline import Deadline
+from .faultinject import FaultAction, FaultPlan
 from .timing import METRICS, MetricsRegistry, StageStats
 
-__all__ = ["OperatorConfig", "METRICS", "MetricsRegistry", "StageStats"]
+__all__ = [
+    "OperatorConfig", "METRICS", "MetricsRegistry", "StageStats",
+    "Deadline", "FaultAction", "FaultPlan",
+]

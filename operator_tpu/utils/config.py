@@ -42,6 +42,19 @@ class OperatorConfig:
     parse_timeout_s: float = 30.0
     ai_timeout_s: float = 180.0
     log_tail_bytes: int = 1_000_000  # cap on fetched pod log
+    # end-to-end deadline budget (utils/deadline.py): born when a failure
+    # is CLAIMED, enforced at every hop; the reference's whole envelope is
+    # its 180 s external-LLM read budget, so that is the default.  A
+    # Podmortem CR overrides per-CR via spec.analysisDeadline.
+    analysis_deadline_s: float = 180.0
+    # slice of the remaining budget log collection may spend before the
+    # pipeline degrades to events-only evidence
+    collect_budget_fraction: float = 0.2
+    # per-provider circuit breaker (operator/providers.py CircuitBreaker):
+    # consecutive-failure trip -> open (AI skipped, pattern-only results)
+    # -> half-open probe after the reset window
+    breaker_failure_threshold: int = 5
+    breaker_reset_s: float = 30.0
 
     # --- health / metrics endpoint (reference operator-deployment.yaml:61-78
     # probes /q/health/*; ours serves /healthz/* + /metrics) ---------------

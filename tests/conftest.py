@@ -8,10 +8,20 @@ which is why this lives at conftest import time.
 
 import os
 import sys
+import tempfile
 
 # force (not setdefault): the environment may pre-set JAX_PLATFORMS to a
 # tunneled TPU backend, and unit tests must never depend on tunnel health
 os.environ["JAX_PLATFORMS"] = "cpu"
+# persistent XLA compile cache for the suite (VERDICT r5 weak #6): the
+# compile-heavy JAX tests re-lower the same tiny-test programs on every run
+# and on every xdist worker; sharing one on-disk cache pays for itself from
+# the second compile on.  setdefault so a series/driver-provided cache dir
+# (the e048cb5 plumbing's env var) wins over the suite default.
+os.environ.setdefault(
+    "OPERATOR_TPU_XLA_CACHE_DIR",
+    os.path.join(tempfile.gettempdir(), "operator-tpu-test-xla-cache"),
+)
 # the env's sitecustomize may have ALREADY imported jax and registered a
 # TPU plugin at interpreter boot, in which case the env var above is read
 # too late — jax.config.update rewrites the live flag before any backend
@@ -41,4 +51,12 @@ def pytest_configure(config):
         cpu_devices = jax.devices("cpu")
         jax.config.update("jax_default_device", cpu_devices[0])
     except Exception:  # pragma: no cover - jax genuinely unavailable
+        return
+    try:
+        from operator_tpu.utils.platform import (
+            enable_persistent_compilation_cache,
+        )
+
+        enable_persistent_compilation_cache()
+    except Exception:  # pragma: no cover - cache is an optimisation only
         pass

@@ -1,0 +1,478 @@
+"""Chaos tests: the watcher→pipeline→storage path under composed,
+DETERMINISTIC fault plans (utils/faultinject.py), plus the serving engine
+under injected step faults.
+
+The determinism contract: a scenario run twice with equal seeded plans
+fires the identical fault sequence (``plan.trace()``) and converges to the
+identical observable state — exactly-once analysis, no leaked engine
+slots/pages, monotone status transitions.  A chaos test that can flake is
+worse than no chaos test.
+"""
+
+import asyncio
+
+import pytest
+
+from operator_tpu.operator.kubeapi import (
+    ConflictError,
+    FakeKubeApi,
+    WatchClosed,
+    WatchExpired,
+)
+from operator_tpu.operator.pipeline import AnalysisPipeline
+from operator_tpu.operator.providers import OpenAICompatProvider, default_registry
+from operator_tpu.operator.watcher import PodFailureWatcher, PodmortemCache
+from operator_tpu.patterns.engine import PatternEngine
+from operator_tpu.schema import (
+    AIProvider,
+    AIProviderRef,
+    AIProviderSpec,
+    LabelSelector,
+    ObjectMeta,
+    Podmortem,
+    PodmortemSpec,
+)
+from operator_tpu.schema.analysis import AIResponse
+from operator_tpu.utils.config import OperatorConfig
+from operator_tpu.utils.faultinject import FaultPlan, OK, raise_, sleep_, times
+from operator_tpu.utils.timing import MetricsRegistry
+
+from test_watcher_pipeline import failed_pod
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _fake_opener(req, timeout=None):
+    """Always-succeeding OpenAI-compatible transport (faults are injected
+    at the http.provider seam, not by breaking the transport)."""
+    import io
+    import json
+
+    class _Resp(io.BytesIO):
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    payload = {
+        "choices": [{"message": {"content": "Root Cause: injected-test."}}],
+        "usage": {"prompt_tokens": 10, "completion_tokens": 5},
+    }
+    return _Resp(json.dumps(payload).encode())
+
+
+async def _chaos_stack(plan: FaultPlan):
+    """Watcher stack over a fault-planned fake apiserver, with an
+    HTTP-provider backend whose outbound attempts hit the same plan."""
+    api = FakeKubeApi()
+    api.fault_plan = plan
+    config = OperatorConfig(
+        pattern_cache_directory="/nonexistent",
+        watch_restart_delay_s=0.01,
+        conflict_backoff_base_s=0.001,
+    )
+    metrics = MetricsRegistry()
+    providers = default_registry()
+    http_backend = OpenAICompatProvider(opener=_fake_opener)
+    http_backend.fault_plan = plan
+    providers.register("openai", http_backend)
+    pipeline = AnalysisPipeline(
+        api, PatternEngine(), config=config, metrics=metrics, providers=providers
+    )
+    cache = PodmortemCache(api, resync_delay_s=0.01)
+    watcher = PodFailureWatcher(
+        api, pipeline, config=config, metrics=metrics, cache=cache
+    )
+    return api, pipeline, watcher, metrics
+
+
+def _composed_plan(seed: int) -> FaultPlan:
+    """The acceptance scenario: watch drop + provider timeouts + 409 storm
+    composed in ONE plan."""
+    import urllib.error
+
+    plan = FaultPlan(seed=seed)
+    # drop the pod watch stream after it has delivered 1 event
+    plan.rule(
+        "kube.watch.Pod",
+        raise_(lambda: WatchClosed("injected stream drop"), "drop"),
+        after=1,
+    )
+    # the provider's first two outbound attempts time out; the third works
+    plan.rule(
+        "http.provider",
+        times(2, raise_(lambda: urllib.error.URLError("injected timeout"), "timeout")),
+    )
+    # a 409 storm against status writes: three conflicts, then clean
+    plan.rule(
+        "kube.patch_status",
+        times(3, raise_(lambda: ConflictError("injected conflict"), "409")),
+        match=lambda kind, name: kind == "Podmortem",
+    )
+    return plan
+
+
+async def _run_composed_scenario(plan: FaultPlan) -> dict:
+    api, pipeline, watcher, metrics = await _chaos_stack(plan)
+    await api.create("AIProvider", AIProvider(
+        metadata=ObjectMeta(name="prov", namespace="ns"),
+        spec=AIProviderSpec(
+            provider_id="openai", model_id="m", api_url="http://fake/v1",
+            max_retries=5, caching_enabled=False,
+        ),
+    ).to_dict())
+    await api.create("Podmortem", Podmortem(
+        metadata=ObjectMeta(name="pm", namespace="ns"),
+        spec=PodmortemSpec(
+            pod_selector=LabelSelector(match_labels={"app": "web"}),
+            ai_provider_ref=AIProviderRef(name="prov", namespace="ns"),
+        ),
+    ).to_dict())
+
+    status_writes: list[dict] = []
+    original_patch_status = api.patch_status
+
+    async def spying_patch_status(kind, name, namespace, status, **kw):
+        out = await original_patch_status(kind, name, namespace, status, **kw)
+        if kind == "Podmortem":
+            status_writes.append(status)
+        return out
+
+    api.patch_status = spying_patch_status
+
+    stop = asyncio.Event()
+    task = asyncio.create_task(watcher.run(stop))
+    await watcher.cache.wait_ready(5)
+    # the failure's ADDED event consumes the after=1 pass-through window
+    # (analysis starts), so the NEXT pod event — the pipeline's own
+    # annotation patch — hits the injected stream drop and the analysis's
+    # effects must survive the reconnect+replay
+    await api.create("Pod", failed_pod().to_dict())
+    # condition wait: the analysis (through AI retries and the 409 storm)
+    # lands in status exactly once
+    for _ in range(500):
+        status = (await api.get("Podmortem", "pm", "ns")).get("status") or {}
+        if status.get("recentFailures"):
+            break
+        await asyncio.sleep(0.02)
+    await watcher.drain()
+    stop.set()
+    api.close_watches()
+    await asyncio.wait_for(asyncio.gather(task, return_exceptions=True), 5)
+
+    status = (await api.get("Podmortem", "pm", "ns")).get("status") or {}
+    failures = status.get("recentFailures") or []
+    return {
+        "trace": plan.trace(),
+        "pending": plan.pending(),
+        "failures": [
+            {k: v for k, v in f.items() if k != "failureTime"} | {
+                "failureTime": f.get("failureTime")}
+            for f in failures
+        ],
+        "successful_status_writes": [
+            w for w in status_writes if w.get("recentFailures")
+        ],
+        "counters": metrics.snapshot()["counters"],
+    }
+
+
+def test_composed_chaos_replays_deterministically():
+    """Watch drop + provider timeout + 409 storm in one plan; two seeded
+    replays produce byte-identical fault traces and identical outcomes:
+    exactly-once analysis, every planned fault consumed."""
+    out_a = run(_run_composed_scenario(_composed_plan(seed=11)))
+    out_b = run(_run_composed_scenario(_composed_plan(seed=11)))
+
+    assert out_a["trace"] == out_b["trace"], "fault replay diverged"
+    assert out_a["pending"] == {}, f"planned faults never fired: {out_a['pending']}"
+
+    for out in (out_a, out_b):
+        # exactly-once analysis despite the storm: one stored entry, one
+        # completed pipeline, and the AI leg survived its injected timeouts
+        assert len(out["failures"]) == 1, out["failures"]
+        entry = out["failures"][0]
+        assert entry["analysisStatus"] == "Analyzed"
+        assert entry["deadlineOutcome"] == "completed"
+        assert out["counters"].get("analyses_completed") == 1
+        # the 409 storm forced retries but exactly ONE write carried the
+        # analysis into status (monotone: no second write rewrote it)
+        assert len(out["successful_status_writes"]) == 1
+    assert out_a["failures"] == out_b["failures"]
+
+
+def test_engine_chaos_stall_and_device_error_no_leaks():
+    """An injected engine-step stall delays but never corrupts; an injected
+    device error kills the in-flight request, the engine auto-recovers, and
+    afterwards no slot or KV page is leaked."""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from operator_tpu.models import TINY_TEST, init_params
+    from operator_tpu.models.tokenizer import ByteTokenizer
+    from operator_tpu.serving.engine import (
+        BatchedGenerator,
+        SamplingParams,
+        ServingEngine,
+    )
+
+    params = init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+    generator = BatchedGenerator(
+        params, TINY_TEST, ByteTokenizer(), max_slots=2, max_seq=128,
+        cache_dtype=jnp.float32, paged=True, page_size=16, decode_block=2,
+    )
+    plan = FaultPlan(seed=3)
+    # second step stalls briefly; the fourth simulates a device error
+    plan.rule("engine.step", [OK, sleep_(0.05), OK,
+                              raise_(lambda: RuntimeError("injected device error"),
+                                     "device")])
+    engine = ServingEngine(generator, admission_wait_s=0.002)
+
+    async def scenario():
+        await engine.start()
+        sampling = SamplingParams(max_tokens=60, temperature=0.0,
+                                  stop_on_eos=False)
+        generator.fault_plan = plan
+        with pytest.raises(RuntimeError):
+            await engine.generate("doomed by injected device error", sampling)
+        generator.fault_plan = None  # fault cleared; recovery must succeed
+        # auto-recovery: the next generate resets device state and serves
+        result = await engine.generate(
+            "served after recovery",
+            SamplingParams(max_tokens=8, temperature=0.0, stop_on_eos=False),
+        )
+        assert result.completion_tokens == 8
+        await engine.close()
+
+    run(scenario())
+    # leak audit: every slot free, every non-prefix page back in the pool
+    assert len(generator.free_slots()) == generator.max_slots
+    assert generator.allocator.available == (
+        generator.allocator.num_pages - 1 - generator.prefix_held_pages
+    )
+    assert plan.pending() == {}, plan.pending()
+
+
+def test_git_clone_fails_twice_then_succeeds(tmp_path):
+    """The declarative 'fail clone twice then succeed' plan drives the git
+    sync seam: two Failed outcomes, then a clean sync of a real repo."""
+    import subprocess
+
+    from operator_tpu.operator.patternsync import GitSyncService, GitSyncError
+    from operator_tpu.schema.crds import PatternRepository
+
+    upstream = tmp_path / "upstream"
+    upstream.mkdir()
+    subprocess.run(["git", "init", "-q", "-b", "main", str(upstream)], check=True)
+    (upstream / "patterns.yaml").write_text(
+        "metadata:\n  library_id: lib\npatterns: []\n"
+    )
+    subprocess.run(["git", "-C", str(upstream), "add", "-A"], check=True)
+    subprocess.run(
+        ["git", "-C", str(upstream), "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-qm", "seed"],
+        check=True,
+    )
+
+    plan = FaultPlan(seed=1)
+    plan.rule("git.clone", times(2, raise_(
+        lambda: GitSyncError("injected clone failure"), "clone-fail")))
+    service = GitSyncService(OperatorConfig(
+        pattern_cache_directory=str(tmp_path / "cache")))
+    service.fault_plan = plan
+    repo = PatternRepository(name="r", url=str(upstream), branch="main")
+
+    async def scenario():
+        outcomes = []
+        for _ in range(3):
+            outcomes.append(await service.sync_repository("lib", repo))
+        return outcomes
+
+    outcomes = run(scenario())
+    assert [o.ok for o in outcomes] == [False, False, True]
+    assert "injected clone failure" in outcomes[0].error
+    assert outcomes[2].commit and outcomes[2].pattern_count == 1
+    assert plan.pending() == {}
+
+
+def test_deadline_exceeded_surfaces_in_status_and_prometheus():
+    """A provider slower than the residual budget degrades to pattern-only
+    with analysisStatus 'deadline-exceeded' and the Prometheus counter
+    incremented — the acceptance path for the deadline subsystem."""
+
+    class SlowBackend:
+        async def generate(self, request):
+            await asyncio.sleep(30)
+            return AIResponse(explanation="too late")
+
+    async def scenario():
+        api = FakeKubeApi()
+        metrics = MetricsRegistry()
+        config = OperatorConfig(
+            analysis_deadline_s=0.3, conflict_backoff_base_s=0.001
+        )
+        providers = default_registry()
+        providers.register("slow", SlowBackend())
+        pipeline = AnalysisPipeline(
+            api, PatternEngine(), config=config, metrics=metrics,
+            providers=providers,
+        )
+        await api.create("AIProvider", AIProvider(
+            metadata=ObjectMeta(name="prov", namespace="ns"),
+            spec=AIProviderSpec(provider_id="slow", model_id="m"),
+        ).to_dict())
+        pm = Podmortem(
+            metadata=ObjectMeta(name="pm", namespace="ns"),
+            spec=PodmortemSpec(
+                pod_selector=LabelSelector(match_labels={"app": "web"}),
+                ai_provider_ref=AIProviderRef(name="prov", namespace="ns"),
+            ),
+        )
+        await api.create("Podmortem", pm.to_dict())
+        pod = failed_pod()
+        await api.create("Pod", pod.to_dict())
+        await pipeline.process_failure_group(pod, [pm], failure_time="t-0")
+
+        status = (await api.get("Podmortem", "pm", "ns")).get("status") or {}
+        entry = status["recentFailures"][0]
+        assert entry["analysisStatus"] == "deadline-exceeded"
+        assert entry["deadlineOutcome"] == "deadline-exceeded"
+        assert metrics.counter("deadline_exceeded") == 1
+        assert "podmortem_deadline_exceeded_total 1" in metrics.prometheus()
+        # budget pressure is NOT backend health: the breaker stays closed
+        assert pipeline.breakers.for_provider("slow").state == "closed"
+        # a degraded (budget-killed) analysis must stay re-analyzable: the
+        # durable marker is not stamped
+        from operator_tpu.operator.storage import ANNOTATION_ANALYZED_FAILURE
+
+        stored = await api.get("Pod", pod.metadata.name, pod.metadata.namespace)
+        annotations = stored["metadata"].get("annotations") or {}
+        assert ANNOTATION_ANALYZED_FAILURE not in annotations
+
+    run(scenario())
+
+
+def test_per_cr_deadline_override_tightens_envelope():
+    """spec.analysisDeadline below the operator default drives the budget;
+    it can tighten but never extend the claim envelope."""
+
+    class SlowBackend:
+        async def generate(self, request):
+            # the CR's 1s budget (minus collect/parse) must bound this
+            assert request.deadline_s is not None and request.deadline_s <= 1.0
+            await asyncio.sleep(30)
+            return AIResponse(explanation="too late")
+
+    async def scenario():
+        api = FakeKubeApi()
+        metrics = MetricsRegistry()
+        config = OperatorConfig(
+            analysis_deadline_s=180.0, conflict_backoff_base_s=0.001
+        )
+        providers = default_registry()
+        providers.register("slow", SlowBackend())
+        pipeline = AnalysisPipeline(
+            api, PatternEngine(), config=config, metrics=metrics,
+            providers=providers,
+        )
+        await api.create("AIProvider", AIProvider(
+            metadata=ObjectMeta(name="prov", namespace="ns"),
+            spec=AIProviderSpec(provider_id="slow", model_id="m"),
+        ).to_dict())
+        pm = Podmortem(
+            metadata=ObjectMeta(name="pm", namespace="ns"),
+            spec=PodmortemSpec(
+                pod_selector=LabelSelector(match_labels={"app": "web"}),
+                ai_provider_ref=AIProviderRef(name="prov", namespace="ns"),
+                analysis_deadline="1s",
+            ),
+        )
+        await api.create("Podmortem", pm.to_dict())
+        pod = failed_pod()
+        await api.create("Pod", pod.to_dict())
+        await pipeline.process_failure_group(pod, [pm], failure_time="t-0")
+        status = (await api.get("Podmortem", "pm", "ns")).get("status") or {}
+        assert status["recentFailures"][0]["analysisStatus"] == "deadline-exceeded"
+        assert metrics.counter("deadline_exceeded") == 1
+
+    run(scenario())
+
+
+def test_circuit_breaker_trips_opens_and_half_open_recovers():
+    """Five consecutive backend failures trip the breaker (AI skipped, no
+    budget burned); after the reset window one half-open probe flows and a
+    healthy backend closes the circuit again."""
+
+    class FlakyBackend:
+        def __init__(self):
+            self.healthy = False
+            self.calls = 0
+
+        async def generate(self, request):
+            self.calls += 1
+            if not self.healthy:
+                raise RuntimeError("backend down")
+            return AIResponse(explanation="Root Cause: fixed.")
+
+    async def scenario():
+        api = FakeKubeApi()
+        metrics = MetricsRegistry()
+        clock = {"t": 0.0}
+        config = OperatorConfig(
+            breaker_failure_threshold=5, breaker_reset_s=30.0,
+            conflict_backoff_base_s=0.001,
+        )
+        backend = FlakyBackend()
+        providers = default_registry()
+        providers.register("flaky", backend)
+        pipeline = AnalysisPipeline(
+            api, PatternEngine(), config=config, metrics=metrics,
+            providers=providers, clock=lambda: clock["t"],
+        )
+        await api.create("AIProvider", AIProvider(
+            metadata=ObjectMeta(name="prov", namespace="ns"),
+            spec=AIProviderSpec(provider_id="flaky", model_id="m",
+                                caching_enabled=False),
+        ).to_dict())
+        pm = Podmortem(
+            metadata=ObjectMeta(name="pm", namespace="ns"),
+            spec=PodmortemSpec(
+                pod_selector=LabelSelector(match_labels={"app": "web"}),
+                ai_provider_ref=AIProviderRef(name="prov", namespace="ns"),
+            ),
+        )
+        await api.create("Podmortem", pm.to_dict())
+        pod = failed_pod()
+        await api.create("Pod", pod.to_dict())
+
+        async def one_analysis(i):
+            await pipeline.process_pod_failure(pod, pm, failure_time=f"t-{i}")
+
+        for i in range(5):  # five failures: breaker trips on the fifth
+            await one_analysis(i)
+        assert backend.calls == 5
+        assert metrics.counter("circuit_opened") == 1
+        assert pipeline.breakers.for_provider("flaky").state == "open"
+
+        await one_analysis(5)  # open: skipped, backend NOT called
+        assert backend.calls == 5
+        assert metrics.counter("circuit_open_skips") == 1
+
+        backend.healthy = True
+        clock["t"] += 31.0  # reset window elapses -> half-open probe
+        await one_analysis(6)
+        assert backend.calls == 6
+        assert pipeline.breakers.for_provider("flaky").state == "closed"
+
+        status = (await api.get("Podmortem", "pm", "ns")).get("status") or {}
+        entries = status["recentFailures"]
+        # newest first: the recovered analysis is Analyzed, the open-skip
+        # and the five failures are Failed — status only ever moved
+        # forward (no entry rewritten after the fact)
+        assert entries[0]["analysisStatus"] == "Analyzed"
+        assert all(e["analysisStatus"] == "Failed" for e in entries[1:])
+
+    run(scenario())

@@ -14,7 +14,7 @@ import asyncio
 
 import pytest
 
-from operator_tpu.operator.kubeapi import FakeKubeApi, WatchExpired
+from operator_tpu.operator.kubeapi import FakeKubeApi, WatchClosed, WatchExpired
 from operator_tpu.schema.meta import LabelSelector, ObjectMeta
 from operator_tpu.schema.crds import Podmortem, PodmortemSpec
 
@@ -192,6 +192,62 @@ def test_bookmark_refreshes_cursor():
         stop.set()
         api.close_watches()
         await asyncio.wait_for(asyncio.gather(task, return_exceptions=True), 5)
+
+    run(body())
+
+
+def test_watcher_survives_410_relist_disconnect_storm():
+    """A composed storm from the fault harness (utils/faultinject.py):
+    the pod watch stream is dropped twice mid-flight, then a resume
+    attempt gets 410 (compacted cursor) forcing the sweep+relist path —
+    a failure landing inside the storm is analysed exactly once."""
+
+    async def body():
+        from operator_tpu.utils.faultinject import FaultPlan, raise_, times
+
+        api, pipeline, watcher, metrics = await make_stack()
+        await api.create("Podmortem", _watched_pm().to_dict())
+        plan = FaultPlan(seed=5)
+        # two stream drops after the first delivered event each...
+        plan.rule(
+            "kube.watch.Pod",
+            times(2, raise_(lambda: WatchClosed("injected drop"), "drop")),
+            after=1,
+        )
+        # ...then the second reconnect is refused with 410: the cursor is
+        # compacted away and only a fresh sweep+relist recovers
+        plan.rule(
+            "kube.watch_open.Pod",
+            raise_(lambda: WatchExpired("injected 410"), "410"),
+            after=2,  # the initial open + the first post-drop reconnect pass
+            match=lambda resource_version: resource_version is not None,
+        )
+        api.fault_plan = plan
+
+        stop = asyncio.Event()
+        task = asyncio.create_task(watcher.run(stop))
+        await watcher.cache.wait_ready(5)
+        # the failure lands while the stream is being storm-dropped
+        await api.create("Pod", failed_pod().to_dict())
+        # condition wait: the analysis landed AND the whole storm fired
+        # (the drops are triggered by the analysis's own status/annotation
+        # events replaying across reconnects)
+        for _ in range(500):
+            status = (await api.get("Podmortem", "pm", "ns")).get("status") or {}
+            if status.get("recentFailures") and not plan.pending():
+                break
+            await asyncio.sleep(0.02)
+        await watcher.drain()
+        stop.set()
+        api.close_watches()
+        await asyncio.wait_for(asyncio.gather(task, return_exceptions=True), 5)
+
+        status = (await api.get("Podmortem", "pm", "ns")).get("status") or {}
+        failures = status.get("recentFailures") or []
+        assert len(failures) == 1, "storm lost or duplicated the failure"
+        assert metrics.counter("analyses_completed") == 1  # exactly once
+        assert plan.pending() == {}, f"storm never fully fired: {plan.pending()}"
+        assert watcher.restarts >= 3  # two drops + the 410
 
     run(body())
 
