@@ -1,0 +1,34 @@
+"""Incident memory: failure fingerprinting, a durable incident store, a
+TPU-scored embedding index, and the recall policy that lets the analysis
+pipeline reuse whole analyses for recurring failures.
+
+See docs/MEMORY.md for the fingerprint spec, recall policy, and tuning.
+"""
+
+from .fingerprint import FailureFingerprint, evidence_template, failure_fingerprint, normalize_line
+from .index import IncidentIndex
+from .recall import (
+    RECALL_HIT,
+    RECALL_MISS,
+    RECALL_NEAR,
+    IncidentMemory,
+    RecallDecision,
+    build_incident_memory,
+)
+from .store import Incident, IncidentStore
+
+__all__ = [
+    "FailureFingerprint",
+    "Incident",
+    "IncidentIndex",
+    "IncidentMemory",
+    "IncidentStore",
+    "RECALL_HIT",
+    "RECALL_MISS",
+    "RECALL_NEAR",
+    "RecallDecision",
+    "build_incident_memory",
+    "evidence_template",
+    "failure_fingerprint",
+    "normalize_line",
+]

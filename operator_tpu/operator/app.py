@@ -62,6 +62,15 @@ class Operator:
         )
         self.events = EventService(api, self.config)
         self.storage = AnalysisStorageService(api, self.config)
+        # incident memory shares the semantic matcher's embedder when one
+        # is mounted (neural near-miss recall); lexical hashing otherwise
+        from ..memory import build_incident_memory
+
+        semantic = getattr(self.engine, "semantic", None)
+        self.memory = build_incident_memory(
+            self.config,
+            embedder=semantic.embedder if semantic is not None else None,
+        )
         self.pipeline = AnalysisPipeline(
             api,
             self.engine,
@@ -70,6 +79,7 @@ class Operator:
             storage=self.storage,
             providers=self.providers,
             metrics=self.metrics,
+            memory=self.memory,
         )
         self.cr_cache = PodmortemCache(api)
         self.watcher = PodFailureWatcher(
@@ -99,6 +109,8 @@ class Operator:
                 self.liveness,
                 self.readiness,
                 metrics=self.metrics,
+                memory=self.memory,
+                incidents_token=self.config.incidents_api_token or None,
                 host=self.config.health_host,
                 port=self.config.health_port,
             )
@@ -283,6 +295,11 @@ class Operator:
         log.info("operator starting (namespaces: %s)",
                  self.config.watch_namespaces or "ALL")
         self._stop.clear()
+        if self.memory is not None and self.config.memory_configmap:
+            # PVC-less durability: merge the last ConfigMap snapshot before
+            # any analysis runs (journal/live entries win over snapshot)
+            namespace = getattr(self.api, "namespace", None) or "default"
+            await self.memory.restore_from_configmap(self.api, namespace)
         if self.health_server is not None:
             await self.health_server.start()
         if self.config.completion_api_port >= 0:
@@ -317,6 +334,18 @@ class Operator:
             task.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
         self._tasks = []
+        if self.memory is not None:
+            if self.config.memory_configmap:
+                # final forced snapshot: incidents inserted inside the last
+                # flush interval must survive a PVC-less restart
+                try:
+                    namespace = getattr(self.api, "namespace", None) or "default"
+                    await self.memory.maybe_flush_to_configmap(
+                        self.api, namespace, force=True
+                    )
+                except Exception:  # noqa: BLE001 - shutdown must complete
+                    log.warning("final incident snapshot failed", exc_info=True)
+            self.memory.close()  # flush+close the incident journal handle
         log.info("operator stopped")
 
     async def run_forever(self) -> None:

@@ -11,6 +11,11 @@ asyncio HTTP server exposes:
   latency registry (detect→collect→parse→prefill→decode→store), scrapeable
   by any standard collector — the observability the p50<2s SLO needs
 - ``GET /metrics.json``  — the same data as a JSON snapshot
+- ``GET /incidents``     — the incident-memory store, newest first
+  (``?limit=N``; docs/MEMORY.md)
+- ``GET /incidents/query`` — free-text similarity query over the incident
+  index (``?q=...&k=N``): which remembered failures does this log line
+  look like?
 
 Probe responses are JSON; failures return 503 so the kubelet treats the
 pod exactly as it treats the reference's native binary.
@@ -21,10 +26,14 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
-from typing import Optional
+import urllib.parse
+from typing import TYPE_CHECKING, Optional
 
 from ..utils.timing import METRICS, MetricsRegistry
 from .health import LivenessCheck, ReadinessCheck
+
+if TYPE_CHECKING:  # import cycle guard: memory is constructed by the app
+    from ..memory import IncidentMemory
 
 log = logging.getLogger(__name__)
 
@@ -44,12 +53,19 @@ class HealthServer:
         readiness: ReadinessCheck,
         *,
         metrics: Optional[MetricsRegistry] = None,
+        memory: "Optional[IncidentMemory]" = None,
+        incidents_token: Optional[str] = None,
         host: str = "0.0.0.0",
         port: int = 8080,
     ) -> None:
         self.liveness = liveness
         self.readiness = readiness
         self.metrics = metrics or METRICS
+        self.memory = memory
+        #: bearer token gating /incidents* (None/"" = open); probes and
+        #: /metrics stay unauthenticated — incident records quote log
+        #: evidence, which is more sensitive than latency numbers
+        self.incidents_token = incidents_token or None
         self.host = host
         self.port = port
         self._server: Optional[asyncio.AbstractServer] = None
@@ -89,8 +105,24 @@ class HealthServer:
             parts = line.decode("latin-1").split()
             if len(parts) < 2:
                 return
-            method, path = parts[0], parts[1].split("?")[0]
-            status, body = await self._route(method, path)
+            method, target = parts[0], parts[1]
+            path, _, raw_query = target.partition("?")
+            query = urllib.parse.parse_qs(raw_query)
+            # drain the (bounded) header block; only Authorization is
+            # consumed — the /incidents* routes may require a token
+            authorization = ""
+            for _ in range(100):
+                try:
+                    header = await reader.readline()
+                except ValueError:
+                    return
+                if not header or header in (b"\r\n", b"\n"):
+                    break
+                if header.lower().startswith(b"authorization:"):
+                    authorization = header.split(b":", 1)[1].strip().decode("latin-1")
+            status, body = await self._route(
+                method, path, query, authorization=authorization
+            )
             if isinstance(body, bytes):  # pre-rendered (Prometheus text)
                 payload = body
                 content_type = b"text/plain; version=0.0.4; charset=utf-8"
@@ -116,9 +148,24 @@ class HealthServer:
             except (ConnectionError, OSError):
                 pass
 
-    async def _route(self, method: str, path: str) -> "tuple[int, dict | bytes]":
+    async def _route(
+        self,
+        method: str,
+        path: str,
+        query: "Optional[dict[str, list[str]]]" = None,
+        *,
+        authorization: str = "",
+    ) -> "tuple[int, dict | bytes]":
+        query = query or {}
         if method not in ("GET", "HEAD"):
             return 405, {"error": "method not allowed"}
+        if path.startswith("/incidents") and self.incidents_token:
+            import hmac
+
+            if not hmac.compare_digest(
+                authorization.encode(), f"Bearer {self.incidents_token}".encode()
+            ):
+                return 401, {"error": "missing or invalid bearer token"}
         if path in ("/healthz/live", "/livez"):
             status = await self.liveness.check()
             return (200 if status.ready else 503), {
@@ -135,4 +182,37 @@ class HealthServer:
             return 200, self.metrics.prometheus().encode()
         if path == "/metrics.json":
             return 200, self.metrics.snapshot()
+        if path == "/incidents":
+            if self.memory is None:
+                return 404, {"error": "incident memory disabled"}
+            try:
+                limit = int(query.get("limit", ["100"])[0])
+            except ValueError:
+                return 400, {"error": "limit must be an integer"}
+            # serialize off-loop and only the requested page — a full-store
+            # to_dict on the probe loop would stall kubelet probes
+            incidents = await asyncio.to_thread(
+                self.memory.store.to_dicts, True, limit
+            )
+            return 200, {"count": len(self.memory.store), "incidents": incidents}
+        if path == "/incidents/query":
+            if self.memory is None:
+                return 404, {"error": "incident memory disabled"}
+            text = query.get("q", [""])[0]
+            if not text.strip():
+                return 400, {"error": "missing query parameter q"}
+            try:
+                k = int(query.get("k", ["3"])[0])
+            except ValueError:
+                return 400, {"error": "k must be an integer"}
+            # embedding runs off-loop: a neural embedder must not stall
+            # probe handling on this same server
+            matches = await asyncio.to_thread(self.memory.query_text, text, k)
+            payload = []
+            for incident, score in matches:
+                # re-serialize under the store lock: the Incident is live
+                data = self.memory.store.dump(incident.fingerprint)
+                if data is not None:
+                    payload.append({"score": round(score, 4), **data})
+            return 200, {"matches": payload}
         return 404, {"error": f"no route {path}"}

@@ -17,9 +17,22 @@ import logging
 import time
 from typing import Callable, Optional
 
+from ..memory import (
+    RECALL_HIT,
+    RECALL_NEAR,
+    IncidentMemory,
+    RecallDecision,
+    build_incident_memory,
+)
 from ..patterns.engine import PatternEngine
-from ..schema.analysis import AIResponse, AnalysisRequest, AnalysisResult, PodFailureData
-from ..schema.crds import AIProvider, Podmortem, parse_refresh_interval
+from ..schema.analysis import (
+    AIResponse,
+    AnalysisRequest,
+    AnalysisResult,
+    PodFailureData,
+    PriorIncident,
+)
+from ..schema.crds import AIProvider, FailureRecurrence, Podmortem, parse_refresh_interval
 from ..schema.kube import Event as KubeEvent
 from ..schema.kube import Pod
 from ..schema.meta import now_iso
@@ -91,6 +104,7 @@ class AnalysisPipeline:
         providers: Optional[ProviderRegistry] = None,
         metrics: Optional[MetricsRegistry] = None,
         clock: Optional[Callable[[], float]] = None,
+        memory: Optional[IncidentMemory] = None,
     ) -> None:
         self.api = api
         self.engine = engine
@@ -101,6 +115,10 @@ class AnalysisPipeline:
         self.metrics = metrics or METRICS
         self.cache = ResponseCache()
         self.dedupe = FailureDedupe()
+        # incident memory (docs/MEMORY.md): recall across failures so a
+        # recurring class pays the TPU decode once, not once per pod.
+        # Injectable; the default honours config.memory_enabled.
+        self.memory = memory if memory is not None else build_incident_memory(self.config)
         # deadline budgets + per-provider circuit breakers share one
         # injectable clock so chaos tests replay deterministically
         self._clock = clock or time.monotonic
@@ -241,9 +259,72 @@ class AnalysisPipeline:
             self.metrics.incr("parse_errors")
             return None
 
-        # -- explain (the AI leg gets whatever budget is left) -------------
+        # -- recall (incident memory, docs/MEMORY.md) ----------------------
+        # exact fingerprint hit: reuse the stored analysis and SKIP the AI
+        # leg — the dominant cost for a fleet-wide recurring failure; near
+        # hit: carry the top-k prior incidents into the prompt; miss: full
+        # analysis, remembered below
+        ai_configured = (
+            podmortem.spec.ai_analysis_enabled
+            and podmortem.spec.ai_provider_ref is not None
+        )
+        # reuse identity is the provider ref PLUS a hash of the spec
+        # fields that shape its output: a hit must hand this CR an
+        # analysis its own CURRENT provider would have generated — never
+        # another CR's text, and never a stale one from before the
+        # AIProvider was edited (new model/template regenerates).  The CR's
+        # cachingEnabled opt-out is honoured exactly like ResponseCache.
+        provider_ref_key: Optional[str] = None
+        provider: Optional[AIProvider] = None
+        caching_ok = False
+        if ai_configured:
+            provider, provider_ref_key = await self._resolve_provider_identity(
+                podmortem
+            )
+            caching_ok = provider is not None and provider.spec.caching_enabled
+        recall: Optional[RecallDecision] = None
+        recurrence: Optional[FailureRecurrence] = None
         ai_response: Optional[AIResponse] = None
-        if podmortem.spec.ai_analysis_enabled and podmortem.spec.ai_provider_ref is not None:
+        reused = False
+        if self.memory is not None:
+            with self.metrics.timed("recall"):
+                # embedding may be a neural encoder; keep the loop free
+                recall = await asyncio.to_thread(
+                    self.memory.recall, result, pod,
+                    allow_reuse=ai_configured and caching_ok,
+                    provider_ref=provider_ref_key,
+                )
+            if recall.kind == RECALL_HIT:
+                incident = recall.incident
+                reused = True
+                self.metrics.incr("recall_hit")
+                # the hit RETURNS the unused deadline budget: everything
+                # the AI leg would have spent is handed back (recorded so
+                # the decode-seconds saved are visible on /metrics)
+                self.metrics.record(
+                    "recall_budget_returned", deadline.remaining() * 1e3
+                )
+                ai_response = AIResponse(
+                    explanation=recall.analysis.explanation,
+                    provider_id=recall.analysis.provider_id,
+                    model_id=recall.analysis.model_id,
+                    cached=True,
+                )
+                recurrence = FailureRecurrence(
+                    fingerprint=incident.fingerprint,
+                    seen_count=incident.seen_count,
+                    first_seen=incident.first_seen,
+                    reused_analysis=True,
+                )
+            elif recall.kind == RECALL_NEAR:
+                self.metrics.incr("recall_near")
+            else:
+                self.metrics.incr("recall_miss")
+
+        # -- explain (the AI leg gets whatever budget is left) -------------
+        if reused:
+            pass  # cached analysis; no generation
+        elif ai_configured:
             if deadline.expired:
                 # the budget died before the AI leg even started: degrade
                 # to pattern-only NOW instead of dispatching a doomed call
@@ -257,18 +338,61 @@ class AnalysisPipeline:
                     error=message, deadline_outcome="deadline-exceeded"
                 )
             else:
+                priors = [
+                    PriorIncident(
+                        fingerprint=inc.fingerprint,
+                        score=round(score, 4),
+                        seen_count=inc.seen_count,
+                        severity=inc.severity,
+                        last_seen=inc.last_seen,
+                        explanation=inc.explanation,
+                    )
+                    for inc, score in (recall.neighbors if recall else [])
+                ]
                 ai_response = await self._generate_explanation(
-                    pod, podmortem, result, failure, deadline=deadline
+                    pod, podmortem, result, failure, deadline=deadline,
+                    prior_incidents=priors, provider=provider,
                 )
             self._record_deadline_outcome(ai_response)
         elif podmortem.spec.ai_analysis_enabled:
             log.info("podmortem %s has no aiProviderRef; storing pattern-only result",
                      podmortem.qualified_name())
 
+        # -- remember (a hit already bumped its recurrence counters) -------
+        if self.memory is not None and recall is not None:
+            if not reused:
+                incident = await asyncio.to_thread(
+                    self.memory.insert, recall.fingerprint, result, pod, ai_response,
+                    related=[inc.fingerprint for inc, _ in recall.neighbors],
+                    # recall() already counted this sighting iff it found
+                    # the digest; otherwise a racing concurrent first
+                    # sighting is counted by the upsert itself
+                    seen_recorded=recall.incident is not None,
+                    # cachingEnabled=false also means "don't remember my
+                    # generations": recurrence is tracked, text is not
+                    provider_ref=provider_ref_key if caching_ok else None,
+                    cacheable=caching_ok,
+                )
+                if incident is not None:  # weak fingerprints are never stored
+                    recurrence = FailureRecurrence(
+                        fingerprint=incident.fingerprint,
+                        seen_count=incident.seen_count,
+                        first_seen=incident.first_seen,
+                        reused_analysis=False,
+                    )
+            # snapshot into the OPERATOR's namespace (where restore reads
+            # it, app.py) — never the CR's, or multi-namespace fleets
+            # scatter partial snapshots that restore can't find.  Hits
+            # flush too: recurrence counters must survive a restart.
+            await self.memory.maybe_flush_to_configmap(
+                self.api, getattr(self.api, "namespace", None) or "default"
+            )
+
         # -- store + emit --------------------------------------------------
         with self.metrics.timed("store"):
             await self.storage.store_analysis_results(
-                result, ai_response, pod, podmortem, failure_time=failure_time
+                result, ai_response, pod, podmortem,
+                failure_time=failure_time, recurrence=recurrence,
             )
         explanation = (
             ai_response.explanation
@@ -323,6 +447,44 @@ class AnalysisPipeline:
         return PodFailureData(pod=pod, logs=logs, events=events, collection_time=now_iso())
 
     # ------------------------------------------------------------------
+    async def _resolve_provider_identity(
+        self, podmortem: Podmortem
+    ) -> "tuple[Optional[AIProvider], Optional[str]]":
+        """Fetch the CR's AIProvider and derive the reuse-identity key:
+        ``namespace/name@spec-hash`` over the spec fields that shape the
+        generated text (the same identity basis as ResponseCache.key).
+        Fetch failures return (None, bare ref key): recall proceeds
+        reuse-disabled and the AI leg's own fetch reports the error."""
+        import hashlib
+        import json
+
+        ref = podmortem.spec.ai_provider_ref
+        namespace = ref.namespace or podmortem.metadata.namespace or "default"
+        ref_key = f"{namespace}/{ref.name}"
+        try:
+            provider_dict = await self.api.get("AIProvider", ref.name, namespace)
+        except ApiError:
+            return None, ref_key
+        provider = AIProvider.parse(provider_dict)
+        spec = provider.spec
+        basis = json.dumps(
+            {
+                "provider": spec.provider_id,
+                "url": spec.api_url,
+                "model": spec.model_id,
+                "template": spec.prompt_template,
+                "max_tokens": spec.max_tokens,
+                "temperature": spec.temperature,
+                # additionalConfig selects LoRA adapters and guided-decoding
+                # constraints — output-shaping, so part of the identity
+                "extra": dict(sorted(spec.additional_config.items())),
+            },
+            sort_keys=True,
+        )
+        digest = hashlib.sha256(basis.encode()).hexdigest()[:12]
+        return provider, f"{ref_key}@{digest}"
+
+    # ------------------------------------------------------------------
     def _record_deadline_outcome(self, ai_response: Optional[AIResponse]) -> None:
         """One place turns the AI leg's budget outcome into counters (the
         Prometheus surface: podmortem_deadline_*_total).  Backends that
@@ -348,27 +510,30 @@ class AnalysisPipeline:
         failure: PodFailureData,
         *,
         deadline: Optional[Deadline] = None,
+        prior_incidents: Optional[list[PriorIncident]] = None,
+        provider: Optional[AIProvider] = None,
     ) -> AIResponse:
         ref = podmortem.spec.ai_provider_ref
         namespace = ref.namespace or podmortem.metadata.namespace or "default"
-        try:
-            provider_dict = await self.api.get("AIProvider", ref.name, namespace)
-        except NotFoundError:
-            message = f"AIProvider {namespace}/{ref.name} not found"
-            log.warning("%s (podmortem %s)", message, podmortem.qualified_name())
-            await self.events.emit_analysis_error(pod, podmortem, message)
-            self.metrics.incr("provider_missing")
-            return AIResponse(error=message)
-        except ApiError as exc:
-            await self.events.emit_analysis_error(pod, podmortem, f"AIProvider fetch failed: {exc}")
-            return AIResponse(error=str(exc))
-
-        provider = AIProvider.parse(provider_dict)
+        if provider is None:  # not pre-fetched by the recall identity step
+            try:
+                provider_dict = await self.api.get("AIProvider", ref.name, namespace)
+            except NotFoundError:
+                message = f"AIProvider {namespace}/{ref.name} not found"
+                log.warning("%s (podmortem %s)", message, podmortem.qualified_name())
+                await self.events.emit_analysis_error(pod, podmortem, message)
+                self.metrics.incr("provider_missing")
+                return AIResponse(error=message)
+            except ApiError as exc:
+                await self.events.emit_analysis_error(pod, podmortem, f"AIProvider fetch failed: {exc}")
+                return AIResponse(error=str(exc))
+            provider = AIProvider.parse(provider_dict)
         provider_config = await resolve_provider_config(self.api, provider)
         remaining = deadline.remaining() if deadline is not None else None
         request = AnalysisRequest(
             analysis_result=result, provider_config=provider_config,
             failure_data=failure, deadline_s=remaining,
+            prior_incidents=list(prior_incidents or []),
         )
 
         cache_key = None

@@ -270,6 +270,9 @@ class ResponseCache:
                  e.context.matched_line if e.context else None)
                 for e in (result.events if result else [])[:8]
             ],
+            # near-miss recalls change the rendered prompt, so they are
+            # part of the response identity too
+            "prior": [p.fingerprint for p in request.prior_incidents],
         }
         return hashlib.sha256(json.dumps(basis, sort_keys=True).encode()).hexdigest()
 

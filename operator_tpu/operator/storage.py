@@ -24,7 +24,7 @@ import logging
 from typing import Optional
 
 from ..schema.analysis import AIResponse, AnalysisResult
-from ..schema.crds import PodFailureStatus, Podmortem
+from ..schema.crds import FailureRecurrence, PodFailureStatus, Podmortem
 from ..schema.kube import Pod
 from ..schema.meta import now_iso
 from ..schema.serde import to_dict
@@ -45,8 +45,34 @@ ANNOTATION_ANALYZED_AT = "podmortem.io/analyzed-at"
 ANNOTATION_ANALYZED_FAILURE = "podmortem.io/analyzed-failure"
 ANNOTATION_MONITOR = "podmortem.io/monitor"
 
-#: keep pod annotations within etcd sanity; full text still goes to CR status
-MAX_ANNOTATION_CHARS = 8192
+#: the apiserver rejects objects whose TOTAL annotation BYTES exceed
+#: 256 KiB (TotalAnnotationSizeLimitB); whatever the configured char cap
+#: says, never let one analysis text get near it — a rejected patch loses
+#: the whole store, a truncated text loses only its tail.  Enforced in
+#: bytes because that is what the apiserver counts (CJK / box-drawing
+#: evidence encodes at 3-4 bytes per char).
+HARD_ANNOTATION_CEILING_BYTES = 240 * 1024
+
+#: explicit truncation marker — a reader (or a tool diffing two stored
+#: analyses) must be able to tell "short analysis" from "cap applied"
+TRUNCATION_MARKER = "…[truncated]"
+
+
+def truncate_marked(text: str, cap: int, *, max_bytes: Optional[int] = None) -> str:
+    """Truncate ``text`` to at most ``cap`` chars — and, when ``max_bytes``
+    is given, at most that many UTF-8 bytes — replacing the tail with an
+    explicit marker when anything was cut.  Deterministic (equal inputs
+    give byte-equal outputs — incident-memory reuse depends on it)."""
+    out = text
+    if 0 < cap < len(out):
+        if cap <= len(TRUNCATION_MARKER):
+            return TRUNCATION_MARKER[:cap]
+        out = out[: cap - len(TRUNCATION_MARKER)] + TRUNCATION_MARKER
+    if max_bytes is not None and len(out.encode("utf-8")) > max_bytes:
+        budget = max(0, max_bytes - len(TRUNCATION_MARKER.encode("utf-8")))
+        head = out.encode("utf-8")[:budget].decode("utf-8", errors="ignore")
+        out = head + TRUNCATION_MARKER
+    return out
 
 
 class AnalysisStorageService:
@@ -63,6 +89,7 @@ class AnalysisStorageService:
         podmortem: Podmortem,
         *,
         failure_time: Optional[str] = None,
+        recurrence: Optional[FailureRecurrence] = None,
     ) -> None:
         """Store to both places; failures in one must not block the other
         (reference stores annotations first, then status :60-68)."""
@@ -77,7 +104,8 @@ class AnalysisStorageService:
             pod, result, explanation, failure_time=failure_time if final else None
         )
         await self.store_to_podmortem_status(
-            podmortem, pod, result, ai_response, explanation, failure_time=failure_time
+            podmortem, pod, result, ai_response, explanation,
+            failure_time=failure_time, recurrence=recurrence,
         )
 
     @staticmethod
@@ -96,7 +124,10 @@ class AnalysisStorageService:
         failure_time: Optional[str] = None,
     ) -> bool:
         annotations = {
-            ANNOTATION_ANALYSIS: explanation[:MAX_ANNOTATION_CHARS],
+            ANNOTATION_ANALYSIS: truncate_marked(
+                explanation, self.config.max_annotation_chars,
+                max_bytes=HARD_ANNOTATION_CEILING_BYTES,
+            ),
             ANNOTATION_SEVERITY: (result.summary.highest_severity or "NONE"),
             ANNOTATION_ANALYZED_AT: now_iso(),
         }
@@ -129,6 +160,7 @@ class AnalysisStorageService:
         explanation: str,
         *,
         failure_time: Optional[str] = None,
+        recurrence: Optional[FailureRecurrence] = None,
     ) -> bool:
         if ai_response is not None and ai_response.explanation:
             analysis_status = "Analyzed"
@@ -146,9 +178,12 @@ class AnalysisStorageService:
             pod_namespace=pod.metadata.namespace,
             failure_time=failure_time or now_iso(),
             analysis_status=analysis_status,
-            explanation=explanation,
+            explanation=truncate_marked(
+                explanation, self.config.max_status_explanation_chars
+            ),
             severity=result.summary.highest_severity,
             deadline_outcome=deadline_outcome,
+            recurrence=recurrence,
         )
 
         async def attempt() -> bool:

@@ -199,6 +199,20 @@ class AIProviderConfig:
 
 
 @dataclass
+class PriorIncident:
+    """One remembered incident injected into the prompt as
+    retrieval-augmented context on a near-miss recall
+    (memory/recall.py; rendered by serving/prompts.py)."""
+
+    fingerprint: Optional[str] = None
+    score: float = 0.0
+    seen_count: int = 0
+    severity: Optional[str] = None
+    last_seen: Optional[str] = None
+    explanation: Optional[str] = None
+
+
+@dataclass
 class AnalysisRequest:
     """POST body for explanation generation (reference
     AIInterfaceClient.java:45-59: wraps AnalysisResult + provider config)."""
@@ -211,6 +225,9 @@ class AnalysisRequest:
     #: engine clamps max_tokens to the roofline fit, the HTTP provider
     #: clamps its read timeout.  None = no budget (legacy callers).
     deadline_s: Optional[float] = None
+    #: near-miss recalls from incident memory, best first — prompt
+    #: construction appends them under a bounded char budget
+    prior_incidents: list[PriorIncident] = field(default_factory=list)
 
     def to_dict(self) -> dict[str, Any]:
         return to_dict(self)

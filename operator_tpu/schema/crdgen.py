@@ -65,6 +65,16 @@ _POD_FAILURE_STATUS = _obj(
             "type": "string",
             "enum": ["completed", "truncated", "deadline-exceeded"],
         },
+        # incident-memory classification (operator_tpu/memory/): stable
+        # failure fingerprint + fleet-wide recurrence accounting
+        "recurrence": _obj(
+            {
+                "fingerprint": _STR,
+                "seenCount": _INT,
+                "firstSeen": _STR,
+                "reusedAnalysis": _BOOL,
+            }
+        ),
     }
 )
 

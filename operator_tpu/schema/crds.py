@@ -52,6 +52,19 @@ class PodmortemSpec:
 
 
 @dataclass
+class FailureRecurrence:
+    """status.recentFailures[].recurrence — how incident memory classified
+    this failure (operator_tpu/memory/): the stable fingerprint, how often
+    the class has been seen fleet-wide, and whether the stored analysis
+    was reused instead of re-generated."""
+
+    fingerprint: Optional[str] = None
+    seen_count: int = 0
+    first_seen: Optional[str] = None
+    reused_analysis: bool = False
+
+
+@dataclass
 class PodFailureStatus:
     """One entry of status.recentFailures (reference podmortem-crd.yaml:68-82,
     written by AnalysisStorageService.java:286-333)."""
@@ -66,6 +79,8 @@ class PodFailureStatus:
     #: completed | truncated (max_tokens clamped to fit the residual
     #: budget) | deadline-exceeded (degraded to pattern-only)
     deadline_outcome: Optional[str] = None
+    #: incident-memory classification (None when memory is disabled)
+    recurrence: Optional[FailureRecurrence] = None
 
 
 @dataclass

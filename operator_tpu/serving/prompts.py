@@ -47,6 +47,31 @@ Fix: <the most direct remediation>"""
 #: BASELINE config 4)
 MAX_EVIDENCE_CHARS = 1600
 MAX_TAIL_CHARS = 1200
+#: retrieval-augmented context from incident memory (near-miss recall,
+#: operator_tpu/memory/recall.py) rides the SAME budget discipline —
+#: injecting prior incidents must never blow up the prefill bucket
+MAX_PRIOR_INCIDENT_CHARS = 1200
+
+
+def pack_blocks(blocks: "list[str]", budget: int, *, sep: str = "\n---\n") -> str:
+    """The one budget-aware block packer every prompt section uses: take
+    blocks in order, truncating the block that crosses the char budget and
+    dropping the rest.  Evidence selection and prior-incident injection
+    share this so neither can silently exceed its slice of the prompt."""
+    kept: list[str] = []
+    used = 0
+    for block in blocks:
+        block = block.strip()
+        if not block:
+            continue
+        remaining = budget - used
+        if remaining <= 0:
+            break
+        if len(block) > remaining:
+            block = block[:remaining]
+        kept.append(block)
+        used += len(block)
+    return sep.join(kept)
 
 
 def _pattern_summary(result: Optional[AnalysisResult]) -> str:
@@ -63,22 +88,40 @@ def _pattern_summary(result: Optional[AnalysisResult]) -> str:
 def _evidence(result: Optional[AnalysisResult]) -> str:
     if result is None:
         return "(none)"
+    blocks = [
+        event.context.render()
+        for event in result.top_events(3)
+        if event.context is not None
+    ]
+    return pack_blocks(blocks, MAX_EVIDENCE_CHARS) or "(none)"
+
+
+def prior_incident_section(request: AnalysisRequest) -> str:
+    """Render near-miss recalls as an appended prompt section ("" when
+    there are none).  Appended AFTER the template so the static preamble —
+    and its shared-prefix KV registration — is untouched."""
+    priors = request.prior_incidents
+    if not priors:
+        return ""
     blocks = []
-    used = 0
-    for event in result.top_events(3):
-        if event.context is None:
+    for i, prior in enumerate(priors):
+        if not prior.explanation:
             continue
-        block = event.context.render().strip()
-        if not block:
-            continue
-        remaining = MAX_EVIDENCE_CHARS - used
-        if remaining <= 0:
-            break
-        if len(block) > remaining:
-            block = block[:remaining]
-        blocks.append(block)
-        used += len(block)
-    return "\n---\n".join(blocks) if blocks else "(none)"
+        head = (
+            f"[{i + 1}] similarity {prior.score:.2f}, "
+            f"seen {prior.seen_count}x"
+            + (f", severity {prior.severity}" if prior.severity else "")
+            + (f", last {prior.last_seen}" if prior.last_seen else "")
+        )
+        blocks.append(f"{head}\n{prior.explanation}")
+    body = pack_blocks(blocks, MAX_PRIOR_INCIDENT_CHARS)
+    if not body:
+        return ""
+    return (
+        "\n\nSimilar previously-analyzed incidents (for context; this "
+        "failure is NOT identical to them — diagnose the evidence above "
+        "on its own merits):\n" + body
+    )
 
 
 def build_warmup_prompt() -> str:
@@ -148,7 +191,11 @@ def build_prompt(request: AnalysisRequest) -> str:
         "log_tail": log_tail or "(no logs)",
     }
     try:
-        return template.format(**fields)
+        rendered = template.format(**fields)
     except (KeyError, IndexError, ValueError):
         # user template with unknown placeholders: fall back to default
-        return DEFAULT_TEMPLATE.format(**fields)
+        rendered = DEFAULT_TEMPLATE.format(**fields)
+    # retrieval-augmented context (near-miss recall) appends AFTER the
+    # render: the template's static preamble stays byte-identical, so the
+    # shared-prefix KV cache keeps matching these prompts
+    return rendered + prior_incident_section(request)

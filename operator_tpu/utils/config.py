@@ -56,6 +56,37 @@ class OperatorConfig:
     breaker_failure_threshold: int = 5
     breaker_reset_s: float = 30.0
 
+    # --- incident memory (operator_tpu/memory/, docs/MEMORY.md) -----------
+    # recall across failures: exact fingerprint hit reuses the stored
+    # analysis (AI leg skipped), near hit injects prior incidents into the
+    # prompt, miss analyzes then remembers
+    memory_enabled: bool = True
+    # JSONL journal path (crash-safe append); unset = in-memory only.
+    # The shipped deployment points it at the pattern-cache PVC.
+    memory_path: Optional[str] = None
+    memory_max_entries: int = 2048
+    memory_ttl_s: float = 604800.0  # 7d; 0 = no TTL (LRU bound only)
+    # near-miss similarity threshold; 0 = the embedder's own default
+    # (lexical hashing 0.3, MiniLM 0.45 — patterns/semantic.py)
+    recall_threshold: float = 0.0
+    recall_top_k: int = 3
+    # ConfigMap name for PVC-less durability (snapshot flushed at most
+    # every memory_flush_interval_s); empty = off
+    memory_configmap: str = ""
+    memory_flush_interval_s: float = 30.0
+    # bearer token required by GET /incidents* on the health port ("" =
+    # open, like the probes) — incident records quote log evidence, which
+    # can carry secrets, so fleets with untrusted pod networks set this
+    incidents_api_token: str = ""
+
+    # --- storage text caps ------------------------------------------------
+    # Kubernetes rejects objects whose TOTAL annotations exceed 256 KiB;
+    # the stored AI text is truncated at this cap with an explicit
+    # "…[truncated]" marker (full text still goes to CR status, itself
+    # capped below against the ~1.5 MiB etcd object limit)
+    max_annotation_chars: int = 8192
+    max_status_explanation_chars: int = 32768
+
     # --- health / metrics endpoint (reference operator-deployment.yaml:61-78
     # probes /q/health/*; ours serves /healthz/* + /metrics) ---------------
     health_host: str = "0.0.0.0"

@@ -401,6 +401,145 @@ def test_per_cr_deadline_override_tightens_envelope():
     run(scenario())
 
 
+def _memory_chaos_plan(seed: int) -> FaultPlan:
+    """409 storm on BOTH storage writes + a pod watch-stream drop, the
+    storm the incident store must stay consistent under."""
+    plan = FaultPlan(seed=seed)
+    plan.rule(
+        "kube.watch.Pod",
+        raise_(lambda: WatchClosed("injected stream drop"), "drop"),
+        after=1,
+    )
+    plan.rule(
+        "kube.patch_status",
+        times(4, raise_(lambda: ConflictError("injected conflict"), "409")),
+        match=lambda kind, name: kind == "Podmortem",
+    )
+    plan.rule(
+        "kube.patch",
+        times(2, raise_(lambda: ConflictError("injected conflict"), "409")),
+        match=lambda kind, name: kind == "Pod",
+    )
+    return plan
+
+
+async def _run_memory_chaos(plan: FaultPlan, journal_path: str) -> dict:
+    """Two pods fail identically (the second AFTER the first analysis
+    lands, so recall sees a stored incident) while the plan's 409 storm
+    and watch drop fire.  Returns the observable memory state."""
+    api = FakeKubeApi()
+    api.fault_plan = plan
+    config = OperatorConfig(
+        pattern_cache_directory="/nonexistent",
+        watch_restart_delay_s=0.01,
+        conflict_backoff_base_s=0.001,
+        memory_path=journal_path,
+    )
+    metrics = MetricsRegistry()
+    pipeline = AnalysisPipeline(
+        api, PatternEngine(), config=config, metrics=metrics,
+        providers=default_registry(),
+    )
+    cache = PodmortemCache(api, resync_delay_s=0.01)
+    watcher = PodFailureWatcher(
+        api, pipeline, config=config, metrics=metrics, cache=cache
+    )
+    await api.create("AIProvider", AIProvider(
+        metadata=ObjectMeta(name="prov", namespace="ns"),
+        spec=AIProviderSpec(provider_id="template", model_id="m"),
+    ).to_dict())
+    await api.create("Podmortem", Podmortem(
+        metadata=ObjectMeta(name="pm", namespace="ns"),
+        spec=PodmortemSpec(
+            pod_selector=LabelSelector(match_labels={"app": "web"}),
+            ai_provider_ref=AIProviderRef(name="prov", namespace="ns"),
+        ),
+    ).to_dict())
+
+    oom_log = "java.lang.OutOfMemoryError: Java heap space"
+    stop = asyncio.Event()
+    task = asyncio.create_task(watcher.run(stop))
+    await watcher.cache.wait_ready(5)
+
+    async def wait_failures(n):
+        for _ in range(500):
+            status = (await api.get("Podmortem", "pm", "ns")).get("status") or {}
+            if len(status.get("recentFailures") or []) >= n:
+                return
+            await asyncio.sleep(0.02)
+        raise AssertionError(f"never reached {n} stored failures")
+
+    pod1 = failed_pod(name="web-1")
+    api.set_pod_log("prod", "web-1", oom_log)
+    await api.create("Pod", pod1.to_dict())
+    await wait_failures(1)
+    pod2 = failed_pod(name="web-2")
+    api.set_pod_log("prod", "web-2", oom_log)
+    await api.create("Pod", pod2.to_dict())
+    await wait_failures(2)
+    await watcher.drain()
+    stop.set()
+    api.close_watches()
+    await asyncio.wait_for(asyncio.gather(task, return_exceptions=True), 5)
+
+    incidents = pipeline.memory.store.all()
+    pipeline.memory.close()
+    # reload the journal from disk: the crash-safe append must reproduce
+    # exactly the live store (no duplicate, no lost incident)
+    from operator_tpu.memory import IncidentStore
+
+    reloaded = IncidentStore(journal_path)
+    replayed = reloaded.all()
+    reloaded.close()
+    status = (await api.get("Podmortem", "pm", "ns")).get("status") or {}
+    return {
+        "trace": plan.trace(),
+        "pending": plan.pending(),
+        "incidents": [
+            (i.fingerprint, i.seen_count, i.reused_count, i.explanation)
+            for i in incidents
+        ],
+        "replayed": [
+            (i.fingerprint, i.seen_count, i.reused_count, i.explanation)
+            for i in replayed
+        ],
+        "recurrences": [
+            (f.get("recurrence") or {}).get("reusedAnalysis")
+            for f in status.get("recentFailures") or []
+        ],
+        "counters": {
+            k: v for k, v in metrics.snapshot()["counters"].items()
+            if k.startswith("recall_")
+        },
+    }
+
+
+def test_incident_store_consistent_under_replayed_chaos(tmp_path):
+    """The 409 + watch-drop storm replayed twice: byte-identical fault
+    traces, and in both runs the store converges to EXACTLY ONE incident
+    seen twice (one miss, one reused hit) whose journal replays to the
+    same state — no duplicate, no lost incident, no phantom recurrence."""
+    out_a = run(_run_memory_chaos(_memory_chaos_plan(seed=7),
+                                  str(tmp_path / "a" / "incidents.jsonl")))
+    out_b = run(_run_memory_chaos(_memory_chaos_plan(seed=7),
+                                  str(tmp_path / "b" / "incidents.jsonl")))
+
+    assert out_a["trace"] == out_b["trace"], "fault replay diverged"
+    assert out_a["pending"] == {}, f"planned faults never fired: {out_a['pending']}"
+
+    for out in (out_a, out_b):
+        assert len(out["incidents"]) == 1, out["incidents"]
+        _, seen, reused, explanation = out["incidents"][0]
+        assert seen == 2 and reused == 1
+        assert explanation and explanation.startswith("Root Cause:")
+        # disk state == live state, entry for entry
+        assert out["replayed"] == out["incidents"]
+        # newest-first status: the second failure reused, the first did not
+        assert out["recurrences"] == [True, False]
+        assert out["counters"] == {"recall_miss": 1, "recall_hit": 1}
+    assert out_a["incidents"] == out_b["incidents"]
+
+
 def test_circuit_breaker_trips_opens_and_half_open_recovers():
     """Five consecutive backend failures trip the breaker (AI skipped, no
     budget burned); after the reset window one half-open probe flows and a

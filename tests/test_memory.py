@@ -1,0 +1,747 @@
+"""Incident memory: fingerprint stability, store durability/eviction,
+recall policy (exact-hit bypass, near-hit injection, miss-then-remember),
+and the operator surfaces (CR status recurrence, /incidents endpoints,
+ConfigMap snapshot).
+
+The acceptance contract (ISSUE 2): a replayed identical failure skips the
+AI leg entirely (backend call count unchanged), stores the same analysis
+byte-identically with ``recurrence.reusedAnalysis: true``, and increments
+``podmortem_recall_hit_total``.
+"""
+
+import asyncio
+import json
+import os
+import pathlib
+
+import pytest
+
+from operator_tpu.memory import (
+    RECALL_HIT,
+    RECALL_MISS,
+    RECALL_NEAR,
+    Incident,
+    IncidentIndex,
+    IncidentMemory,
+    IncidentStore,
+    failure_fingerprint,
+    normalize_line,
+)
+from operator_tpu.operator.kubeapi import FakeKubeApi
+from operator_tpu.operator.pipeline import AnalysisPipeline
+from operator_tpu.operator.providers import default_registry
+from operator_tpu.patterns.engine import PatternEngine
+from operator_tpu.schema import (
+    AIProvider,
+    AIProviderRef,
+    AIProviderSpec,
+    LabelSelector,
+    ObjectMeta,
+    Podmortem,
+    PodmortemSpec,
+)
+from operator_tpu.schema.analysis import (
+    AIResponse,
+    AnalysisEvent,
+    AnalysisResult,
+    AnalysisSummary,
+    MatchContext,
+    MatchedPattern,
+    PodFailureData,
+)
+from operator_tpu.utils.config import OperatorConfig
+from operator_tpu.utils.timing import MetricsRegistry
+
+from test_watcher_pipeline import failed_pod
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _result(pattern_id: str, line: str, severity: str = "HIGH") -> AnalysisResult:
+    return AnalysisResult(
+        summary=AnalysisSummary(highest_severity=severity, significant_events=1),
+        events=[AnalysisEvent(
+            score=0.9,
+            matched_pattern=MatchedPattern(id=pattern_id, name=pattern_id, severity=severity),
+            context=MatchContext(line_number=1, matched_line=line),
+        )],
+    )
+
+
+# --------------------------------------------------------------------------
+# fingerprint
+# --------------------------------------------------------------------------
+
+
+class TestFingerprint:
+    NOISY = [
+        "2026-07-28T09:14:03.123Z ERROR pod payment-7f9c6d-x2b9z died at 0x7fff3a2b",
+        "connection to 10.42.0.17:5432 refused (attempt 3, id 550e8400-e29b-41d4-a716-446655440000)",
+        "09:14:03,991 worker-12 OOM killed after 137s rss=4096MB",
+    ]
+
+    def test_normalize_is_idempotent(self):
+        for line in self.NOISY:
+            once = normalize_line(line)
+            assert normalize_line(once) == once
+
+    def test_normalize_strips_run_specific_noise(self):
+        a = normalize_line(
+            "2026-07-28T09:14:03Z pod payment-7f9c6d-x2b9z oom at 10.42.0.17:5432 req 0xdeadbeef")
+        b = normalize_line(
+            "2026-07-30T11:02:55Z pod payment-8a1b2c-k9m3x oom at 10.42.9.201:5432 req 0xcafebabe")
+        assert a == b
+        # but plain hyphenated words survive (no digit in the suffix)
+        assert "half-open" in normalize_line("breaker went half-open")
+
+    def test_identical_failures_across_pods_share_a_digest(self):
+        line = "java.lang.OutOfMemoryError: Java heap space"
+        fp1 = failure_fingerprint(_result("oom", line), failed_pod(name="web-1"))
+        fp2 = failure_fingerprint(_result("oom", line), failed_pod(name="web-2",
+                                                                   finished_at="2026-07-29T01:00:00Z"))
+        assert fp1.digest == fp2.digest
+        assert fp1.pattern_ids == ("oom",)
+
+    def test_distinct_failure_classes_do_not_collide(self):
+        engine = PatternEngine()
+        digests = set()
+        for fixture in ("oom_java.log", "dns_failure.log", "disk_full.log",
+                        "image_pull_backoff.log", "tls_cert.log"):
+            logs = (FIXTURES / fixture).read_text()
+            result = engine.analyze(PodFailureData(logs=logs))
+            fp = failure_fingerprint(result, failed_pod())
+            digests.add(fp.digest)
+        assert len(digests) == 5, "fixture failure classes collided"
+
+    def test_exit_code_and_reason_participate(self):
+        line = "container terminated"
+        base = failure_fingerprint(_result("p", line), failed_pod(exit_code=1))
+        oom = failure_fingerprint(_result("p", line),
+                                  failed_pod(exit_code=137, reason="OOMKilled"))
+        assert base.digest != oom.digest
+
+    def test_weak_fingerprint_is_never_stored_or_reused(self):
+        """No matched patterns + no evidence = only (exit code, reason):
+        two UNRELATED apps dying with exit 1 would collide, so such
+        failures always take the full analysis path."""
+        empty = AnalysisResult()  # nothing matched
+        fp = failure_fingerprint(empty, failed_pod(exit_code=1))
+        assert fp.is_weak
+        memory = IncidentMemory()
+        assert memory.insert(fp, empty, failed_pod(),
+                             AIResponse(explanation="app A's root cause")) is None
+        assert len(memory.store) == 0
+        out = memory.recall(empty, failed_pod(name="totally-different-app"))
+        assert out.kind == RECALL_MISS and out.incident is None
+
+
+# --------------------------------------------------------------------------
+# store
+# --------------------------------------------------------------------------
+
+
+def _incident(fp: str, explanation="Root Cause: X.", **kw) -> Incident:
+    return Incident(fingerprint=fp, template=f"tpl {fp}", explanation=explanation, **kw)
+
+
+class TestStore:
+    def test_lru_eviction_bound(self):
+        store = IncidentStore(max_entries=3, ttl_s=0)
+        for i in range(5):
+            store.upsert(_incident(f"fp{i}"))
+        assert len(store) == 3
+        assert store.get("fp0") is None and store.get("fp1") is None
+        assert store.get("fp4") is not None
+
+    def test_ttl_eviction(self):
+        clock = {"t": 1000.0}
+        store = IncidentStore(max_entries=100, ttl_s=60.0, clock=lambda: clock["t"])
+        store.upsert(_incident("old"))
+        clock["t"] += 61.0
+        store.upsert(_incident("new"))
+        assert store.get("old") is None
+        assert store.get("new") is not None
+        # expire() alone also sweeps
+        clock["t"] += 61.0
+        evicted = store.expire()
+        assert evicted == ["new"] and len(store) == 0
+
+    def test_journal_survives_reopen(self, tmp_path):
+        path = str(tmp_path / "incidents.jsonl")
+        store = IncidentStore(path)
+        store.upsert(_incident("a", explanation="Root Cause: A."))
+        store.record_recurrence("a", reused=True)
+        store.upsert(_incident("b"))
+        store.close()
+
+        reopened = IncidentStore(path)
+        assert len(reopened) == 2
+        a = reopened.get("a")
+        assert a.explanation == "Root Cause: A."
+        assert a.seen_count == 2 and a.reused_count == 1
+        reopened.close()
+
+    def test_torn_journal_tail_is_skipped(self, tmp_path):
+        path = tmp_path / "incidents.jsonl"
+        store = IncidentStore(str(path))
+        store.upsert(_incident("a"))
+        store.close()
+        with open(path, "a") as f:
+            f.write('{"op": "put", "incident": {"finger')  # crash mid-append
+        reopened = IncidentStore(str(path))
+        assert len(reopened) == 1 and reopened.get("a") is not None
+        reopened.close()
+
+    def test_journal_compacts(self, tmp_path):
+        path = tmp_path / "incidents.jsonl"
+        store = IncidentStore(str(path), compact_factor=2)
+        store.upsert(_incident("a"))
+        for _ in range(200):
+            store.record_recurrence("a")
+        store.close()
+        lines = path.read_text().strip().splitlines()
+        assert len(lines) < 100, "journal never compacted"
+        reopened = IncidentStore(str(path))
+        assert reopened.get("a").seen_count == 201
+        reopened.close()
+
+    def test_snapshot_roundtrip_and_size_guard(self):
+        store = IncidentStore()
+        for i in range(10):
+            store.upsert(_incident(f"fp{i}", explanation="X" * 100))
+        text = store.snapshot(max_bytes=1500)
+        assert len(text) <= 1500
+        other = IncidentStore()
+        loaded = other.load_snapshot(text)
+        assert 0 < loaded < 10  # newest kept, oldest dropped by the guard
+        full = IncidentStore()
+        assert full.load_snapshot(store.snapshot()) == 10
+
+
+# --------------------------------------------------------------------------
+# recall policy
+# --------------------------------------------------------------------------
+
+
+class _CountingBackend:
+    def __init__(self):
+        self.calls = 0
+
+    async def generate(self, request):
+        self.calls += 1
+        self.last_request = request
+        return AIResponse(
+            explanation=f"Root Cause: generated #{self.calls}.\nFix: fix it.",
+            provider_id="counting", model_id="m",
+        )
+
+
+async def _pipeline_stack(config=None, memory=None):
+    api = FakeKubeApi()
+    metrics = MetricsRegistry()
+    config = config or OperatorConfig(conflict_backoff_base_s=0.001)
+    providers = default_registry()
+    backend = _CountingBackend()
+    providers.register("counting", backend)
+    pipeline = AnalysisPipeline(
+        api, PatternEngine(), config=config, metrics=metrics,
+        providers=providers, memory=memory,
+    )
+    await api.create("AIProvider", AIProvider(
+        metadata=ObjectMeta(name="prov", namespace="ns"),
+        spec=AIProviderSpec(provider_id="counting", model_id="m"),
+    ).to_dict())
+    pm = Podmortem(
+        metadata=ObjectMeta(name="pm", namespace="ns"),
+        spec=PodmortemSpec(
+            pod_selector=LabelSelector(match_labels={"app": "web"}),
+            ai_provider_ref=AIProviderRef(name="prov", namespace="ns"),
+        ),
+    )
+    await api.create("Podmortem", pm.to_dict())
+    return api, pipeline, pm, backend, metrics
+
+
+OOM_LOG = ("java.lang.OutOfMemoryError: Java heap space\n"
+           "    at com.example.Worker.alloc(Worker.java:42)")
+
+
+def test_exact_hit_bypasses_ai_leg_byte_identically():
+    async def body():
+        api, pipeline, pm, backend, metrics = await _pipeline_stack()
+        for name, ft in (("web-1", "t1"), ("web-2", "t2")):
+            pod = failed_pod(name=name)
+            await api.create("Pod", pod.to_dict())
+            api.set_pod_log("prod", name, OOM_LOG)
+            await pipeline.process_pod_failure(pod, pm, failure_time=ft)
+
+        # the replayed failure skipped generation: ONE backend call total
+        assert backend.calls == 1
+        assert metrics.counter("recall_hit") == 1
+        assert metrics.counter("recall_miss") == 1
+        assert "podmortem_recall_hit_total 1" in metrics.prometheus()
+        # the returned deadline budget is visible as a stage metric
+        assert metrics.stage("recall_budget_returned").count == 1
+
+        status = (await api.get("Podmortem", "pm", "ns"))["status"]
+        newest, oldest = status["recentFailures"][0], status["recentFailures"][1]
+        assert newest["explanation"] == oldest["explanation"]  # byte-identical
+        assert newest["analysisStatus"] == "Analyzed"
+        assert newest["recurrence"]["reusedAnalysis"] is True
+        assert newest["recurrence"]["seenCount"] == 2
+        assert oldest["recurrence"]["reusedAnalysis"] is False
+        assert newest["recurrence"]["fingerprint"] == oldest["recurrence"]["fingerprint"]
+        # durable marker stamped on the reused (final) result too
+        annotations = (await api.get("Pod", "web-2", "prod"))["metadata"]["annotations"]
+        assert annotations["podmortem.io/analyzed-failure"] == "t2"
+
+    run(body())
+
+
+def test_pattern_only_recurrence_tracked_but_never_reused():
+    """A class first stored without AI text (provider failing) keeps being
+    re-analyzed — recurrence counts, no stale reuse — and gains its
+    analysis when the backend recovers."""
+
+    class Flaky:
+        def __init__(self):
+            self.healthy = False
+            self.calls = 0
+
+        async def generate(self, request):
+            self.calls += 1
+            if not self.healthy:
+                raise RuntimeError("backend down")
+            return AIResponse(explanation="Root Cause: recovered.", provider_id="flaky")
+
+    async def body():
+        api = FakeKubeApi()
+        metrics = MetricsRegistry()
+        providers = default_registry()
+        backend = Flaky()
+        providers.register("flaky", backend)
+        pipeline = AnalysisPipeline(
+            api, PatternEngine(),
+            config=OperatorConfig(conflict_backoff_base_s=0.001),
+            metrics=metrics, providers=providers,
+        )
+        await api.create("AIProvider", AIProvider(
+            metadata=ObjectMeta(name="prov", namespace="ns"),
+            spec=AIProviderSpec(provider_id="flaky"),
+        ).to_dict())
+        pm = Podmortem(metadata=ObjectMeta(name="pm", namespace="ns"),
+                       spec=PodmortemSpec(ai_provider_ref=AIProviderRef(name="prov", namespace="ns")))
+        await api.create("Podmortem", pm.to_dict())
+        pod = failed_pod()
+        await api.create("Pod", pod.to_dict())
+        api.set_pod_log("prod", "web-1", OOM_LOG)
+
+        await pipeline.process_pod_failure(pod, pm, failure_time="t1")
+        assert backend.calls == 1 and metrics.counter("recall_hit") == 0
+        backend.healthy = True
+        await pipeline.process_pod_failure(pod, pm, failure_time="t2")
+        assert backend.calls == 2  # no reuse of the failed (empty) analysis
+        await pipeline.process_pod_failure(pod, pm, failure_time="t3")
+        assert backend.calls == 2  # NOW the stored analysis is reusable
+        assert metrics.counter("recall_hit") == 1
+        status = (await api.get("Podmortem", "pm", "ns"))["status"]
+        assert status["recentFailures"][0]["recurrence"]["seenCount"] == 3
+
+    run(body())
+
+
+class TestNearHitThreshold:
+    """Near-miss behaviour with both embedder families."""
+
+    def _memory(self, embedder=None, **kw) -> IncidentMemory:
+        return IncidentMemory(embedder=embedder, **kw)
+
+    def _seed(self, memory: IncidentMemory, pattern: str, line: str, text: str):
+        result = _result(pattern, line)
+        fp = failure_fingerprint(result, failed_pod())
+        memory.insert(fp, result, failed_pod(),
+                      AIResponse(explanation=text, provider_id="p"))
+        return fp
+
+    def test_hashing_embedder_near_then_miss(self):
+        memory = self._memory()  # lexical default threshold 0.3
+        self._seed(memory, "oom-killed",
+                   "java.lang.OutOfMemoryError: Java heap space exhausted",
+                   "Root Cause: JVM heap exhaustion.")
+        # a paraphrase of the same class: different fingerprint, high
+        # lexical overlap -> near, with the prior attached
+        near = memory.recall(
+            _result("oom-heap", "OutOfMemoryError while growing Java heap arena"),
+            failed_pod(name="other"),
+        )
+        assert near.kind == RECALL_NEAR
+        assert near.neighbors and near.neighbors[0][0].explanation.startswith("Root Cause: JVM")
+        assert near.neighbors[0][1] >= memory.near_threshold
+        # an unrelated failure scores under the threshold -> miss
+        miss = memory.recall(
+            _result("dns", "lookup backend.svc on resolver: NXDOMAIN"),
+            failed_pod(name="misc"),
+        )
+        assert miss.kind == RECALL_MISS
+
+    def test_neural_embedder_threshold_is_honoured(self):
+        jax = pytest.importorskip("jax")
+        from operator_tpu.models.encoder import EncoderConfig, init_encoder_params
+        from operator_tpu.patterns.semantic import NeuralEmbedder
+
+        config = EncoderConfig(name="tiny", vocab_size=64, hidden_size=32,
+                               intermediate_size=64, num_layers=2, num_heads=4,
+                               max_positions=64)
+        params = init_encoder_params(config, jax.random.PRNGKey(0))
+        embedder = NeuralEmbedder(
+            params, config, lambda text: [b % 64 for b in text.encode()][:32],
+        )
+
+        # an effectively-unreachable threshold: nothing is near
+        strict = self._memory(embedder=embedder, near_threshold=0.9999)
+        self._seed(strict, "oom", "OutOfMemoryError heap", "Root Cause: heap.")
+        out = strict.recall(_result("oom2", "OutOfMemoryError heap space"),
+                            failed_pod(name="n"))
+        assert out.kind == RECALL_MISS
+
+        # a permissive threshold admits the same neighbour
+        loose = self._memory(embedder=embedder, near_threshold=0.0001)
+        self._seed(loose, "oom", "OutOfMemoryError heap", "Root Cause: heap.")
+        out = loose.recall(_result("oom2", "OutOfMemoryError heap space"),
+                           failed_pod(name="n"))
+        assert out.kind == RECALL_NEAR
+        assert out.neighbors[0][1] <= 1.0 + 1e-6
+
+    def test_exact_hit_beats_near(self):
+        memory = self._memory()
+        result = _result("oom", "java.lang.OutOfMemoryError: heap")
+        fp = self._seed(memory, "oom", "java.lang.OutOfMemoryError: heap",
+                        "Root Cause: heap.")
+        out = memory.recall(result, failed_pod(name="web-9"))
+        assert out.kind == RECALL_HIT and out.incident.fingerprint == fp.digest
+
+
+def test_near_hit_injects_priors_into_prompt():
+    async def body():
+        api, pipeline, pm, backend, metrics = await _pipeline_stack()
+        pod1 = failed_pod(name="web-1")
+        await api.create("Pod", pod1.to_dict())
+        api.set_pod_log("prod", "web-1",
+                        "java.lang.OutOfMemoryError: Java heap space exhausted")
+        await pipeline.process_pod_failure(pod1, pm, failure_time="t1")
+
+        # same class phrased differently (regex still matches oom patterns,
+        # but different evidence line -> different fingerprint)
+        pod2 = failed_pod(name="api-1", labels={"app": "web"})
+        await api.create("Pod", pod2.to_dict())
+        api.set_pod_log("prod", "api-1",
+                        "java.lang.OutOfMemoryError: GC overhead limit exceeded in Java heap")
+        await pipeline.process_pod_failure(pod2, pm, failure_time="t2")
+
+        assert backend.calls == 2
+        assert metrics.counter("recall_near") == 1
+        request = backend.last_request
+        assert request.prior_incidents, "near-hit priors not injected"
+        from operator_tpu.serving.prompts import build_prompt
+
+        prompt = build_prompt(request)
+        assert "Similar previously-analyzed incidents" in prompt
+        assert "generated #1" in prompt
+        # linked on the stored incident
+        stored = pipeline.memory.store.all()
+        assert any(request.prior_incidents[0].fingerprint in inc.related
+                   for inc in stored)
+
+    run(body())
+
+
+def test_hit_requires_matching_provider_ref():
+    """One CR's stored analysis is never replayed for a CR with a
+    different AIProvider ref — reuse identity includes WHO generated it."""
+
+    async def body():
+        api, pipeline, pm, backend, metrics = await _pipeline_stack()
+        # a second CR, same pod selector, DIFFERENT provider (template)
+        await api.create("AIProvider", AIProvider(
+            metadata=ObjectMeta(name="other-prov", namespace="ns"),
+            spec=AIProviderSpec(provider_id="template", model_id="m"),
+        ).to_dict())
+        pm2 = Podmortem(
+            metadata=ObjectMeta(name="pm2", namespace="ns"),
+            spec=PodmortemSpec(
+                pod_selector=LabelSelector(match_labels={"app": "web"}),
+                ai_provider_ref=AIProviderRef(name="other-prov", namespace="ns"),
+            ),
+        )
+        await api.create("Podmortem", pm2.to_dict())
+        pod = failed_pod()
+        await api.create("Pod", pod.to_dict())
+        api.set_pod_log("prod", "web-1", OOM_LOG)
+        # CR 1 (counting backend) analyzes and seeds memory
+        await pipeline.process_pod_failure(pod, pm, failure_time="t1")
+        assert backend.calls == 1
+        # CR 2 (template provider) must NOT get the counting backend's
+        # text — its own provider runs
+        await pipeline.process_pod_failure(pod, pm2, failure_time="t1")
+        assert metrics.counter("recall_hit") == 0
+        status = (await api.get("Podmortem", "pm2", "ns"))["status"]
+        assert "generated #" not in status["recentFailures"][0]["explanation"]
+        # while CR 1 replaying the failure DOES hit
+        await pipeline.process_pod_failure(pod, pm, failure_time="t2")
+        assert backend.calls == 1 and metrics.counter("recall_hit") == 1
+
+    run(body())
+
+
+def test_incident_endpoints_honour_bearer_token():
+    from operator_tpu.operator.health import LivenessCheck, ReadinessCheck
+    from operator_tpu.operator.httpserver import HealthServer
+
+    async def body():
+        api = FakeKubeApi()
+        server = HealthServer(
+            LivenessCheck(),
+            ReadinessCheck(api, OperatorConfig(pattern_cache_directory="/nonexistent")),
+            metrics=MetricsRegistry(), memory=IncidentMemory(),
+            incidents_token="s3cret", host="127.0.0.1", port=0,
+        )
+        await server.start()
+        try:
+            async def get(path, token=None):
+                reader, writer = await asyncio.open_connection("127.0.0.1", server.bound_port)
+                auth = f"Authorization: Bearer {token}\r\n" if token else ""
+                writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n{auth}\r\n".encode())
+                await writer.drain()
+                raw = await reader.read()
+                writer.close()
+                await writer.wait_closed()
+                return int(raw.split()[1])
+
+            assert await get("/incidents") == 401
+            assert await get("/incidents", token="wrong") == 401
+            assert await get("/incidents", token="s3cret") == 200
+            # probes stay open — the kubelet sends no token
+            assert await get("/healthz/live") == 200
+        finally:
+            await server.stop()
+
+    run(body())
+
+
+def test_truncated_analysis_is_not_cached_for_reuse():
+    """A deadline-truncated (or errored) explanation must never be frozen
+    into memory — the next occurrence re-analyzes with its own budget."""
+    memory = IncidentMemory()
+    result = _result("oom", "java.lang.OutOfMemoryError: heap")
+    fp = failure_fingerprint(result, failed_pod())
+    memory.insert(fp, result, failed_pod(), AIResponse(
+        explanation="Root Cause: the JVM ran ou",  # cut off mid-sentence
+        deadline_outcome="truncated",
+    ))
+    assert memory.store.get(fp.digest).explanation is None
+    out = memory.recall(result, failed_pod(name="web-2"))
+    assert out.kind != RECALL_HIT
+    # errored responses are equally non-reusable
+    memory.insert(fp, result, failed_pod(), AIResponse(
+        explanation="partial", error="backend died mid-stream"))
+    assert memory.store.get(fp.digest).explanation is None
+    # and a clean completion finally becomes the reusable analysis
+    memory.insert(fp, result, failed_pod(), AIResponse(
+        explanation="Root Cause: full text.", deadline_outcome="completed"))
+    assert memory.store.get(fp.digest).explanation == "Root Cause: full text."
+
+
+def test_concurrent_first_sightings_do_not_undercount():
+    """Two pods of one ReplicaSet crash together: both recalls miss, both
+    analyses insert — the second upsert must still count the sighting."""
+    memory = IncidentMemory()
+    result = _result("oom", "java.lang.OutOfMemoryError: heap")
+    fp = failure_fingerprint(result, failed_pod())
+    # both pipelines ran recall() before either insert(): incident was
+    # None for both, so both pass seen_recorded=False
+    memory.insert(fp, result, failed_pod(name="web-1"),
+                  AIResponse(explanation="RC"), seen_recorded=False)
+    memory.insert(fp, result, failed_pod(name="web-2"),
+                  AIResponse(explanation="RC"), seen_recorded=False)
+    assert memory.store.get(fp.digest).seen_count == 2
+
+
+def test_recall_sweeps_ttl_on_hit_only_workloads():
+    """A store that only ever serves hits still ages incidents out: the
+    TTL sweep rides recall(), evicting from store AND index."""
+    clock = {"t": 1000.0}
+    memory = IncidentMemory(
+        store=IncidentStore(max_entries=100, ttl_s=60.0, clock=lambda: clock["t"])
+    )
+    stale = _result("dns", "lookup backend.svc: NXDOMAIN")
+    stale_fp = failure_fingerprint(stale, failed_pod())
+    memory.insert(stale_fp, stale, failed_pod(), AIResponse(explanation="RC dns"))
+    clock["t"] += 61.0
+    fresh = _result("oom", "java.lang.OutOfMemoryError: heap")
+    out = memory.recall(fresh, failed_pod(name="web-2"))
+    assert out.kind == RECALL_MISS  # the stale prior was swept, not "near"
+    assert len(memory.store) == 0 and len(memory.index) == 0
+
+
+def test_eviction_keeps_index_and_store_in_lockstep():
+    memory = IncidentMemory(store=IncidentStore(max_entries=2, ttl_s=0))
+    fps = []
+    for i, line in enumerate(["alpha failure mode", "beta failure mode",
+                              "gamma failure mode"]):
+        result = _result(f"p{i}", line)
+        fp = failure_fingerprint(result, failed_pod())
+        memory.insert(fp, result, failed_pod(), AIResponse(explanation=f"RC {i}"))
+        fps.append(fp)
+    assert len(memory.store) == 2
+    assert len(memory.index) == 2
+    assert memory.store.get(fps[0].digest) is None
+    # a query never returns the evicted digest
+    for digest, _ in memory.index.query("alpha failure mode", k=3):
+        assert digest != fps[0].digest
+
+
+# --------------------------------------------------------------------------
+# operator surfaces
+# --------------------------------------------------------------------------
+
+
+def test_incident_endpoints_on_health_server():
+    from operator_tpu.operator.health import LivenessCheck, ReadinessCheck
+    from operator_tpu.operator.httpserver import HealthServer
+
+    async def body():
+        api = FakeKubeApi()
+        memory = IncidentMemory()
+        result = _result("oom-killed", "java.lang.OutOfMemoryError: heap")
+        fp = failure_fingerprint(result, failed_pod())
+        memory.insert(fp, result, failed_pod(),
+                      AIResponse(explanation="Root Cause: heap.", provider_id="p"))
+        server = HealthServer(
+            LivenessCheck(),
+            ReadinessCheck(api, OperatorConfig(pattern_cache_directory="/nonexistent")),
+            metrics=MetricsRegistry(), memory=memory, host="127.0.0.1", port=0,
+        )
+        await server.start()
+        try:
+            async def get(path):
+                reader, writer = await asyncio.open_connection("127.0.0.1", server.bound_port)
+                writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+                await writer.drain()
+                raw = await reader.read()
+                writer.close()
+                await writer.wait_closed()
+                head, _, payload = raw.partition(b"\r\n\r\n")
+                return int(head.split()[1]), json.loads(payload)
+
+            status, body = await get("/incidents")
+            assert status == 200 and body["count"] == 1
+            assert body["incidents"][0]["fingerprint"] == fp.digest
+            assert body["incidents"][0]["seenCount"] == 1
+
+            status, body = await get("/incidents/query?q=OutOfMemoryError%20heap&k=2")
+            assert status == 200
+            assert body["matches"][0]["fingerprint"] == fp.digest
+            assert 0.0 < body["matches"][0]["score"] <= 1.0 + 1e-6
+
+            status, body = await get("/incidents/query")
+            assert status == 400
+        finally:
+            await server.stop()
+
+    run(body())
+
+
+def test_configmap_snapshot_roundtrip():
+    async def body():
+        api = FakeKubeApi()
+        memory = IncidentMemory(configmap="podmortem-incidents", flush_interval_s=0.0)
+        result = _result("oom", "java.lang.OutOfMemoryError")
+        fp = failure_fingerprint(result, failed_pod())
+        memory.insert(fp, result, failed_pod(), AIResponse(explanation="RC"))
+        assert await memory.maybe_flush_to_configmap(api, "podmortem-system")
+        cm = await api.get("ConfigMap", "podmortem-incidents", "podmortem-system")
+        assert fp.digest in cm["data"]["incidents"]
+
+        # a fresh (restarted) memory restores from the ConfigMap and can
+        # serve an exact hit straight away
+        restored = IncidentMemory(configmap="podmortem-incidents")
+        assert await restored.restore_from_configmap(api, "podmortem-system") == 1
+        out = restored.recall(result, failed_pod(name="other"))
+        assert out.kind == RECALL_HIT
+
+    run(body())
+
+
+def test_annotation_truncation_marker():
+    from operator_tpu.operator.storage import (
+        AnalysisStorageService,
+        TRUNCATION_MARKER,
+        truncate_marked,
+    )
+
+    assert truncate_marked("short", 100) == "short"
+    cut = truncate_marked("A" * 200, 50)
+    assert len(cut) == 50 and cut.endswith(TRUNCATION_MARKER)
+    # determinism (incident reuse stores byte-identical text)
+    assert truncate_marked("A" * 200, 50) == cut
+    # the hard ceiling counts BYTES (what the apiserver counts): CJK text
+    # under the char cap must still be trimmed to the byte budget
+    wide = truncate_marked("语" * 100, 1000, max_bytes=64)
+    assert len(wide.encode("utf-8")) <= 64
+    assert wide.endswith(TRUNCATION_MARKER)
+
+    async def body():
+        api = FakeKubeApi()
+        config = OperatorConfig(max_annotation_chars=64,
+                                conflict_backoff_base_s=0.001)
+        storage = AnalysisStorageService(api, config)
+        pod = failed_pod()
+        await api.create("Pod", pod.to_dict())
+        pm = Podmortem(metadata=ObjectMeta(name="pm", namespace="ns"))
+        await api.create("Podmortem", pm.to_dict())
+        long_text = "Root Cause: " + "x" * 5000
+        await storage.store_analysis_results(
+            _result("p", "line"), AIResponse(explanation=long_text), pod, pm,
+            failure_time="t",
+        )
+        annotations = (await api.get("Pod", "web-1", "prod"))["metadata"]["annotations"]
+        stored = annotations["podmortem.io/analysis"]
+        assert len(stored) == 64 and stored.endswith(TRUNCATION_MARKER)
+        # CR status keeps the full text (its own, larger cap untouched)
+        status = (await api.get("Podmortem", "pm", "ns"))["status"]
+        assert status["recentFailures"][0]["explanation"] == long_text
+
+    run(body())
+
+
+def test_memory_journal_wired_through_pipeline(tmp_path):
+    """memory_path config -> a pipeline whose recall survives a process
+    restart (new pipeline over the same journal)."""
+
+    async def body():
+        path = str(tmp_path / "incidents.jsonl")
+        config = OperatorConfig(conflict_backoff_base_s=0.001, memory_path=path)
+        api, pipeline, pm, backend, metrics = await _pipeline_stack(config=config)
+        pod = failed_pod()
+        await api.create("Pod", pod.to_dict())
+        api.set_pod_log("prod", "web-1", OOM_LOG)
+        await pipeline.process_pod_failure(pod, pm, failure_time="t1")
+        assert backend.calls == 1
+        pipeline.memory.close()
+        assert os.path.exists(path)
+
+        # "restart": a fresh stack over the same journal reuses immediately
+        api2, pipeline2, pm2, backend2, metrics2 = await _pipeline_stack(config=config)
+        pod2 = failed_pod(name="web-9")
+        await api2.create("Pod", pod2.to_dict())
+        api2.set_pod_log("prod", "web-9", OOM_LOG)
+        await pipeline2.process_pod_failure(pod2, pm2, failure_time="t9")
+        assert backend2.calls == 0, "journal-restored incident was not reused"
+        assert metrics2.counter("recall_hit") == 1
+        pipeline2.memory.close()
+
+    run(body())

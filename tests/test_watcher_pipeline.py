@@ -379,7 +379,7 @@ def test_weightless_tpu_native_never_stores_noise():
     run(body())
 
 
-def test_pipeline_ai_success_and_cache():
+def test_pipeline_ai_success_and_recall():
     async def body():
         api, pipeline, watcher, metrics = await make_stack()
         provider = AIProvider(metadata=ObjectMeta(name="prov", namespace="ns"),
@@ -397,9 +397,42 @@ def test_pipeline_ai_success_and_cache():
         status = (await api.get("Podmortem", "pm", "ns"))["status"]
         assert status["recentFailures"][0]["analysisStatus"] == "Analyzed"
         assert status["recentFailures"][0]["explanation"].startswith("Root Cause:")
-        # second identical failure hits the response cache
+        # a second identical failure is an incident-memory exact hit: the
+        # whole AI leg (and therefore the response cache under it) is
+        # skipped and the stored analysis is reused
+        await pipeline.process_pod_failure(pod, pm, failure_time="t2")
+        assert metrics.counter("recall_hit") == 1
+        assert metrics.counter("ai_cache_hits") == 0
+
+    run(body())
+
+
+def test_pipeline_response_cache_without_memory():
+    """With incident memory disabled the pre-existing per-provider
+    ResponseCache still dedupes identical generations."""
+
+    async def body():
+        config = OperatorConfig(
+            pattern_cache_directory="/nonexistent", watch_restart_delay_s=0.01,
+            conflict_backoff_base_s=0.001, memory_enabled=False,
+        )
+        api, pipeline, watcher, metrics = await make_stack(config=config)
+        assert pipeline.memory is None
+        provider = AIProvider(metadata=ObjectMeta(name="prov", namespace="ns"),
+                              spec=AIProviderSpec(provider_id="template", model_id="m"))
+        await api.create("AIProvider", provider.to_dict())
+        pm = Podmortem(
+            metadata=ObjectMeta(name="pm", namespace="ns"),
+            spec=PodmortemSpec(ai_provider_ref=AIProviderRef(name="prov", namespace="ns")),
+        )
+        await api.create("Podmortem", pm.to_dict())
+        pod = failed_pod()
+        await api.create("Pod", pod.to_dict())
+        api.set_pod_log("prod", "web-1", "java.lang.OutOfMemoryError: Java heap space")
+        await pipeline.process_pod_failure(pod, pm, failure_time="t1")
         await pipeline.process_pod_failure(pod, pm, failure_time="t2")
         assert metrics.counter("ai_cache_hits") == 1
+        assert metrics.counter("recall_hit") == 0
 
     run(body())
 
