@@ -1,0 +1,40 @@
+"""graftlint — JAX/TPU-aware static analysis for the repo's own invariants.
+
+The codebase carries three load-bearing invariant families that ordinary
+linters cannot see:
+
+- **hot-path purity** — nothing reachable from a ``jax.jit``/``pallas_call``
+  entry point may synchronise with the host (``.item()``, ``np.asarray``,
+  ``jax.device_get``, ``block_until_ready``): one stray host sync inside the
+  decode loop serialises the TPU and the p50 SLO dies silently;
+- **deadline propagation** (PR 1, utils/deadline.py) — every blocking
+  external call on the analysis path must spend a budget, not block forever;
+- **lock discipline** — operator/memory state shared between watcher threads
+  and the pipeline must only be touched under its guarding lock.
+
+``python -m operator_tpu.analysis`` runs every registered rule over the
+repo, honours inline ``# graftlint: disable=GLxxx reason=...`` pragmas and a
+committed baseline (``analysis-baseline.json``) of grandfathered findings,
+and exits non-zero on anything new — the CI gate (docs/ANALYSIS.md).
+
+This package imports neither jax nor the runtime modules it analyses (pure
+``ast``), so the gate runs on any box in milliseconds.
+"""
+
+from .baseline import Baseline, load_baseline, write_baseline
+from .core import AnalysisContext, Finding, ModuleSource, Rule
+from .rules import ALL_RULES, rules_by_id
+from .runner import run_analysis
+
+__all__ = [
+    "ALL_RULES",
+    "AnalysisContext",
+    "Baseline",
+    "Finding",
+    "ModuleSource",
+    "Rule",
+    "load_baseline",
+    "rules_by_id",
+    "run_analysis",
+    "write_baseline",
+]

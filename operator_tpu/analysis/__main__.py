@@ -1,0 +1,167 @@
+"""CLI: ``python -m operator_tpu.analysis [--baseline FILE] [paths...]``.
+
+Exit codes: 0 = clean (every finding baselined or suppressed), 1 = new
+findings (or malformed pragmas), 2 = usage error.  ``--fix`` does not exist
+by design — every finding here is a semantic invariant whose correct repair
+needs a human decision (which branch of the degradation ladder, which lock,
+which budget slice); a mechanical rewrite would hide exactly the thinking
+the rule exists to force.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .baseline import Baseline, load_baseline, write_baseline
+from .rules import ALL_RULES, rules_by_id
+from .runner import collect_context, run_analysis
+
+
+def _detect_root(start: Path) -> Path:
+    """Nearest ancestor containing the package (or pyproject) — the repo
+    root all finding paths are relative to."""
+    current = start.resolve()
+    for candidate in [current, *current.parents]:
+        if (candidate / "operator_tpu").is_dir() or (
+            candidate / "pyproject.toml"
+        ).exists():
+            return candidate
+    return current
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m operator_tpu.analysis",
+        description="graftlint: enforce the repo's hot-path, deadline, "
+        "concurrency and generated-artifact invariants (docs/ANALYSIS.md)",
+    )
+    parser.add_argument(
+        "paths", nargs="*", type=Path,
+        help="files/directories to analyse (default: the repo root)",
+    )
+    parser.add_argument(
+        "--root", type=Path, default=None,
+        help="repo root for relative paths + project rules (default: "
+        "auto-detected from cwd)",
+    )
+    parser.add_argument(
+        "--baseline", type=Path, default=None,
+        help="baseline JSON of grandfathered findings (analysis-baseline.json)",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="record the current findings into --baseline and exit 0",
+    )
+    parser.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalogue",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.id}  {rule.name}\n    {rule.description}")
+        return 0
+
+    try:
+        rules = rules_by_id(
+            [r.strip() for r in args.rules.split(",")] if args.rules else None
+        )
+    except KeyError as exc:
+        print(exc.args[0], file=sys.stderr)
+        return 2
+
+    root = (args.root or _detect_root(Path.cwd())).resolve()
+    try:
+        ctx = collect_context(root, args.paths or None)
+    except (FileNotFoundError, ValueError) as exc:
+        print(exc, file=sys.stderr)
+        return 2
+    findings, pragma_errors = run_analysis(ctx, rules)
+
+    if args.write_baseline:
+        if args.baseline is None:
+            print("--write-baseline requires --baseline", file=sys.stderr)
+            return 2
+        if args.rules or args.paths:
+            # a partial run writes a partial baseline, silently dropping
+            # every other rule's grandfathered entries — refuse
+            print(
+                "--write-baseline records the FULL analysis; drop --rules/"
+                "path arguments (a partial baseline would discard the "
+                "other rules' grandfathered findings)",
+                file=sys.stderr,
+            )
+            return 2
+        write_baseline(args.baseline, findings)
+        print(
+            f"baseline written: {len(findings)} finding(s) -> {args.baseline}"
+        )
+        return 0
+
+    baseline = Baseline()
+    if args.baseline is not None:
+        if not args.baseline.exists():
+            # a moved/typo'd baseline must not dress grandfathered debt up
+            # as new regressions — fail loudly like a typo'd source path
+            print(f"no such baseline file: {args.baseline} (create one "
+                  "with --write-baseline)", file=sys.stderr)
+            return 2
+        baseline = load_baseline(args.baseline)
+    new, stale = baseline.filter(findings)
+    # a partial run (--rules/paths) can only vouch for what it ran: an
+    # entry for a rule that did not run is not stale, it is unchecked
+    if args.rules:
+        ran_rules = {rule.id for rule in rules}
+        stale = [key for key in stale if key[0] in ran_rules]
+    if args.paths:
+        analyzed = {m.relpath for m in ctx.modules}
+        stale = [key for key in stale if key[1] in analyzed]
+    new = pragma_errors + new
+
+    if args.format == "json":
+        print(json.dumps(
+            {
+                "findings": [f.__dict__ for f in new],
+                "baselined": len(findings) - (len(new) - len(pragma_errors)),
+                "stale_baseline": [list(k) for k in stale],
+            },
+            indent=2,
+        ))
+        return 1 if new else 0
+
+    for finding in new:
+        print(finding.render())
+    for rule, path, symbol, message in stale:
+        sym = f" [{symbol}]" if symbol else ""
+        print(
+            f"note: stale baseline entry {rule} {path}{sym}: {message!r} "
+            "no longer matches — remove it from the baseline"
+        )
+    if new:
+        print(
+            f"\ngraftlint: {len(new)} finding(s) not in the baseline "
+            "(see docs/ANALYSIS.md; suppress deliberate exceptions with "
+            "`# graftlint: disable=GLxxx reason=...`)"
+        )
+        return 1
+    suppressed = len(findings) - len(new) + len(pragma_errors)
+    print(
+        f"graftlint: clean — {len(ctx.modules)} file(s), "
+        f"{len(ALL_RULES) if not args.rules else len(rules)} rule(s), "
+        f"{suppressed} baselined finding(s)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,225 @@
+"""Framework core: findings, rules, pragma suppression, parsed sources.
+
+A :class:`Rule` sees the whole project at once (:class:`AnalysisContext`)
+because the interesting invariants are cross-file: jit entry points in
+``serving/engine.py`` reach bodies defined in ``serving/programs.py`` and
+``models/llama.py``, and the generated-artifact rule compares code against
+``deploy/``.  Rules that only need one file at a time simply iterate
+``ctx.modules``.
+
+Suppression is explicit and auditable: a finding survives unless the
+offending line (or its enclosing ``def``/``class`` line) carries
+
+    # graftlint: disable=GL001 reason=why this is deliberate
+
+The ``reason=`` clause is mandatory — a pragma without one does NOT
+suppress (it surfaces as a GL000 malformed-pragma finding instead), so
+every exception in the tree documents itself.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterator, Optional
+
+#: pragma grammar (GLxxx = rule id): ``graftlint: disable=GLxxx[,GLyyy] reason=<text to EOL>``
+PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*disable=(?P<rules>[A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)"
+    r"(?P<reason>\s+reason=\S.*)?"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one place.
+
+    ``symbol`` is the enclosing qualified name (``Class.method`` or a
+    module-level function); with ``message`` it forms the baseline identity,
+    so unrelated edits that shift line numbers do not churn the baseline.
+    """
+
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    message: str
+    symbol: str = ""
+
+    def key(self) -> tuple[str, str, str, str]:
+        return (self.rule, self.path, self.symbol, self.message)
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}"
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{where}: {self.rule}: {self.message}{sym}"
+
+
+@dataclass
+class Pragma:
+    line: int
+    rules: tuple[str, ...]
+    has_reason: bool
+    #: the pragma is the whole line (a standalone comment): it also covers
+    #: the next source line, the own-line form used when the inline form
+    #: would not fit
+    standalone: bool = False
+
+
+class ModuleSource:
+    """One parsed Python file: source text, AST (with parent links), the
+    pragma table, and the enclosing-scope index used for symbols and
+    def-level suppression."""
+
+    def __init__(self, root: Path, path: Path) -> None:
+        self.abspath = path
+        self.relpath = path.relative_to(root).as_posix()
+        self.text = path.read_text(encoding="utf-8", errors="replace")
+        self.lines = self.text.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(self.text)
+        except SyntaxError as exc:  # surfaced as a finding by the runner
+            self.parse_error = f"syntax error: {exc.msg} (line {exc.lineno})"
+        if self.tree is not None:
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    child._graftlint_parent = node  # type: ignore[attr-defined]
+        self.pragmas = self._scan_pragmas()
+
+    # -- pragmas -------------------------------------------------------
+    def _scan_pragmas(self) -> dict[int, Pragma]:
+        """Pragmas live in COMMENT tokens only — pragma-shaped text inside
+        string literals and docstrings (rule documentation, test fixtures)
+        must neither suppress nor trip the GL000 malformed-pragma check."""
+        import io
+        import tokenize
+
+        pragmas: dict[int, Pragma] = {}
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.text).readline)
+            for token in tokens:
+                if token.type != tokenize.COMMENT:
+                    continue
+                match = PRAGMA_RE.search(token.string)
+                if match is None:
+                    continue
+                lineno, col = token.start
+                rules = tuple(
+                    r.strip()
+                    for r in match.group("rules").split(",")
+                    if r.strip()
+                )
+                pragmas[lineno] = Pragma(
+                    line=lineno,
+                    rules=rules,
+                    has_reason=match.group("reason") is not None,
+                    standalone=token.line[:col].strip() == "",
+                )
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            pass  # unparseable file: surfaced as a GL000 parse finding
+        return pragmas
+
+    def malformed_pragmas(self) -> list[Pragma]:
+        return [p for p in self.pragmas.values() if not p.has_reason]
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """Is ``rule`` disabled at ``line``?  Honoured positions: the line
+        itself, a standalone pragma comment on the line above, or the
+        ``def``/``class`` header of any enclosing scope."""
+        pragma = self.pragmas.get(line)
+        if pragma and pragma.has_reason and rule in pragma.rules:
+            return True
+        above = self.pragmas.get(line - 1)
+        if (
+            above is not None
+            and above.standalone
+            and above.has_reason
+            and rule in above.rules
+        ):
+            return True
+        for scope in self._enclosing_scopes(line):
+            pragma = self.pragmas.get(scope.lineno)
+            if pragma and pragma.has_reason and rule in pragma.rules:
+                return True
+        return False
+
+    # -- scopes --------------------------------------------------------
+    _SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+    def _enclosing_scopes(self, line: int) -> Iterator[ast.AST]:
+        if self.tree is None:
+            return
+        for node in ast.walk(self.tree):
+            if isinstance(node, self._SCOPE_NODES):
+                end = getattr(node, "end_lineno", node.lineno)
+                if node.lineno <= line <= (end or node.lineno):
+                    yield node
+
+    def symbol_at(self, node: ast.AST) -> str:
+        """``Class.method`` / ``func`` / ``func.<locals>.inner`` for the
+        scope enclosing ``node`` (the node itself when it is a def)."""
+        chain: list[str] = []
+        current: Optional[ast.AST] = node
+        while current is not None:
+            if isinstance(current, self._SCOPE_NODES):
+                chain.append(current.name)
+            current = getattr(current, "_graftlint_parent", None)
+        return ".".join(reversed(chain))
+
+
+@dataclass
+class AnalysisContext:
+    """Everything a rule may look at: the repo root and every parsed module
+    under the analysed trees.  ``module(relpath)`` is the per-file lookup;
+    rules with generated-artifact checks also read non-Python files through
+    ``root``."""
+
+    root: Path
+    modules: list[ModuleSource] = field(default_factory=list)
+    _by_path: dict[str, ModuleSource] = field(default_factory=dict)
+    #: scratch space for cross-rule shared computations (e.g. the jit
+    #: reachability graph GL001 and GL002 both need)
+    caches: dict = field(default_factory=dict)
+
+    def add(self, module: ModuleSource) -> None:
+        self.modules.append(module)
+        self._by_path[module.relpath] = module
+
+    def module(self, relpath: str) -> Optional[ModuleSource]:
+        return self._by_path.get(relpath)
+
+    def in_scope(self, patterns: tuple[str, ...]) -> list[ModuleSource]:
+        out = []
+        for module in self.modules:
+            if any(re.match(pattern, module.relpath) for pattern in patterns):
+                out.append(module)
+        return out
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``name``/``description`` and
+    implement :meth:`check`.  ``scope`` documents (and restricts) which
+    repo-relative paths the rule inspects — regex, anchored at start."""
+
+    id: str = "GL000"
+    name: str = "abstract"
+    description: str = ""
+    scope: tuple[str, ...] = (r".*\.py$",)
+
+    def check(self, ctx: AnalysisContext) -> list[Finding]:
+        raise NotImplementedError
+
+    # helper shared by every AST rule
+    def finding(
+        self, module: ModuleSource, node: ast.AST, message: str
+    ) -> Finding:
+        return Finding(
+            rule=self.id,
+            path=module.relpath,
+            line=getattr(node, "lineno", 1),
+            message=message,
+            symbol=module.symbol_at(node),
+        )

@@ -1,0 +1,538 @@
+"""Jit/Pallas reachability + taint — the shared engine behind GL001/GL002.
+
+Purely syntactic (``ast``), no jax import.  Three passes:
+
+1. **Entry detection** — every function that becomes a compiled program:
+   ``@jax.jit`` / ``@partial(jax.jit, static_argnames=...)`` decorated defs,
+   functions passed to ``jax.jit(...)`` / ``jit(...)`` by name
+   (``jax.jit(self._decode_block, donate_argnums=(1,))``), lambdas inside a
+   jit call, and kernels handed to ``pl.pallas_call`` (directly or through
+   ``functools.partial``).
+2. **Reachability** — from each entry, resolve calls through module-level
+   functions, ``from x import y`` imports within the analysed set, and
+   ``self.method`` lookups across every analysed class (the generator is
+   assembled from mixins, so method resolution is deliberately
+   class-agnostic).  Higher-order wrappers (``lax.scan``, ``vmap``,
+   ``partial``, ``checkpoint``/``remat``) treat function-valued arguments
+   as calls; nested ``def``s of a reachable function are reachable.
+3. **Taint** — which names hold traced values: entry parameters minus
+   ``static_argnames`` minus ``self``, anything produced by a ``jnp.*`` /
+   ``jax.*`` call, and everything derived from those.  Shape/dtype metadata
+   (``x.shape``, ``x.ndim``, ``x.dtype``, ``x.size``, ``len(x)``) is static
+   at trace time and sanitises; so do ``is``/``is not`` comparisons
+   (pytree-None dispatch is resolved at trace time).  Taint propagates into
+   callees per call site (positional + keyword mapping) to a fixpoint.
+
+Heuristic boundaries, documented for rule consumers: attributes of ``self``
+are treated as host configuration (untainted) — per-slot device state hung
+on the generator is read through parameters in this codebase — and free
+variables of nested functions default to untainted.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .core import AnalysisContext, ModuleSource
+
+_DEF_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+#: attribute accesses that yield static (host) metadata at trace time
+SANITIZING_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding"}
+#: builtins whose result is a static host value
+SANITIZING_CALLS = {"len", "isinstance", "range", "type", "hasattr", "getattr"}
+#: roots whose calls produce traced arrays even from static args
+ARRAY_NAMESPACES = {"jnp", "lax", "pl", "pltpu"}
+#: ``jax.<second>.*`` namespaces that produce arrays (``jax.devices()`` /
+#: ``jax.default_backend()`` style introspection stays host-static)
+JAX_ARRAY_SUBMODULES = {"lax", "nn", "numpy", "random", "scipy"}
+#: higher-order wrappers whose function-valued args are effectively called
+HOF_NAMES = {"scan", "vmap", "pmap", "checkpoint", "remat", "partial",
+             "fori_loop", "while_loop", "cond", "switch", "custom_vjp",
+             "shard_map", "named_call"}
+
+
+def iter_scope(stmt: ast.AST):
+    """Walk a statement WITHOUT descending into nested function/lambda
+    subtrees.  Nested defs are yielded (so callers can register them) but
+    their bodies belong to their own scope: a nested helper's locals,
+    returns and calls must never leak into the enclosing function's taint
+    env or finding scan (each reachable nested def is analysed as its own
+    FunctionInfo)."""
+    stack: list[ast.AST] = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (*_DEF_NODES, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _func_root(func: ast.AST) -> Optional[str]:
+    """Leftmost name of a (possibly dotted) call target."""
+    while isinstance(func, ast.Attribute):
+        func = func.value
+    return func.id if isinstance(func, ast.Name) else None
+
+
+def _attr_chain(func: ast.AST) -> list[str]:
+    """``jax.lax.scan`` -> ["jax", "lax", "scan"]; [] when not a pure
+    name/attribute chain."""
+    parts: list[str] = []
+    while isinstance(func, ast.Attribute):
+        parts.append(func.attr)
+        func = func.value
+    if isinstance(func, ast.Name):
+        parts.append(func.id)
+        return list(reversed(parts))
+    return []
+
+
+def _is_array_namespace_call(func: ast.AST) -> bool:
+    chain = _attr_chain(func)
+    if not chain:
+        return False
+    if chain[0] in ARRAY_NAMESPACES:
+        return True
+    return chain[0] == "jax" and len(chain) > 2 and chain[1] in JAX_ARRAY_SUBMODULES
+
+
+def _is_jit_ref(func: ast.AST) -> bool:
+    if isinstance(func, ast.Name):
+        return func.id == "jit"
+    return isinstance(func, ast.Attribute) and func.attr == "jit"
+
+
+def _is_pallas_ref(func: ast.AST) -> bool:
+    if isinstance(func, ast.Name):
+        return func.id == "pallas_call"
+    return isinstance(func, ast.Attribute) and func.attr == "pallas_call"
+
+
+def _static_argnames(call: ast.Call) -> set[str]:
+    names: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                    names.add(node.value)
+    return names
+
+
+@dataclass
+class FunctionInfo:
+    """One def (or lambda) in the analysed set."""
+
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    module: ModuleSource
+    qualname: str
+    is_entry: bool = False
+    entry_kind: str = ""  # "jit" | "pallas"
+    static_params: set[str] = field(default_factory=set)
+    tainted_params: set[str] = field(default_factory=set)
+    reachable: bool = False
+
+    @property
+    def params(self) -> list[str]:
+        args = self.node.args
+        names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+        if args.vararg:
+            names.append(args.vararg.arg)
+        if args.kwarg:
+            names.append(args.kwarg.arg)
+        return names
+
+
+class JitGraph:
+    """Reachability/taint index over a set of modules (see module doc)."""
+
+    @classmethod
+    def for_modules(
+        cls, ctx: AnalysisContext, modules: list[ModuleSource]
+    ) -> "JitGraph":
+        """Cached constructor: GL001 and GL002 share one scope, so the
+        fixpoint (the expensive half of the analysis) runs once per run."""
+        key = ("jitgraph", tuple(m.relpath for m in modules))
+        graph = ctx.caches.get(key)
+        if graph is None:
+            graph = cls(ctx, modules)
+            ctx.caches[key] = graph
+        return graph
+
+    def __init__(self, ctx: AnalysisContext, modules: list[ModuleSource]) -> None:
+        self.ctx = ctx
+        self.modules = [m for m in modules if m.tree is not None]
+        self._relpaths = {m.relpath for m in self.modules}
+        self._infos: dict[int, FunctionInfo] = {}  # id(node) -> info
+        self._module_funcs: dict[str, dict[str, ast.AST]] = {}
+        self._methods: dict[str, list[FunctionInfo]] = {}  # name -> infos
+        self._imports: dict[str, dict[str, tuple[str, str]]] = {}
+        self._build_tables()
+        self._detect_entries()
+        self._propagate()
+
+    # -- construction --------------------------------------------------
+    def _build_tables(self) -> None:
+        for module in self.modules:
+            funcs: dict[str, ast.AST] = {}
+            for node in ast.walk(module.tree):
+                if isinstance(node, _DEF_NODES):
+                    info = FunctionInfo(
+                        node=node, module=module, qualname=module.symbol_at(node)
+                    )
+                    self._infos[id(node)] = info
+                    parent = getattr(node, "_graftlint_parent", None)
+                    if isinstance(parent, ast.Module):
+                        funcs[node.name] = node
+                    elif isinstance(parent, ast.ClassDef):
+                        self._methods.setdefault(node.name, []).append(info)
+            self._module_funcs[module.relpath] = funcs
+            self._imports[module.relpath] = self._scan_imports(module)
+
+    def _scan_imports(self, module: ModuleSource) -> dict[str, tuple[str, str]]:
+        """local name -> (target module relpath, original name) for
+        ``from X import y [as z]`` imports resolvable inside the set."""
+        out: dict[str, tuple[str, str]] = {}
+        package_parts = module.relpath.split("/")[:-1]
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ImportFrom):
+                continue
+            if node.level:
+                base = package_parts[: len(package_parts) - (node.level - 1)]
+            else:
+                base = []
+            target = base + (node.module.split(".") if node.module else [])
+            rel = "/".join(target) + ".py"
+            if rel not in self._relpaths:
+                continue
+            for alias in node.names:
+                out[alias.asname or alias.name] = (rel, alias.name)
+        return out
+
+    def info(self, node: ast.AST) -> Optional[FunctionInfo]:
+        return self._infos.get(id(node))
+
+    def reachable_functions(self) -> list[FunctionInfo]:
+        return [i for i in self._infos.values() if i.reachable]
+
+    # -- entry detection -----------------------------------------------
+    def _detect_entries(self) -> None:
+        for module in self.modules:
+            for node in ast.walk(module.tree):
+                if isinstance(node, _DEF_NODES):
+                    self._check_decorators(module, node)
+                elif isinstance(node, ast.Call):
+                    self._check_call(module, node)
+
+    def _check_decorators(self, module: ModuleSource, node: ast.AST) -> None:
+        for deco in node.decorator_list:
+            if _is_jit_ref(deco):
+                self._mark_entry(self.info(node), "jit", set())
+            elif isinstance(deco, ast.Call):
+                if _is_jit_ref(deco.func):
+                    self._mark_entry(self.info(node), "jit", _static_argnames(deco))
+                elif deco.args and _is_jit_ref(deco.args[0]):
+                    # @partial(jax.jit, static_argnames=...)
+                    self._mark_entry(self.info(node), "jit", _static_argnames(deco))
+
+    def _check_call(self, module: ModuleSource, call: ast.Call) -> None:
+        if _is_jit_ref(call.func) and call.args:
+            target = call.args[0]
+            statics = _static_argnames(call)
+            for info in self._resolve_function_ref(module, call, target):
+                self._mark_entry(info, "jit", statics)
+        elif _is_pallas_ref(call.func) and call.args:
+            target = call.args[0]
+            if isinstance(target, ast.Call):  # partial(kernel, ...)
+                target = target.args[0] if target.args else target
+            for info in self._resolve_function_ref(module, call, target):
+                self._mark_entry(info, "pallas", set())
+
+    def _mark_entry(
+        self, info: Optional[FunctionInfo], kind: str, statics: set[str]
+    ) -> None:
+        if info is None:
+            return
+        info.is_entry = True
+        info.entry_kind = info.entry_kind or kind
+        info.static_params.update(statics)
+        traced = {
+            p for p in info.params
+            if p not in info.static_params and p != "self"
+        }
+        info.tainted_params.update(traced)
+
+    # -- name resolution -----------------------------------------------
+    def _resolve_function_ref(
+        self, module: ModuleSource, site: ast.AST, target: ast.AST
+    ) -> list[FunctionInfo]:
+        """Defs a function-valued expression can denote."""
+        if isinstance(target, ast.Lambda):
+            info = self._infos.get(id(target))
+            if info is None:
+                info = FunctionInfo(
+                    node=target, module=module,
+                    qualname=f"{module.symbol_at(target)}.<lambda>",
+                )
+                self._infos[id(target)] = info
+            return [info]
+        if isinstance(target, ast.Attribute):
+            if isinstance(target.value, ast.Name) and target.value.id == "self":
+                return list(self._methods.get(target.attr, []))
+            return []
+        if not isinstance(target, ast.Name):
+            return []
+        name = target.id
+        # nearest lexically-enclosing def with that name
+        scope = getattr(site, "_graftlint_parent", None)
+        while scope is not None:
+            if isinstance(scope, _DEF_NODES):
+                for child in ast.walk(scope):
+                    if (
+                        isinstance(child, _DEF_NODES)
+                        and child.name == name
+                        and child is not scope
+                    ):
+                        return [self._infos[id(child)]]
+            scope = getattr(scope, "_graftlint_parent", None)
+        local = self._module_funcs.get(module.relpath, {}).get(name)
+        if local is not None:
+            return [self._infos[id(local)]]
+        imported = self._imports.get(module.relpath, {}).get(name)
+        if imported is not None:
+            rel, orig = imported
+            other = self._module_funcs.get(rel, {}).get(orig)
+            if other is not None:
+                return [self._infos[id(other)]]
+        return []
+
+    # -- reachability + taint fixpoint ---------------------------------
+    def _propagate(self) -> None:
+        self._resolve_returns = False
+        self._return_memo: dict[int, bool] = {}
+        worklist = [i for i in self._infos.values() if i.is_entry]
+        for info in worklist:
+            info.reachable = True
+        while worklist:
+            info = worklist.pop()
+            env = self.local_taint(info)
+            body = (
+                info.node.body
+                if isinstance(info.node.body, list)
+                else [ast.Expr(info.node.body)]  # lambda
+            )
+            for stmt in body:
+                for node in iter_scope(stmt):
+                    if isinstance(node, _DEF_NODES):
+                        # a DECORATED nested def (@pl.when(...)) is invoked
+                        # by traced machinery with traced values; plain
+                        # nested defs become reachable through their call
+                        # sites (precise per-site taint mapping)
+                        if not node.decorator_list:
+                            continue
+                        nested = self._infos[id(node)]
+                        if not nested.reachable:
+                            nested.reachable = True
+                            nested.tainted_params.update(
+                                p for p in nested.params if p != "self"
+                            )
+                            worklist.append(nested)
+                        continue
+                    if not isinstance(node, ast.Call):
+                        continue
+                    for callee, args_taint in self._resolve_call(
+                        info.module, node, env
+                    ):
+                        changed = not callee.reachable
+                        callee.reachable = True
+                        before = len(callee.tainted_params)
+                        callee.tainted_params.update(args_taint)
+                        if changed or len(callee.tainted_params) != before:
+                            worklist.append(callee)
+        # from here on expr_tainted may resolve call return taint through
+        # the (now stable) per-function taint sets
+        self._resolve_returns = True
+
+    def _resolve_call(
+        self, module: ModuleSource, call: ast.Call, env: set[str]
+    ) -> list[tuple[FunctionInfo, set[str]]]:
+        """(callee, tainted-param-names) pairs for one call site."""
+        out: list[tuple[FunctionInfo, set[str]]] = []
+        targets: list[ast.AST] = []
+        func = call.func
+        if isinstance(func, ast.Name) or (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+        ):
+            targets.append(func)
+        # higher-order wrappers: function-valued args are called with
+        # traced values (scan carries, vmapped batches)
+        attr = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else ""
+        )
+        if attr in HOF_NAMES:
+            for arg in call.args:
+                for info in self._resolve_function_ref(module, call, arg):
+                    out.append(
+                        (info, {p for p in info.params if p != "self"})
+                    )
+        for target in targets:
+            for info in self._resolve_function_ref(module, call, target):
+                out.append((info, self._map_taint(info, call, env)))
+        return out
+
+    def _map_taint(
+        self, callee: FunctionInfo, call: ast.Call, env: set[str]
+    ) -> set[str]:
+        params = [p for p in callee.params if p != "self"]
+        tainted: set[str] = set()
+        for idx, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                # can't map positions past a splat: taint the rest
+                tainted.update(params[idx:])
+                break
+            if idx < len(params) and self.expr_tainted(arg, env):
+                tainted.add(params[idx])
+        for kw in call.keywords:
+            if kw.arg is not None and self.expr_tainted(kw.value, env):
+                tainted.add(kw.arg)
+        return tainted & set(params)
+
+    # -- taint ----------------------------------------------------------
+    def local_taint(self, info: FunctionInfo) -> set[str]:
+        """Names holding traced values inside ``info``: tainted params plus
+        assignment targets of tainted expressions (iterated to fixpoint —
+        straight-line reassignment chains converge in a few passes)."""
+        env = set(info.tainted_params)
+        body = (
+            info.node.body if isinstance(info.node.body, list) else []
+        )
+        for _ in range(8):
+            before = len(env)
+            for stmt in body:
+                for node in iter_scope(stmt):
+                    if isinstance(node, _DEF_NODES):
+                        continue
+                    targets: list[ast.AST] = []
+                    value: Optional[ast.AST] = None
+                    if isinstance(node, ast.Assign):
+                        targets, value = node.targets, node.value
+                    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                        targets, value = [node.target], node.value
+                    elif isinstance(node, ast.For):
+                        targets, value = [node.target], node.iter
+                    if value is not None and self.expr_tainted(
+                        value, env, module=info.module
+                    ):
+                        for target in targets:
+                            for leaf in ast.walk(target):
+                                if isinstance(leaf, ast.Name):
+                                    env.add(leaf.id)
+            if len(env) == before:
+                break
+        return env
+
+    def _return_tainted(self, info: FunctionInfo) -> bool:
+        """Does a call to ``info`` yield a traced value?  Computed from its
+        (post-fixpoint) tainted params and return expressions; cycles
+        resolve conservatively to tainted."""
+        memo = self._return_memo
+        key = id(info.node)
+        if key in memo:
+            return memo[key]
+        memo[key] = True  # in-progress: recursion assumes tainted
+        if not isinstance(info.node.body, list):  # lambda
+            result = self.expr_tainted(
+                info.node.body, set(info.tainted_params), module=info.module
+            )
+        else:
+            env = self.local_taint(info)
+            result = False
+            for stmt in info.node.body:
+                for node in iter_scope(stmt):
+                    if isinstance(node, _DEF_NODES):
+                        continue
+                    if isinstance(node, ast.Return) and node.value is not None:
+                        if self.expr_tainted(node.value, env, module=info.module):
+                            result = True
+                            break
+                if result:
+                    break
+        memo[key] = result
+        return result
+
+    def expr_tainted(
+        self,
+        expr: ast.AST,
+        env: set[str],
+        module: Optional[ModuleSource] = None,
+    ) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in env
+        if isinstance(expr, ast.Constant):
+            return False
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in SANITIZING_ATTRS:
+                return False
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                return False  # host-owned configuration (module doc)
+            return self.expr_tainted(expr.value, env, module)
+        if isinstance(expr, ast.Compare):
+            if all(
+                isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                for op in expr.ops
+            ):
+                # static pytree-None dispatch / dict-membership config
+                # checks (`name in layer_lora`); membership on an actual
+                # traced ARRAY would be a real bug but the jit trace
+                # itself rejects it loudly
+                return False
+            return any(
+                self.expr_tainted(e, env, module)
+                for e in [expr.left, *expr.comparators]
+            )
+        if isinstance(expr, ast.Call):
+            func = expr.func
+            if isinstance(func, ast.Name) and func.id in SANITIZING_CALLS:
+                return False
+            if _is_array_namespace_call(func):
+                return True
+            # resolved local/imported/self calls: taint of their returns
+            if self._resolve_returns and module is not None:
+                infos = self._resolve_function_ref(module, expr, func)
+                if infos:
+                    return any(self._return_tainted(i) for i in infos)
+            if isinstance(func, ast.Attribute) and self.expr_tainted(
+                func.value, env, module
+            ):
+                return True  # method on a traced value
+            return any(
+                self.expr_tainted(a, env, module) for a in expr.args
+            ) or any(
+                self.expr_tainted(kw.value, env, module)
+                for kw in expr.keywords
+            )
+        if isinstance(expr, ast.BoolOp):
+            return any(self.expr_tainted(v, env, module) for v in expr.values)
+        if isinstance(expr, ast.BinOp):
+            return self.expr_tainted(
+                expr.left, env, module
+            ) or self.expr_tainted(expr.right, env, module)
+        if isinstance(expr, ast.UnaryOp):
+            return self.expr_tainted(expr.operand, env, module)
+        if isinstance(expr, ast.Subscript):
+            return self.expr_tainted(
+                expr.value, env, module
+            ) or self.expr_tainted(expr.slice, env, module)
+        if isinstance(expr, ast.IfExp):
+            return any(
+                self.expr_tainted(e, env, module)
+                for e in [expr.test, expr.body, expr.orelse]
+            )
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.expr_tainted(e, env, module) for e in expr.elts)
+        if isinstance(expr, ast.Starred):
+            return self.expr_tainted(expr.value, env, module)
+        return False

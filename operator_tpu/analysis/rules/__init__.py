@@ -1,0 +1,28 @@
+"""Rule registry — one module per rule, imported here in catalogue order."""
+
+from __future__ import annotations
+
+from ..core import Rule
+from .gl001_host_sync import HostSyncInHotPath
+from .gl002_tracer import TracerUnsafeControlFlow
+from .gl003_deadline import DeadlinePropagation
+from .gl004_locks import LockDiscipline
+from .gl005_drift import GeneratedArtifactDrift
+
+ALL_RULES: list[Rule] = [
+    HostSyncInHotPath(),
+    TracerUnsafeControlFlow(),
+    DeadlinePropagation(),
+    LockDiscipline(),
+    GeneratedArtifactDrift(),
+]
+
+
+def rules_by_id(ids: list[str] | None = None) -> list[Rule]:
+    if not ids:
+        return list(ALL_RULES)
+    table = {rule.id: rule for rule in ALL_RULES}
+    missing = [i for i in ids if i not in table]
+    if missing:
+        raise KeyError(f"unknown rule id(s): {', '.join(missing)}")
+    return [table[i] for i in ids]

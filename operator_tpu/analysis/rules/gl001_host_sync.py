@@ -1,0 +1,98 @@
+"""GL001 — no host synchronisation inside the TPU hot path.
+
+A ``.item()`` / ``np.asarray`` / ``jax.device_get`` / ``block_until_ready``
+inside anything reachable from a ``jax.jit``/``pallas_call`` entry point
+forces a device→host readback at trace time (or worse, per step): the
+decode loop that is supposed to dispatch K steps per host round-trip
+(serving/engine.py) silently serialises the TPU instead — the exact failure
+mode the ragged/paged attention kernels exist to avoid.
+
+Scope: the compute tree — ``ops/``, ``serving/``, ``models/``.  Host-side
+orchestration in those files (admission, the step() token fetch — "the ONE
+host sync per block") is fine: the rule only looks INSIDE the reachable
+set computed by :mod:`..jitgraph`.
+
+``float(x)`` / ``int(x)`` / ``bool(x)`` are flagged only when ``x`` is
+*tainted* (derives from a traced value): on a tracer these raise
+``ConcretizationTypeError`` at best and force a sync at worst, while
+``float(len(xs))``-style host arithmetic stays legal.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import AnalysisContext, Finding, Rule
+from ..jitgraph import JitGraph, _func_root, iter_scope
+
+#: numpy module aliases in this codebase
+_NUMPY_ALIASES = {"np", "numpy", "onp"}
+#: numpy calls that materialise (copy to host) an array
+_NUMPY_MATERIALIZERS = {"asarray", "array", "ascontiguousarray", "copy", "save"}
+#: method calls on any object that force a device->host readback
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+#: jax module-level sync functions
+_JAX_SYNC_FUNCS = {"device_get", "block_until_ready"}
+
+
+class HostSyncInHotPath(Rule):
+    id = "GL001"
+    name = "host-sync-in-hot-path"
+    description = (
+        "no .item()/tolist()/np.asarray/jax.device_get/block_until_ready — "
+        "and no float()/int() on traced values — in functions reachable "
+        "from jax.jit / pallas_call entry points"
+    )
+    scope = (
+        r"operator_tpu/ops/.*\.py$",
+        r"operator_tpu/serving/.*\.py$",
+        r"operator_tpu/models/.*\.py$",
+    )
+
+    def check(self, ctx: AnalysisContext) -> list[Finding]:
+        graph = JitGraph.for_modules(ctx, ctx.in_scope(self.scope))
+        findings: list[Finding] = []
+        for info in graph.reachable_functions():
+            env = graph.local_taint(info)
+            body = info.node.body if isinstance(info.node.body, list) else [
+                ast.Expr(info.node.body)
+            ]
+            for stmt in body:
+                for node in iter_scope(stmt):
+                    # nested defs are their own reachable infos: iter_scope
+                    # never descends into them, so no duplicate findings
+                    if not isinstance(node, ast.Call):
+                        continue
+                    message = self._sync_message(graph, node, env, info.module)
+                    if message is not None:
+                        findings.append(
+                            self.finding(
+                                info.module, node,
+                                f"{message} in jit/pallas hot path "
+                                f"(reachable from a compiled entry point)",
+                            )
+                        )
+        return findings
+
+    def _sync_message(
+        self, graph: JitGraph, call: ast.Call, env: set[str], module
+    ) -> str | None:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            root = _func_root(func)
+            if func.attr in _SYNC_METHODS and root not in _NUMPY_ALIASES:
+                return f"host sync: .{func.attr}()"
+            if root == "jax" and func.attr in _JAX_SYNC_FUNCS:
+                return f"host sync: jax.{func.attr}()"
+            if root in _NUMPY_ALIASES and func.attr in _NUMPY_MATERIALIZERS:
+                return f"host materialisation: {root}.{func.attr}()"
+        elif isinstance(func, ast.Name):
+            if func.id in _JAX_SYNC_FUNCS:
+                return f"host sync: {func.id}()"
+            if (
+                func.id in ("float", "int", "bool")
+                and call.args
+                and graph.expr_tainted(call.args[0], env, module)
+            ):
+                return f"host sync: {func.id}() on a traced value"
+        return None

@@ -1,0 +1,102 @@
+"""GL002 — tracer-unsafe Python control flow inside compiled bodies.
+
+Inside a jitted/Pallas body, a Python ``if``/``while`` on a traced value
+either raises ``ConcretizationTypeError`` or — when it "works" because the
+value was concrete at trace time — silently bakes one branch into the
+compiled program, which then serves WRONG results for other inputs.
+``assert`` on a traced value is the same trap with a nicer spelling; Python
+``for`` over a traced array unrolls the loop into the program (compile-time
+explosion, recompile per length).
+
+The taint model (``jitgraph``) keeps the legal idioms quiet: branching on
+``static_argnames`` parameters, on shape/dtype metadata, on closure
+configuration, and ``x is None`` pytree dispatch are all static at trace
+time and never flagged.  The fix for a real finding is ``jnp.where`` /
+``lax.cond`` / ``lax.while_loop`` / ``lax.fori_loop``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import AnalysisContext, Finding, Rule
+from ..jitgraph import JitGraph, iter_scope
+
+
+class TracerUnsafeControlFlow(Rule):
+    id = "GL002"
+    name = "tracer-unsafe-control-flow"
+    description = (
+        "no Python if/while/assert on traced values (and no Python "
+        "iteration over traced arrays) inside jit/pallas bodies — use "
+        "jnp.where / lax.cond / lax.while_loop"
+    )
+    scope = (
+        r"operator_tpu/ops/.*\.py$",
+        r"operator_tpu/serving/.*\.py$",
+        r"operator_tpu/models/.*\.py$",
+    )
+
+    def check(self, ctx: AnalysisContext) -> list[Finding]:
+        graph = JitGraph.for_modules(ctx, ctx.in_scope(self.scope))
+        findings: list[Finding] = []
+        for info in graph.reachable_functions():
+            env = graph.local_taint(info)
+            module = info.module
+            vararg = getattr(info.node.args, "vararg", None)
+            tuple_params = {vararg.arg} if vararg else set()
+            body = info.node.body if isinstance(info.node.body, list) else [
+                ast.Expr(info.node.body)  # jitted lambda: check its expression
+            ]
+            for stmt in body:
+                for node in iter_scope(stmt):
+                    message: str | None = None
+                    if isinstance(node, ast.If) and graph.expr_tainted(
+                        node.test, env, module
+                    ):
+                        message = (
+                            "Python `if` on a traced value inside a compiled "
+                            "body — use jnp.where / lax.cond"
+                        )
+                    elif isinstance(node, ast.While) and graph.expr_tainted(
+                        node.test, env, module
+                    ):
+                        message = (
+                            "Python `while` on a traced value inside a "
+                            "compiled body — use lax.while_loop"
+                        )
+                    elif isinstance(node, ast.Assert) and graph.expr_tainted(
+                        node.test, env, module
+                    ):
+                        message = (
+                            "`assert` on a traced value inside a compiled "
+                            "body — use checkify or a host-side precondition"
+                        )
+                    elif isinstance(node, ast.For) and self._iter_flaggable(
+                        node.iter, tuple_params
+                    ) and graph.expr_tainted(node.iter, env, module):
+                        message = (
+                            "Python iteration over a traced value unrolls "
+                            "into the program — use lax.scan / lax.fori_loop"
+                        )
+                    elif isinstance(node, ast.IfExp) and graph.expr_tainted(
+                        node.test, env, module
+                    ):
+                        message = (
+                            "conditional expression on a traced value inside "
+                            "a compiled body — use jnp.where"
+                        )
+                    if message is not None:
+                        findings.append(self.finding(info.module, node, message))
+        return findings
+
+    @staticmethod
+    def _iter_flaggable(iter_expr: ast.AST, tuple_params: set[str]) -> bool:
+        """Iterating a *call result* (helpers returning host tuples) or a
+        ``*args`` tuple of arrays is host iteration, not array unrolling —
+        only direct traced values (names/attributes/subscripts) flag."""
+        if isinstance(iter_expr, ast.Call):
+            return False
+        if isinstance(iter_expr, ast.Name) and iter_expr.id in tuple_params:
+            return False
+        return True

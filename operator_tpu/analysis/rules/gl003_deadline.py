@@ -1,0 +1,144 @@
+"""GL003 — deadline propagation on the analysis control plane.
+
+PR 1 made the latency contract end-to-end: a :class:`~operator_tpu.utils.
+deadline.Deadline` is born when a failure is claimed and every downstream
+hop spends from it.  That contract decays one "quick" API call at a time —
+an unbudgeted apiserver read in a helper blocks the pipeline for the TCP
+stack's idea of forever, and the p50 SLO is gone with no test failing.
+
+The rule: every blocking external call in the control-plane files
+(``operator/pipeline.py``, ``providers.py``, ``patternsync.py``,
+``kubeapi.py``) must be budget-bound **at the call itself**:
+
+- wrapped in ``asyncio.wait_for(...)`` (the residue of a threaded
+  Deadline — ``timeout=deadline.remaining()`` — is the idiom), or
+- passing a ``timeout=`` / ``deadline=`` keyword.
+
+A ``deadline`` parameter on the enclosing function is how the budget
+arrives but is deliberately NOT sufficient on its own — an unspent
+parameter bounds nothing, and the docs promise per-call enforcement.
+
+"Blocking external" means: Kubernetes API verbs on an api handle
+(``self.api.get(...)``, ``api.list(...)``), provider ``.generate(...)``,
+subprocess ``.communicate()``, and ``urlopen``/opener HTTP calls.  Internal
+awaits (queues, events, locks) are not external and are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..core import AnalysisContext, Finding, ModuleSource, Rule
+
+_KUBE_OPS = {
+    "get", "list", "list_rv", "create", "patch", "patch_status", "delete",
+    "get_log", "watch",
+}
+_DEF_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _is_api_handle(expr: ast.AST) -> bool:
+    """``api`` / ``self.api`` / ``self._api`` — the KubeApi handle shapes
+    used across the control plane."""
+    if isinstance(expr, ast.Name):
+        return expr.id == "api"
+    return (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+        and expr.attr in ("api", "_api")
+    )
+
+
+def _terminal_name(func: ast.AST) -> str:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+class DeadlinePropagation(Rule):
+    id = "GL003"
+    name = "deadline-propagation"
+    description = (
+        "every blocking external call (kube API verb, provider generate, "
+        "subprocess communicate, urlopen) must spend a budget at the call: "
+        "asyncio.wait_for (typically on a threaded Deadline's remaining()) "
+        "or a timeout=/deadline= keyword"
+    )
+    scope = (
+        r"operator_tpu/operator/pipeline\.py$",
+        r"operator_tpu/operator/providers\.py$",
+        r"operator_tpu/operator/patternsync\.py$",
+        r"operator_tpu/operator/kubeapi\.py$",
+    )
+
+    def check(self, ctx: AnalysisContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for module in ctx.in_scope(self.scope):
+            if module.tree is None:
+                continue
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                label = self._external_call(node)
+                if label is None:
+                    continue
+                if self._guarded(node):
+                    continue
+                findings.append(
+                    self.finding(
+                        module, node,
+                        f"blocking external call {label} without a budget: "
+                        "wrap in asyncio.wait_for on a threaded Deadline's "
+                        "remaining() (utils/deadline.py), or pass timeout=",
+                    )
+                )
+        return findings
+
+    # -- matchers ------------------------------------------------------
+    def _external_call(self, call: ast.Call) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in _KUBE_OPS and _is_api_handle(func.value):
+                return f"{ast.unparse(func)}(...)"
+            if func.attr == "generate":
+                return f"{ast.unparse(func)}(...)"
+            if func.attr == "communicate":
+                return f"{ast.unparse(func)}(...)"
+            if func.attr in ("urlopen", "_opener"):
+                return f"{ast.unparse(func)}(...)"
+        elif isinstance(func, ast.Name) and func.id in ("urlopen", "_opener"):
+            return f"{func.id}(...)"
+        return None
+
+    # -- guards --------------------------------------------------------
+    @staticmethod
+    def _is_literal_none(expr: ast.AST) -> bool:
+        return isinstance(expr, ast.Constant) and expr.value is None
+
+    def _guarded(self, call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg in ("timeout", "timeout_s", "deadline", "deadline_s"):
+                # `timeout=None` is spelled like a budget and bounds
+                # nothing; dynamic expressions (deadline.remaining(), a
+                # conditional residue) are accepted
+                return not self._is_literal_none(kw.value)
+        node: Optional[ast.AST] = call
+        while node is not None:
+            node = getattr(node, "_graftlint_parent", None)
+            if (
+                isinstance(node, ast.Call)
+                and node is not call
+                and _terminal_name(node.func) == "wait_for"
+            ):
+                timeout: Optional[ast.AST] = None
+                if len(node.args) > 1:
+                    timeout = node.args[1]
+                for kw in node.keywords:
+                    if kw.arg == "timeout":
+                        timeout = kw.value
+                return timeout is not None and not self._is_literal_none(timeout)
+        return False

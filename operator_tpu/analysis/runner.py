@@ -1,0 +1,90 @@
+"""Project walker + rule executor: collect sources, run rules, suppress.
+
+Separated from ``__main__`` so tests (and future in-process consumers, e.g.
+a pre-commit hook) can run the analysis without a subprocess.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional, Sequence
+
+from .core import AnalysisContext, Finding, ModuleSource, Rule
+
+#: directories never worth parsing
+_SKIP_DIRS = {
+    "__pycache__", ".git", ".pytest_cache", ".hypothesis", "build",
+    "node_modules", ".venv", "venv", "env", ".tox", ".eggs",
+    ".mypy_cache", "site-packages",
+}
+
+
+def collect_context(root: Path, paths: Optional[Sequence[Path]] = None) -> AnalysisContext:
+    """Parse every ``.py`` under ``paths`` (default: the whole tree) into an
+    :class:`AnalysisContext` rooted at ``root``."""
+    root = root.resolve()
+    ctx = AnalysisContext(root=root)
+    roots = [Path(p).resolve() for p in paths] if paths else [root]
+    seen: set[Path] = set()
+    for base in roots:
+        if not base.exists():
+            # a typo'd path in a CI command must fail loudly, never turn
+            # the gate into "clean — 0 file(s)"
+            raise FileNotFoundError(f"no such path: {base}")
+        if not base.is_relative_to(root):
+            raise ValueError(
+                f"{base} is outside the analysis root {root} — finding "
+                "paths are root-relative; pass --root accordingly"
+            )
+        candidates = [base] if base.is_file() else sorted(base.rglob("*.py"))
+        for path in candidates:
+            if path.suffix != ".py" or path in seen:
+                continue
+            if any(part in _SKIP_DIRS for part in path.parts):
+                continue
+            seen.add(path)
+            ctx.add(ModuleSource(root, path))
+    return ctx
+
+
+def run_analysis(
+    ctx: AnalysisContext, rules: Sequence[Rule]
+) -> tuple[list[Finding], list[Finding]]:
+    """Run ``rules`` over ``ctx``.
+
+    Returns ``(findings, pragma_errors)``: rule findings surviving pragma
+    suppression (sorted by location), plus one GL000 finding per malformed
+    pragma (``disable=`` without ``reason=`` — a suppression that does not
+    document itself does not suppress).
+    """
+    findings: list[Finding] = []
+    for rule in rules:
+        for finding in rule.check(ctx):
+            module = ctx.module(finding.path)
+            if module is not None and module.suppressed(finding.rule, finding.line):
+                continue
+            findings.append(finding)
+    pragma_errors: list[Finding] = []
+    for module in ctx.modules:
+        if module.parse_error:
+            pragma_errors.append(
+                Finding(
+                    rule="GL000", path=module.relpath, line=1,
+                    message=module.parse_error,
+                )
+            )
+        for pragma in module.malformed_pragmas():
+            pragma_errors.append(
+                Finding(
+                    rule="GL000",
+                    path=module.relpath,
+                    line=pragma.line,
+                    message=(
+                        "malformed graftlint pragma: `reason=` is required "
+                        "(a suppression must document itself); this pragma "
+                        "suppresses nothing"
+                    ),
+                )
+            )
+    key = lambda f: (f.path, f.line, f.rule)  # noqa: E731
+    return sorted(findings, key=key), sorted(pragma_errors, key=key)

@@ -37,6 +37,7 @@ class IncidentIndex:
         )
 
     def __len__(self) -> int:
+        # graftlint: disable=GL004 reason=deliberate lock-free snapshot read; _state is an immutable tuple swapped atomically under the lock
         return len(self._state[0])
 
     # ------------------------------------------------------------------
@@ -94,6 +95,7 @@ class IncidentIndex:
     def query(self, text: str, k: int = 3) -> list[tuple[str, float]]:
         """Top-k (digest, cosine score), descending.  Scores on the MXU via
         the fused Pallas kernel on TPU, XLA/numpy elsewhere."""
+        # graftlint: disable=GL004 reason=deliberate lock-free snapshot read; _state is an immutable tuple swapped atomically under the lock
         digests, matrix = self._state  # one consistent snapshot
         if not digests or not text.strip():
             return []

@@ -205,6 +205,7 @@ async def iter_watch_resumed(
     resumes AT the unapplied event and the server replays it).
     """
     try:
+        # graftlint: disable=GL003 reason=watch streams are deliberately unbounded; liveness comes from server-side close + the resume discipline, not a deadline
         async for event in api.watch(
             kind, namespace, resource_version=get_cursor()
         ):

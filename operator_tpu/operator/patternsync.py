@@ -211,12 +211,16 @@ class PatternLibraryReconciler:
         ref = creds.secret_ref
         namespace = ref.namespace or library.metadata.namespace or "default"
         try:
-            secret = Secret.parse(await self.api.get("Secret", ref.name, namespace))
+            secret = Secret.parse(await asyncio.wait_for(
+                self.api.get("Secret", ref.name, namespace),
+                timeout=self.config.kube_call_timeout_s,
+            ))
         except NotFoundError:
             log.warning("credentials secret %s/%s not found", namespace, ref.name)
             return None
-        except ApiError as exc:
-            log.warning("credentials secret fetch failed: %s", exc)
+        except (ApiError, asyncio.TimeoutError) as exc:
+            log.warning("credentials secret fetch failed: %s",
+                        str(exc) or "timed out")
             return None
         return secret.decoded(ref.key or "token")
 
@@ -280,12 +284,16 @@ class PatternLibraryReconciler:
 
     async def _patch_status(self, library: PatternLibrary, status: dict) -> None:
         try:
-            await self.api.patch_status(
-                "PatternLibrary", library.metadata.name, library.metadata.namespace, status
+            await asyncio.wait_for(
+                self.api.patch_status(
+                    "PatternLibrary", library.metadata.name,
+                    library.metadata.namespace, status,
+                ),
+                timeout=self.config.kube_call_timeout_s,
             )
-        except ApiError as exc:
+        except (ApiError, asyncio.TimeoutError) as exc:
             log.warning("patternlibrary status patch failed for %s: %s",
-                        library.qualified_name(), exc)
+                        library.qualified_name(), str(exc) or "timed out")
 
     # ------------------------------------------------------------------
     async def run(self, stop: asyncio.Event, *, poll_interval_s: float = 15.0) -> None:
@@ -294,12 +302,17 @@ class PatternLibraryReconciler:
         15s granularity gives the same behaviour within one tick)."""
         while not stop.is_set():
             try:
-                for raw in await self.api.list("PatternLibrary"):
+                libraries = await asyncio.wait_for(
+                    self.api.list("PatternLibrary"),
+                    timeout=self.config.kube_call_timeout_s,
+                )
+                for raw in libraries:
                     if stop.is_set():
                         return
                     await self.reconcile(PatternLibrary.parse(raw))
-            except ApiError as exc:
-                log.warning("patternlibrary list failed: %s", exc)
+            except (ApiError, asyncio.TimeoutError) as exc:
+                log.warning("patternlibrary list failed: %s",
+                            str(exc) or "timed out")
             try:
                 await asyncio.wait_for(stop.wait(), timeout=poll_interval_s)
             except asyncio.TimeoutError:

@@ -214,7 +214,11 @@ class AnalysisPipeline:
         try:
             with self.metrics.timed("collect"):
                 failure = await asyncio.wait_for(
-                    self.collect_failure_data(pod), timeout=collect_s
+                    self.collect_failure_data(
+                        pod,
+                        deadline=Deadline.start(collect_s, clock=self._clock),
+                    ),
+                    timeout=collect_s,
                 )
         except asyncio.TimeoutError:
             log.error("log collection for %s exceeded its %.1fs budget slice",
@@ -279,7 +283,7 @@ class AnalysisPipeline:
         caching_ok = False
         if ai_configured:
             provider, provider_ref_key = await self._resolve_provider_identity(
-                podmortem
+                podmortem, deadline=deadline
             )
             caching_ok = provider is not None and provider.spec.caching_enabled
         recall: Optional[RecallDecision] = None
@@ -408,21 +412,33 @@ class AnalysisPipeline:
         return result
 
     # ------------------------------------------------------------------
-    async def collect_failure_data(self, pod: Pod) -> PodFailureData:
+    async def collect_failure_data(
+        self, pod: Pod, *, deadline: Optional[Deadline] = None
+    ) -> PodFailureData:
         """Pod log + namespace events for the pod
         (reference collectPodFailureData, PodFailureWatcher.java:310-345).
         Prefers the previous container's log when the pod restarted (the
-        crash evidence lives there, not in the fresh container)."""
+        crash evidence lives there, not in the fresh container).  Each
+        apiserver call spends from ``deadline`` (the collect slice of the
+        analysis envelope); without one the calls are unbounded — callers
+        on the analysis path always pass the budget."""
         restarted = any(
             cs.restart_count > 0 for cs in (pod.status.container_statuses if pod.status else [])
         )
+
+        def residue() -> Optional[float]:
+            return deadline.remaining() if deadline is not None else None
+
         logs = ""
         try:
-            logs = await self.api.get_log(
-                pod.metadata.name,
-                pod.metadata.namespace,
-                previous=restarted,
-                tail_bytes=self.config.log_tail_bytes,
+            logs = await asyncio.wait_for(
+                self.api.get_log(
+                    pod.metadata.name,
+                    pod.metadata.namespace,
+                    previous=restarted,
+                    tail_bytes=self.config.log_tail_bytes,
+                ),
+                timeout=residue(),
             )
         except NotFoundError:
             raise
@@ -431,7 +447,10 @@ class AnalysisPipeline:
                         pod.qualified_name(), exc)
         events: list[KubeEvent] = []
         try:
-            raw_events = await self.api.list("Event", namespace=pod.metadata.namespace)
+            raw_events = await asyncio.wait_for(
+                self.api.list("Event", namespace=pod.metadata.namespace),
+                timeout=residue(),
+            )
             for raw in raw_events:
                 event = KubeEvent.parse(raw)
                 if event.regarding is None or event.regarding.name != pod.metadata.name:
@@ -442,19 +461,22 @@ class AnalysisPipeline:
                 if event.reporting_controller == self.config.reporting_controller:
                     continue
                 events.append(event)
-        except ApiError as exc:
+        except (ApiError, asyncio.TimeoutError) as exc:
+            # events are best-effort evidence: a timeout here degrades to
+            # logs-only instead of burning the rest of the collect slice
             log.debug("event list failed for %s: %s", pod.qualified_name(), exc)
         return PodFailureData(pod=pod, logs=logs, events=events, collection_time=now_iso())
 
     # ------------------------------------------------------------------
     async def _resolve_provider_identity(
-        self, podmortem: Podmortem
+        self, podmortem: Podmortem, *, deadline: Optional[Deadline] = None
     ) -> "tuple[Optional[AIProvider], Optional[str]]":
         """Fetch the CR's AIProvider and derive the reuse-identity key:
         ``namespace/name@spec-hash`` over the spec fields that shape the
         generated text (the same identity basis as ResponseCache.key).
-        Fetch failures return (None, bare ref key): recall proceeds
-        reuse-disabled and the AI leg's own fetch reports the error."""
+        Fetch failures — including the ``deadline`` residue expiring —
+        return (None, bare ref key): recall proceeds reuse-disabled and the
+        AI leg's own fetch reports the error."""
         import hashlib
         import json
 
@@ -462,8 +484,11 @@ class AnalysisPipeline:
         namespace = ref.namespace or podmortem.metadata.namespace or "default"
         ref_key = f"{namespace}/{ref.name}"
         try:
-            provider_dict = await self.api.get("AIProvider", ref.name, namespace)
-        except ApiError:
+            provider_dict = await asyncio.wait_for(
+                self.api.get("AIProvider", ref.name, namespace),
+                timeout=deadline.remaining() if deadline is not None else None,
+            )
+        except (ApiError, asyncio.TimeoutError):
             return None, ref_key
         provider = AIProvider.parse(provider_dict)
         spec = provider.spec
@@ -517,18 +542,29 @@ class AnalysisPipeline:
         namespace = ref.namespace or podmortem.metadata.namespace or "default"
         if provider is None:  # not pre-fetched by the recall identity step
             try:
-                provider_dict = await self.api.get("AIProvider", ref.name, namespace)
+                provider_dict = await asyncio.wait_for(
+                    self.api.get("AIProvider", ref.name, namespace),
+                    timeout=(
+                        deadline.remaining() if deadline is not None else None
+                    ),
+                )
             except NotFoundError:
                 message = f"AIProvider {namespace}/{ref.name} not found"
                 log.warning("%s (podmortem %s)", message, podmortem.qualified_name())
                 await self.events.emit_analysis_error(pod, podmortem, message)
                 self.metrics.incr("provider_missing")
                 return AIResponse(error=message)
-            except ApiError as exc:
-                await self.events.emit_analysis_error(pod, podmortem, f"AIProvider fetch failed: {exc}")
-                return AIResponse(error=str(exc))
+            except (ApiError, asyncio.TimeoutError) as exc:
+                message = (
+                    f"AIProvider fetch failed: "
+                    f"{str(exc) or 'deadline budget exhausted'}"
+                )
+                await self.events.emit_analysis_error(pod, podmortem, message)
+                return AIResponse(error=message)
             provider = AIProvider.parse(provider_dict)
-        provider_config = await resolve_provider_config(self.api, provider)
+        provider_config = await resolve_provider_config(
+            self.api, provider, deadline=deadline
+        )
         remaining = deadline.remaining() if deadline is not None else None
         request = AnalysisRequest(
             analysis_result=result, provider_config=provider_config,

@@ -211,14 +211,25 @@ def default_registry() -> ProviderRegistry:
 # --------------------------------------------------------------------------
 
 
-async def resolve_provider_config(api: KubeApi, provider: AIProvider) -> AIProviderConfig:
+async def resolve_provider_config(
+    api: KubeApi,
+    provider: AIProvider,
+    *,
+    deadline: Optional[Deadline] = None,
+) -> AIProviderConfig:
+    """CR spec + defaults + auth token from the referenced Secret.  The
+    Secret read spends from ``deadline`` (the analysis envelope residue);
+    a timeout degrades exactly like a fetch error — config without a token."""
     spec = provider.spec
     token: Optional[str] = None
     auth = spec.authentication_ref
     if auth is not None and auth.secret_name:
         try:
-            secret_dict = await api.get(
-                "Secret", auth.secret_name, provider.metadata.namespace or "default"
+            secret_dict = await asyncio.wait_for(
+                api.get(
+                    "Secret", auth.secret_name, provider.metadata.namespace or "default"
+                ),
+                timeout=deadline.remaining() if deadline is not None else None,
             )
             token = Secret.parse(secret_dict).decoded(auth.secret_key or "token")
             if token is None:
@@ -228,8 +239,9 @@ async def resolve_provider_config(api: KubeApi, provider: AIProvider) -> AIProvi
         except NotFoundError:
             log.warning("auth secret %s not found for provider %s",
                         auth.secret_name, provider.metadata.name)
-        except ApiError as exc:
-            log.warning("failed reading auth secret for %s: %s", provider.metadata.name, exc)
+        except (ApiError, asyncio.TimeoutError) as exc:
+            log.warning("failed reading auth secret for %s: %s",
+                        provider.metadata.name, str(exc) or "timed out")
     return AIProviderConfig(
         provider_id=spec.provider_id,
         api_url=spec.api_url,

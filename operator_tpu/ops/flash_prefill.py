@@ -41,6 +41,7 @@ def flash_prefill_supported(t: int, s: int, cache_offset) -> bool:
     exactly the q range (mini-cache, offset 0) and T divides into blocks."""
     if t != s or t < 2:
         return False
+    # graftlint: disable=GL002 reason=the isinstance guard short-circuits before any tracer comparison; a traced cache_offset yields False without concretising
     if not isinstance(cache_offset, int) or cache_offset != 0:
         return False
     q_block = min(128, t)

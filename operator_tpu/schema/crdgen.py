@@ -238,9 +238,40 @@ def render_all() -> str:
     return yaml.dump_all(all_crds(), Dumper=_NoAliasDumper, sort_keys=False)
 
 
+def check_manifest(path: str) -> bool:
+    """True when the committed manifest at ``path`` matches the generated
+    output (modulo trailing whitespace) — the drift check graftlint rule
+    GL005 runs in CI; exposed here so ``--check`` works in regen loops."""
+    import pathlib
+
+    committed = pathlib.Path(path)
+    if not committed.exists():
+        return False
+    return committed.read_text(encoding="utf-8").strip() == render_all().strip()
+
+
 if __name__ == "__main__":
     import sys
 
+    if "--check" in sys.argv[1:]:
+        import pathlib
+
+        args = [a for a in sys.argv[1:] if a != "--check"]
+        target = args[0] if args else "deploy/crds/podmortem-crds.yaml"
+        if not pathlib.Path(target).exists():
+            # a path error must not read as a drift diagnosis
+            print(f"{target} not found (run from the repo root, or pass "
+                  f"the manifest path)", file=sys.stderr)
+            sys.exit(2)
+        if check_manifest(target):
+            print(f"{target} matches crdgen output")
+            sys.exit(0)
+        print(
+            f"{target} drifted from crdgen output — regenerate with "
+            f"`python -m operator_tpu.schema.crdgen > {target}`",
+            file=sys.stderr,
+        )
+        sys.exit(1)
     try:
         print(render_all())
     except BrokenPipeError:

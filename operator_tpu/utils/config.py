@@ -28,6 +28,11 @@ class OperatorConfig:
     pattern_cache_directory: str = "/shared/patterns"  # application.properties:4-5
     git_binary: str = "git"
     sync_timeout_s: float = 120.0
+    # budget for single control-loop apiserver calls outside an analysis
+    # envelope (pattern-library status patches, secret reads, list sweeps):
+    # enforced so a wedged apiserver connection stalls one reconcile tick,
+    # not the whole reconciler forever (graftlint GL003)
+    kube_call_timeout_s: float = 15.0
 
     # --- storage (reference AnalysisStorageService.java:48,74-76) ---------
     max_recent_failures: int = 10

@@ -2,57 +2,34 @@
 """CI lint: every ``podmortem_*`` metric the code can emit must be
 documented under docs/.
 
-Two emission shapes are scanned in ``operator_tpu/``:
-
-- ``metrics.incr("name")`` — rendered by the registry as
-  ``podmortem_<name>_total`` (utils/timing.py prometheus());
-- literal ``"podmortem_..."`` strings (the stage-summary metric name).
-
-Exit 1 listing any metric that no markdown file under docs/ mentions —
-an operator alerting on an undocumented counter name is debugging blind.
+Thin shim: the scan now lives in graftlint's GL005 rule
+(``operator_tpu/analysis/rules/gl005_drift.py``) so the metric-docs
+contract is enforced by ``python -m operator_tpu.analysis`` alongside the
+other generated-artifact checks.  This entry point is kept so existing CI
+invocations (and operator runbooks) of ``python scripts/check_metric_docs.py``
+keep working with the same verdict and output.
 """
 
 from __future__ import annotations
 
 import pathlib
-import re
 import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
-#: every string literal inside an .incr(...) argument list (conditional
-#: expressions like incr("a" if x else "b") emit BOTH names)
-INCR_CALL = re.compile(r"\.incr\(([^)]*)\)", re.DOTALL)
-STRING = re.compile(r"[\"']([a-z0-9_]+)[\"']")
-#: fully-formed metric names in code (the stage-summary constant); a bare
-#: "podmortem_..." dict key without a metric suffix is not a metric
-LITERAL = re.compile(
-    r"[\"'](podmortem_[a-z0-9_]+_total|podmortem_[a-z0-9_]+_milliseconds)[\"']"
+sys.path.insert(0, str(ROOT))
+
+from operator_tpu.analysis.rules.gl005_drift import (  # noqa: E402
+    emitted_metrics as _emitted_metrics,
+    undocumented_metrics,
 )
 
 
 def emitted_metrics() -> set[str]:
-    metrics: set[str] = set()
-    for path in (ROOT / "operator_tpu").rglob("*.py"):
-        text = path.read_text(encoding="utf-8", errors="replace")
-        for args in INCR_CALL.findall(text):
-            for name in STRING.findall(args):
-                metrics.add(f"podmortem_{name}_total")
-        for name in LITERAL.findall(text):
-            metrics.add(name)
-    return metrics
-
-
-def documented_text() -> str:
-    blobs = []
-    for path in (ROOT / "docs").glob("*.md"):
-        blobs.append(path.read_text(encoding="utf-8", errors="replace"))
-    blobs.append((ROOT / "README.md").read_text(encoding="utf-8", errors="replace"))
-    return "\n".join(blobs)
+    return _emitted_metrics(ROOT)
 
 
 def main() -> int:
-    docs = documented_text()
-    missing = sorted(m for m in emitted_metrics() if m not in docs)
+    missing = undocumented_metrics(ROOT)
     if missing:
         print("undocumented podmortem_* metrics (add them to docs/METRICS.md):")
         for name in missing:

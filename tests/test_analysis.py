@@ -1,0 +1,744 @@
+"""graftlint (operator_tpu/analysis) — rule fixtures, baseline, pragmas.
+
+Each rule gets at least one positive fixture (the violation is found) and
+one negative fixture (the legal idiom is NOT flagged); plus the baseline
+round-trip, pragma suppression semantics, and the repo gate itself
+(`python -m operator_tpu.analysis --baseline analysis-baseline.json` must
+be clean — the CI contract).
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from operator_tpu.analysis import (
+    Baseline,
+    load_baseline,
+    run_analysis,
+    rules_by_id,
+    write_baseline,
+)
+from operator_tpu.analysis.__main__ import main as cli_main
+from operator_tpu.analysis.runner import collect_context
+from operator_tpu.analysis.rules.gl005_drift import undocumented_metrics
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def make_ctx(tmp_path: Path, files: dict[str, str]):
+    for rel, text in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(text), encoding="utf-8")
+    return collect_context(tmp_path)
+
+
+def run_rule(tmp_path, rule_id: str, files: dict[str, str]):
+    ctx = make_ctx(tmp_path, files)
+    findings, pragma_errors = run_analysis(ctx, rules_by_id([rule_id]))
+    return findings, pragma_errors
+
+
+# ---------------------------------------------------------------------------
+# GL001 host-sync-in-hot-path
+# ---------------------------------------------------------------------------
+
+
+def test_gl001_positive_host_sync_reachable_from_jit(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL001", {
+        "operator_tpu/ops/foo.py": """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def entry(x):
+                return helper(x)
+
+            def helper(x):
+                y = np.asarray(x)      # host materialisation inside hot path
+                return y.item()        # and an explicit sync
+        """,
+    })
+    messages = [f.message for f in findings]
+    assert any("np.asarray" in m for m in messages)
+    assert any(".item()" in m for m in messages)
+    assert all(f.rule == "GL001" for f in findings)
+    assert all(f.path == "operator_tpu/ops/foo.py" for f in findings)
+
+
+def test_gl001_negative_host_code_and_static_float(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL001", {
+        "operator_tpu/ops/foo.py": """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def entry(x, xs):
+                scale = float(len(xs))   # host arithmetic on a static length
+                return x * scale
+
+            def host_orchestrator(x):
+                # not reachable from any jit entry: host syncs are its job
+                return np.asarray(x).item()
+        """,
+    })
+    assert findings == []
+
+
+def test_gl001_positive_float_on_traced(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL001", {
+        "operator_tpu/ops/foo.py": """
+            import jax
+
+            @jax.jit
+            def entry(x):
+                return float(x + 1)
+        """,
+    })
+    assert len(findings) == 1
+    assert "float() on a traced value" in findings[0].message
+
+
+def test_gl001_reaches_through_self_methods_and_jit_call_form(tmp_path):
+    # jax.jit(self._step) + self-method resolution across the class
+    findings, _ = run_rule(tmp_path, "GL001", {
+        "operator_tpu/serving/eng.py": """
+            import jax
+
+            class Gen:
+                def __init__(self):
+                    self._fn = jax.jit(self._step, donate_argnums=(0,))
+
+                def _step(self, cache, tok):
+                    return self._inner(cache, tok)
+
+                def _inner(self, cache, tok):
+                    return jax.device_get(cache), tok
+        """,
+    })
+    assert len(findings) == 1
+    assert "jax.device_get" in findings[0].message
+    assert findings[0].symbol == "Gen._inner"
+
+
+# ---------------------------------------------------------------------------
+# GL002 tracer-unsafe control flow
+# ---------------------------------------------------------------------------
+
+
+def test_gl002_positive_if_and_while_on_traced(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL002", {
+        "operator_tpu/models/m.py": """
+            import jax
+
+            @jax.jit
+            def entry(x):
+                if x > 0:
+                    x = x - 1
+                while x < 10:
+                    x = x + 1
+                assert x != 3
+                return x
+        """,
+    })
+    assert len(findings) == 3
+    assert any("`if`" in f.message for f in findings)
+    assert any("`while`" in f.message for f in findings)
+    assert any("assert" in f.message for f in findings)
+
+
+def test_gl002_negative_static_idioms(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL002", {
+        "operator_tpu/models/m.py": """
+            import functools
+            import jax
+            import jax.numpy as jnp
+
+            @functools.partial(jax.jit, static_argnames=("flag",))
+            def entry(x, mask=None, flag=False):
+                if flag:                      # static_argnames param
+                    x = x * 2
+                if mask is not None:          # pytree-None dispatch
+                    x = jnp.where(mask, x, 0)
+                if x.shape[0] > 8:            # shape metadata is static
+                    x = x[:8]
+                for _ in range(x.ndim):       # static iteration
+                    x = x[None]
+                return x
+        """,
+    })
+    assert findings == []
+
+
+def test_gl002_jitted_lambda_body_checked(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL002", {
+        "operator_tpu/ops/l.py": """
+            import jax
+
+            f = jax.jit(lambda x: 1 if x > 0 else 0)
+        """,
+    })
+    assert len(findings) == 1
+    assert "conditional expression" in findings[0].message
+
+
+def test_gl002_pallas_kernel_body_checked(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL002", {
+        "operator_tpu/ops/k.py": """
+            from jax.experimental import pallas as pl
+
+            def _kernel(x_ref, o_ref):
+                v = x_ref[0]
+                if v > 0:
+                    o_ref[0] = v
+
+            def run(x):
+                return pl.pallas_call(_kernel, out_shape=x)(x)
+        """,
+    })
+    assert len(findings) == 1
+    assert findings[0].symbol == "_kernel"
+
+
+def test_gl002_nested_def_locals_do_not_leak_into_outer_scope(tmp_path):
+    """A nested helper's tainted local must not pollute the enclosing
+    function's taint env (scopes are separate), and host control flow on
+    an identically-named outer local stays legal."""
+    findings, _ = run_rule(tmp_path, "GL002", {
+        "operator_tpu/models/m.py": """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def entry(x):
+                def helper(y):
+                    val = jnp.sum(y)
+                    return val
+
+                val = 2
+                if val > 1:          # host int named like helper's local
+                    return helper(x)
+                return x
+        """,
+    })
+    assert findings == []
+
+
+def test_gl001_nested_called_def_reports_exactly_once(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL001", {
+        "operator_tpu/ops/foo.py": """
+            import jax
+
+            @jax.jit
+            def entry(x):
+                def helper(y):
+                    return y.item()
+
+                return helper(x)
+        """,
+    })
+    assert len(findings) == 1
+    assert findings[0].symbol == "entry.helper"
+
+
+# ---------------------------------------------------------------------------
+# GL003 deadline propagation
+# ---------------------------------------------------------------------------
+
+
+def test_gl003_positive_unbudgeted_kube_call(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL003", {
+        "operator_tpu/operator/pipeline.py": """
+            class P:
+                async def fetch(self, name):
+                    return await self.api.get("Pod", name, "ns")
+        """,
+    })
+    assert len(findings) == 1
+    assert "self.api.get" in findings[0].message
+    assert findings[0].symbol == "P.fetch"
+
+
+def test_gl003_negative_budgeted_calls(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL003", {
+        "operator_tpu/operator/pipeline.py": """
+            import asyncio
+
+            class P:
+                async def threads_deadline(self, name, *, deadline=None):
+                    return await asyncio.wait_for(
+                        self.api.get("Pod", name, "ns"),
+                        timeout=deadline.remaining(),
+                    )
+
+                async def keyword(self, req):
+                    return await self.api.watch("Pod", timeout=30.0)
+
+                async def internal_await(self, queue):
+                    # not external: plain queue get never flags
+                    return await queue.get()
+        """,
+    })
+    assert findings == []
+
+
+def test_gl003_positive_unspent_deadline_parameter(tmp_path):
+    """A deadline parameter the function never spends bounds nothing —
+    the call itself must carry the budget."""
+    findings, _ = run_rule(tmp_path, "GL003", {
+        "operator_tpu/operator/pipeline.py": """
+            class P:
+                async def fetch(self, name, *, deadline=None):
+                    return await self.api.get("Pod", name, "ns")
+        """,
+    })
+    assert len(findings) == 1
+
+
+def test_gl003_positive_literal_none_timeout_is_not_a_budget(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL003", {
+        "operator_tpu/operator/pipeline.py": """
+            import asyncio
+
+            class P:
+                async def kwarg_none(self, name):
+                    return await self.api.get("Pod", name, "ns", timeout=None)
+
+                async def wait_for_none(self, name):
+                    return await asyncio.wait_for(
+                        self.api.get("Pod", name, "ns"), timeout=None
+                    )
+        """,
+    })
+    assert len(findings) == 2
+
+
+def test_gl003_scope_excludes_other_modules(tmp_path):
+    # same code outside the four control-plane files is not in scope
+    findings, _ = run_rule(tmp_path, "GL003", {
+        "operator_tpu/operator/storage.py": """
+            class S:
+                async def fetch(self, name):
+                    return await self.api.get("Pod", name, "ns")
+        """,
+    })
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# GL004 lock discipline
+# ---------------------------------------------------------------------------
+
+_GL004_POSITIVE = {
+    "operator_tpu/memory/state.py": """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+
+            def put(self, key, value):
+                with self._lock:
+                    self._items[key] = value
+
+            def get(self, key):
+                return self._items.get(key)   # unguarded read
+    """,
+}
+
+
+def test_gl004_positive_unguarded_read(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL004", dict(_GL004_POSITIVE))
+    assert len(findings) == 1
+    assert "self._items" in findings[0].message
+    assert findings[0].symbol == "Store.get"
+
+
+def test_gl004_positive_container_mutation_is_a_write(tmp_path):
+    """`self._queue.append(...)` under the lock puts _queue in the guard
+    set; an unlocked .pop() elsewhere is the race the rule exists for."""
+    findings, _ = run_rule(tmp_path, "GL004", {
+        "operator_tpu/memory/state.py": """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._queue = []
+
+                def push(self, item):
+                    with self._lock:
+                        self._queue.append(item)
+
+                def steal(self):
+                    return self._queue.pop()
+        """,
+    })
+    assert len(findings) == 1
+    assert findings[0].symbol == "Q.steal"
+    assert "write" in findings[0].message
+
+
+def test_gl004_bare_name_lock_import_is_detected(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL004", {
+        "operator_tpu/memory/state.py": """
+            from threading import Lock
+
+            class Store:
+                def __init__(self):
+                    self._lock = Lock()
+                    self._items = {}
+
+                def put(self, key, value):
+                    with self._lock:
+                        self._items[key] = value
+
+                def get(self, key):
+                    return self._items.get(key)
+        """,
+    })
+    assert len(findings) == 1
+    assert findings[0].symbol == "Store.get"
+
+
+def test_gl004_closure_access_counts_as_lock_free(tmp_path):
+    """A closure defined under the lock may run on another thread after
+    the lock is released (executor.submit) — its accesses are lock-free."""
+    findings, _ = run_rule(tmp_path, "GL004", {
+        "operator_tpu/memory/state.py": """
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+
+                def put(self, key, value):
+                    with self._lock:
+                        self._items[key] = value
+
+                def flush(self, pool):
+                    with self._lock:
+                        def work():
+                            self._items.clear()
+                        pool.submit(work)
+        """,
+    })
+    assert len(findings) == 1
+    assert findings[0].symbol == "Store.flush.work"
+    assert "write" in findings[0].message
+
+
+def test_gl004_negative_locked_helpers_and_init(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL004", {
+        "operator_tpu/memory/state.py": """
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+                    self._restore()            # init-only helper
+
+                def _restore(self):
+                    self._items["boot"] = 1
+
+                def put(self, key, value):
+                    with self._lock:
+                        self._items[key] = value
+                        self._evict_locked()
+
+                def _evict_locked(self):       # *_locked convention
+                    while len(self._items) > 4:
+                        self._items.popitem()
+
+                def get(self, key):
+                    with self._lock:
+                        return self._items.get(key)
+
+                def flush(self):
+                    with self._lock:
+                        self._flush_inner()
+
+                def _flush_inner(self):        # every call site holds the lock
+                    self._items.clear()
+        """,
+    })
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# GL005 generated-artifact drift
+# ---------------------------------------------------------------------------
+
+
+def test_gl005_positive_undocumented_metric(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL005", {
+        "operator_tpu/mod.py": """
+            def tick(metrics):
+                metrics.incr("special_events")
+        """,
+        "docs/METRICS.md": "# Metrics\n\nnothing documented here\n",
+    })
+    assert len(findings) == 1
+    assert "podmortem_special_events_total" in findings[0].message
+
+
+def test_gl005_negative_documented_metric(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL005", {
+        "operator_tpu/mod.py": """
+            def tick(metrics):
+                metrics.incr("special_events")
+        """,
+        "docs/METRICS.md": "# Metrics\n\n`podmortem_special_events_total` — ticks.\n",
+    })
+    assert findings == []
+
+
+def test_gl005_matches_check_metric_docs_verdict_on_repo():
+    """The rule reproduces scripts/check_metric_docs.py on the live tree:
+    both derive from the same scan, so the verdict must be identical."""
+    import scripts.check_metric_docs as shim
+
+    missing = undocumented_metrics(REPO_ROOT)
+    assert missing == []
+    assert shim.main() == 0
+
+
+def test_gl005_crd_manifest_in_sync_with_crdgen():
+    from operator_tpu.schema.crdgen import render_all
+
+    manifest = (REPO_ROOT / "deploy/crds/podmortem-crds.yaml").read_text()
+    assert manifest.strip() == render_all().strip()
+
+
+# ---------------------------------------------------------------------------
+# pragmas
+# ---------------------------------------------------------------------------
+
+
+def test_pragma_suppresses_with_reason(tmp_path):
+    findings, pragma_errors = run_rule(tmp_path, "GL004", {
+        "operator_tpu/memory/state.py": """
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+
+                def put(self, key, value):
+                    with self._lock:
+                        self._items[key] = value
+
+                def get(self, key):
+                    # graftlint: disable=GL004 reason=lock-free snapshot is deliberate here
+                    return self._items.get(key)
+        """,
+    })
+    assert findings == []
+    assert pragma_errors == []
+
+
+def test_pragma_without_reason_does_not_suppress(tmp_path):
+    source = _GL004_POSITIVE["operator_tpu/memory/state.py"].replace(
+        "return self._items.get(key)   # unguarded read",
+        "return self._items.get(key)  # graftlint" + ": disable=GL004",
+    )
+    findings, pragma_errors = run_rule(
+        tmp_path, "GL004", {"operator_tpu/memory/state.py": source}
+    )
+    assert len(findings) == 1  # still reported
+    assert len(pragma_errors) == 1
+    assert pragma_errors[0].rule == "GL000"
+    assert "reason=" in pragma_errors[0].message
+
+
+def test_pragma_inside_string_literal_is_inert(tmp_path):
+    """Pragma-shaped text in docstrings/strings (rule docs, fixtures)
+    must neither suppress findings nor trip the GL000 malformed check."""
+    files = dict(_GL004_POSITIVE)
+    files["operator_tpu/memory/state.py"] = files[
+        "operator_tpu/memory/state.py"
+    ].replace(
+        "def get(self, key):",
+        'def get(self, key):\n'
+        '                """docs say: graftlint: disable=GL004"""',
+    )
+    findings, pragma_errors = run_rule(tmp_path, "GL004", files)
+    assert len(findings) == 1  # the unguarded read is still reported
+    assert pragma_errors == []  # and no malformed-pragma noise
+
+
+def test_pragma_on_def_line_covers_whole_function(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL004", {
+        "operator_tpu/memory/state.py": """
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+
+                def put(self, key, value):
+                    with self._lock:
+                        self._items[key] = value
+
+                def get(self, key):  # graftlint: disable=GL004 reason=snapshot reader
+                    first = self._items.get(key)
+                    return first or self._items.get("default")
+        """,
+    })
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_round_trip(tmp_path):
+    ctx = make_ctx(tmp_path, dict(_GL004_POSITIVE))
+    findings, _ = run_analysis(ctx, rules_by_id(["GL004"]))
+    assert findings
+
+    baseline_path = tmp_path / "analysis-baseline.json"
+    write_baseline(baseline_path, findings)
+    baseline = load_baseline(baseline_path)
+
+    # same findings -> all absorbed, nothing new, nothing stale
+    new, stale = baseline.filter(findings)
+    assert new == [] and stale == []
+
+    # identity survives line drift: shift the file down three lines
+    shifted = "\n\n\n" + (tmp_path / "operator_tpu/memory/state.py").read_text()
+    (tmp_path / "operator_tpu/memory/state.py").write_text(shifted)
+    ctx2 = collect_context(tmp_path)
+    findings2, _ = run_analysis(ctx2, rules_by_id(["GL004"]))
+    new2, stale2 = baseline.filter(findings2)
+    assert new2 == [] and stale2 == []
+
+    # debt paid -> the entry turns stale, the gate stays green
+    new3, stale3 = baseline.filter([])
+    assert new3 == [] and len(stale3) == 1
+
+
+def test_baseline_counts_absorb_exact_multiplicity(tmp_path):
+    ctx = make_ctx(tmp_path, {
+        "operator_tpu/operator/pipeline.py": """
+            class P:
+                async def one(self, name):
+                    return await self.api.get("Pod", name, "ns")
+        """,
+    })
+    findings, _ = run_analysis(ctx, rules_by_id(["GL003"]))
+    baseline = Baseline.from_findings(findings)
+    # a second identical finding in the same symbol is NOT absorbed
+    doubled = findings + findings
+    new, _ = baseline.filter(doubled)
+    assert len(new) == len(findings)
+
+
+# ---------------------------------------------------------------------------
+# the repo gate (acceptance: the committed tree is clean)
+# ---------------------------------------------------------------------------
+
+
+def test_repo_gate_is_clean(capsys):
+    rc = cli_main([
+        "--root", str(REPO_ROOT),
+        "--baseline", str(REPO_ROOT / "analysis-baseline.json"),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, f"graftlint found new issues:\n{out}"
+    assert "clean" in out
+
+
+def test_cli_list_rules(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in ("GL001", "GL002", "GL003", "GL004", "GL005"):
+        assert rule_id in out
+
+
+def test_cli_unknown_rule_is_usage_error(capsys):
+    assert cli_main(["--rules", "GL999"]) == 2
+
+
+def test_cli_partial_rules_run_does_not_report_other_rules_stale(tmp_path, capsys):
+    """`--rules GL001` cannot vouch for GL003 entries — they are
+    unchecked, not stale, and must not be reported for deletion."""
+    (tmp_path / "operator_tpu/operator").mkdir(parents=True)
+    (tmp_path / "operator_tpu/operator/pipeline.py").write_text(
+        "class P:\n"
+        "    async def fetch(self, name):\n"
+        "        return await self.api.get('Pod', name, 'ns')\n"
+    )
+    bl = tmp_path / "bl.json"
+    assert cli_main([
+        "--root", str(tmp_path), "--baseline", str(bl), "--write-baseline",
+    ]) == 0
+    rc = cli_main([
+        "--root", str(tmp_path), "--rules", "GL001", "--baseline", str(bl),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "stale" not in out
+
+
+def test_cli_write_baseline_refuses_partial_runs(tmp_path, capsys):
+    rc = cli_main([
+        "--root", str(REPO_ROOT), "--rules", "GL003",
+        "--baseline", str(tmp_path / "bl.json"), "--write-baseline",
+    ])
+    assert rc == 2
+    assert "FULL analysis" in capsys.readouterr().err
+    assert not (tmp_path / "bl.json").exists()
+
+
+def test_cli_nonexistent_baseline_is_usage_error(tmp_path, capsys):
+    """A moved/typo'd baseline must not re-present grandfathered debt as
+    new regressions — fail loudly instead."""
+    rc = cli_main([
+        "--root", str(REPO_ROOT),
+        "--baseline", str(tmp_path / "moved-elsewhere.json"),
+    ])
+    assert rc == 2
+    assert "no such baseline file" in capsys.readouterr().err
+
+
+def test_cli_nonexistent_path_is_usage_error_not_clean(tmp_path, capsys):
+    """A typo'd path must fail loudly, never 'clean — 0 file(s)'."""
+    rc = cli_main([
+        "--root", str(tmp_path), str(tmp_path / "no_such_dir"),
+    ])
+    assert rc == 2
+    assert "no such path" in capsys.readouterr().err
+
+
+def test_cli_out_of_root_path_is_usage_error(tmp_path, capsys):
+    outside = tmp_path / "outside.py"
+    outside.write_text("x = 1\n")
+    inside = tmp_path / "repo"
+    inside.mkdir()
+    rc = cli_main(["--root", str(inside), str(outside)])
+    assert rc == 2
+    assert "outside the analysis root" in capsys.readouterr().err
+
+
+def test_cli_json_format(tmp_path, capsys):
+    (tmp_path / "operator_tpu/operator").mkdir(parents=True)
+    (tmp_path / "operator_tpu/operator/pipeline.py").write_text(
+        "class P:\n"
+        "    async def fetch(self, name):\n"
+        "        return await self.api.get('Pod', name, 'ns')\n"
+    )
+    rc = cli_main(["--root", str(tmp_path), "--format", "json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["findings"][0]["rule"] == "GL003"
