@@ -54,6 +54,7 @@ from operator_tpu.schema import (
     PodmortemSpec,
     PodStatus,
 )
+from operator_tpu.obs import FlightRecorder, Tracer, render_tree
 from operator_tpu.serving.engine import BatchedGenerator, ServingEngine
 from operator_tpu.serving.provider import TPUNativeProvider
 from operator_tpu.utils.config import OperatorConfig
@@ -86,8 +87,13 @@ async def main(log_path: str, use_tpu_native: bool = False) -> None:
         )
     provider_id = "tpu-native" if use_tpu_native else "template"
 
+    # flight recorder (docs/OBSERVABILITY.md): every analysis below runs
+    # under a trace; the first one's span tree is rendered at the end —
+    # the demo doubles as an observability smoke test
+    recorder = FlightRecorder(metrics=metrics)
     pipeline = AnalysisPipeline(api, engine, config=config, metrics=metrics,
-                                providers=providers)
+                                providers=providers,
+                                tracer=Tracer(recorder=recorder))
     cache = PodmortemCache(api)
     watcher = PodFailureWatcher(api, pipeline, config=config, metrics=metrics,
                                 cache=cache)
@@ -176,6 +182,14 @@ async def main(log_path: str, use_tpu_native: bool = False) -> None:
     print("\n=== Pod annotations ===")
     for key, value in annotations.items():
         print(f"{key}: {value[:160]}")
+
+    # oldest record = the cold analysis; its tree shows where the cold
+    # path's time went, stage by stage (queue wait vs prefill vs decode
+    # on the engine span when --tpu-native)
+    cold_traces = recorder.traces()
+    if cold_traces:
+        print("\n=== Flight recorder: the cold analysis's span tree ===")
+        print(render_tree(cold_traces[-1].trace))
 
     counters = metrics.snapshot()["counters"]
     print("\n=== Incident memory (the recurring-failure hot path) ===")

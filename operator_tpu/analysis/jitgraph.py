@@ -51,6 +51,38 @@ JAX_ARRAY_SUBMODULES = {"lax", "nn", "numpy", "random", "scipy"}
 HOF_NAMES = {"scan", "vmap", "pmap", "checkpoint", "remat", "partial",
              "fori_loop", "while_loop", "cond", "switch", "custom_vjp",
              "shard_map", "named_call"}
+#: trace-inert context managers: profiler/span metadata that neither
+#: syncs the host nor yields traced values — ``jax.profiler
+#: .TraceAnnotation``/``StepTraceAnnotation``, ``jax.named_scope``, and
+#: the obs tracer's ``span()``/``trace()`` (operator_tpu/obs/span.py).
+#: The serving engine wraps its prefill/decode dispatches in these
+#: (engine._annotation); GL001/GL002 must stay quiet on them, and taint
+#: must not flow out of them (their return is a context object, not an
+#: array).
+TRACE_INERT_CALLS = {"TraceAnnotation", "StepTraceAnnotation",
+                     "named_scope", "_annotation"}
+#: receivers whose ``.span()``/``.trace()`` methods are span context
+#: managers, not array ops — ``jnp.trace(x)`` (the matrix trace!) must
+#: stay tainted, so the generic method names require a tracer-shaped
+#: receiver
+_TRACER_RECEIVERS = {"profiler", "tracer", "obs", "TRACER"}
+
+
+def is_trace_inert_call(func: ast.AST) -> bool:
+    """Is this call a trace/profiler annotation (see TRACE_INERT_CALLS)?"""
+    chain = _attr_chain(func)
+    if not chain:
+        return False
+    if chain[-1] in TRACE_INERT_CALLS:
+        return True
+    if chain[-1] in ("span", "trace"):
+        if chain == ["span"] or chain == ["obs_span"]:
+            return True  # `from operator_tpu.obs import span [as obs_span]`
+        if len(chain) >= 2 and (
+            chain[-2] in _TRACER_RECEIVERS or "trace" in chain[-2].lower()
+        ):
+            return True  # jax.profiler.trace / self.tracer.span / obs.span
+    return False
 
 
 def iter_scope(stmt: ast.AST):
@@ -496,6 +528,11 @@ class JitGraph:
         if isinstance(expr, ast.Call):
             func = expr.func
             if isinstance(func, ast.Name) and func.id in SANITIZING_CALLS:
+                return False
+            # BEFORE the array-namespace check: jax.profiler.* and
+            # jax.named_scope are jax-rooted but trace-inert — their
+            # result is a context object, never a traced array
+            if is_trace_inert_call(func):
                 return False
             if _is_array_namespace_call(func):
                 return True

@@ -18,6 +18,7 @@ Counters: ``podmortem_recall_{hit,near,miss}_total`` on ``/metrics``.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 from dataclasses import dataclass, field
 from typing import Optional
@@ -53,6 +54,10 @@ class RecallDecision:
     #: (prior incident, similarity score) pairs for prompt injection,
     #: best first — non-empty only on near
     neighbors: list[tuple[Incident, float]] = field(default_factory=list)
+    #: flight-recorder trace id of the PREVIOUS sighting's analysis
+    #: (captured before this sighting overwrote it) — how a recurrence
+    #: links back to its prior timeline (docs/OBSERVABILITY.md)
+    prior_trace_id: Optional[str] = None
 
 
 class IncidentMemory:
@@ -68,6 +73,7 @@ class IncidentMemory:
         top_k: int = 3,
         configmap: Optional[str] = None,
         flush_interval_s: float = 30.0,
+        kube_timeout_s: float = 15.0,
     ) -> None:
         embedder = embedder or HashingEmbedder()
         # explicit None checks: an EMPTY store/index is falsy (__len__) and
@@ -84,6 +90,11 @@ class IncidentMemory:
         self.top_k = max(1, top_k)
         self.configmap = configmap
         self.flush_interval_s = flush_interval_s
+        #: per-call budget for the ConfigMap snapshot/restore kube calls
+        #: (mirrors OperatorConfig.kube_call_timeout_s): the flush rides
+        #: the analysis pipeline's remember stage, and a wedged apiserver
+        #: connection must cost one bounded attempt, not the analysis task
+        self.kube_timeout_s = kube_timeout_s
         self._last_flush = 0.0
         if len(self.store):
             # journal-restored incidents must be queryable immediately
@@ -97,6 +108,7 @@ class IncidentMemory:
         *,
         allow_reuse: bool = True,
         provider_ref: Optional[str] = None,
+        trace_id: Optional[str] = None,
     ) -> RecallDecision:
         """Classify one analyzed failure against memory.  Every call is a
         sighting: an exact fingerprint match bumps the incident's
@@ -117,19 +129,26 @@ class IncidentMemory:
         if expired:
             self.index.remove(expired)
         incident = self.store.get(fingerprint.digest)
+        prior_trace_id: Optional[str] = None
         if incident is not None:
+            # the PRIOR sighting's trace, read before this sighting's
+            # trace id overwrites it on the stored incident
+            prior_trace_id = incident.last_trace_id
             # reuse is per provider ref: this CR only ever gets back an
             # analysis ITS OWN provider produced earlier
             cached = incident.analyses.get(provider_ref or "")
             reuse = (
                 allow_reuse and cached is not None and bool(cached.explanation)
             )
-            incident = self.store.record_recurrence(fingerprint.digest, reused=reuse)
+            incident = self.store.record_recurrence(
+                fingerprint.digest, reused=reuse, trace_id=trace_id
+            )
             # incident is None only if eviction raced the lookup — fall
             # through to near/miss rather than reuse a vanished record
             if reuse and incident is not None:
                 return RecallDecision(
-                    RECALL_HIT, fingerprint, incident=incident, analysis=cached
+                    RECALL_HIT, fingerprint, incident=incident, analysis=cached,
+                    prior_trace_id=prior_trace_id,
                 )
         neighbors: list[tuple[Incident, float]] = []
         for digest, score in self.index.query(
@@ -144,9 +163,13 @@ class IncidentMemory:
         neighbors = neighbors[: self.top_k]
         if neighbors:
             return RecallDecision(
-                RECALL_NEAR, fingerprint, incident=incident, neighbors=neighbors
+                RECALL_NEAR, fingerprint, incident=incident, neighbors=neighbors,
+                prior_trace_id=prior_trace_id,
             )
-        return RecallDecision(RECALL_MISS, fingerprint, incident=incident)
+        return RecallDecision(
+            RECALL_MISS, fingerprint, incident=incident,
+            prior_trace_id=prior_trace_id,
+        )
 
     # ------------------------------------------------------------------
     def insert(
@@ -160,6 +183,7 @@ class IncidentMemory:
         seen_recorded: bool = False,
         provider_ref: Optional[str] = None,
         cacheable: bool = True,
+        trace_id: Optional[str] = None,
     ) -> Optional[Incident]:
         """Remember a completed analysis (upsert: a class first seen
         pattern-only gains its analysis text when the AI leg later
@@ -214,6 +238,7 @@ class IncidentMemory:
             first_seen=now,
             last_seen=now,
             related=list(related or []),
+            last_trace_id=trace_id,
         )
         evicted = self.store.upsert(incident, bump_if_existing=not seen_recorded)
         if evicted:
@@ -247,11 +272,15 @@ class IncidentMemory:
         from ..operator.kubeapi import ApiError, NotFoundError  # lazy: no cycle
 
         try:
-            cm = await api.get("ConfigMap", self.configmap, namespace)
+            cm = await asyncio.wait_for(
+                api.get("ConfigMap", self.configmap, namespace),
+                timeout=self.kube_timeout_s,
+            )
         except NotFoundError:
             return 0
-        except ApiError as exc:
-            log.warning("incident ConfigMap restore failed: %s", exc)
+        except (ApiError, asyncio.TimeoutError) as exc:
+            log.warning("incident ConfigMap restore failed: %s",
+                        str(exc) or "timed out")
             return 0
         loaded = self.store.load_snapshot((cm.get("data") or {}).get(CONFIGMAP_KEY, ""))
         if loaded:
@@ -281,19 +310,28 @@ class IncidentMemory:
         try:
             data = {CONFIGMAP_KEY: self.store.snapshot()}
             try:
-                await api.patch("ConfigMap", self.configmap, namespace, {"data": data})
+                await asyncio.wait_for(
+                    api.patch("ConfigMap", self.configmap, namespace,
+                              {"data": data}),
+                    timeout=self.kube_timeout_s,
+                )
             except NotFoundError:
-                await api.create("ConfigMap", {
-                    "apiVersion": "v1", "kind": "ConfigMap",
-                    "metadata": {"name": self.configmap, "namespace": namespace},
-                    "data": data,
-                })
+                await asyncio.wait_for(
+                    api.create("ConfigMap", {
+                        "apiVersion": "v1", "kind": "ConfigMap",
+                        "metadata": {"name": self.configmap,
+                                     "namespace": namespace},
+                        "data": data,
+                    }),
+                    timeout=self.kube_timeout_s,
+                )
             # advance the throttle only on SUCCESS: a transient apiserver
             # error must not suppress the retry for a whole interval
             self._last_flush = now
             return True
-        except ApiError as exc:
-            log.warning("incident ConfigMap flush failed: %s", exc)
+        except (ApiError, asyncio.TimeoutError) as exc:
+            log.warning("incident ConfigMap flush failed: %s",
+                        str(exc) or "timed out")
             return False
 
 
@@ -316,4 +354,5 @@ def build_incident_memory(config, *, embedder: Optional[Embedder] = None):
         top_k=config.recall_top_k,
         configmap=config.memory_configmap or None,
         flush_interval_s=config.memory_flush_interval_s,
+        kube_timeout_s=getattr(config, "kube_call_timeout_s", 15.0),
     )

@@ -90,6 +90,10 @@ class Incident:
     #: fingerprints of near-miss incidents this analysis was linked to
     #: (retrieval-augmented context at generation time)
     related: list[str] = field(default_factory=list)
+    #: flight-recorder trace id of the most recent sighting's analysis
+    #: (operator_tpu/obs/) — a recurrence links straight to the prior
+    #: timeline via GET /traces/{id}
+    last_trace_id: Optional[str] = None
 
     def to_dict(self) -> dict:
         return to_dict(self)
@@ -161,6 +165,8 @@ class IncidentStore:
                 incident.reused_count = int(record.get("reused", incident.reused_count))
                 incident.last_seen = record.get("last_seen", incident.last_seen)
                 incident.last_seen_ts = float(record.get("ts", incident.last_seen_ts))
+                # pre-obs journals have no trace field; keep what we had
+                incident.last_trace_id = record.get("trace", incident.last_trace_id)
                 self._entries.move_to_end(record["fp"])
         elif op == "evict":
             self._entries.pop(record.get("fp", ""), None)
@@ -232,6 +238,7 @@ class IncidentStore:
                         existing.related.append(digest)
                 existing.last_seen = incident.last_seen or now_iso()
                 existing.last_seen_ts = now
+                existing.last_trace_id = incident.last_trace_id or existing.last_trace_id
                 incident = existing
             else:
                 incident.first_seen = incident.first_seen or now_iso()
@@ -245,9 +252,13 @@ class IncidentStore:
                 self._append({"op": "evict", "fp": digest})
             return evicted
 
-    def record_recurrence(self, digest: str, *, reused: bool = False) -> Optional[Incident]:
+    def record_recurrence(
+        self, digest: str, *, reused: bool = False, trace_id: Optional[str] = None
+    ) -> Optional[Incident]:
         """Bump the sighting counters of an exact fingerprint hit; returns
-        the updated incident (None when the digest is unknown)."""
+        the updated incident (None when the digest is unknown).
+        ``trace_id`` stamps this sighting's flight-recorder trace onto the
+        incident so the NEXT recurrence can link back to it."""
         with self._lock:
             incident = self._entries.get(digest)
             if incident is None:
@@ -257,12 +268,17 @@ class IncidentStore:
                 incident.reused_count += 1
             incident.last_seen = now_iso()
             incident.last_seen_ts = self._clock()
+            if trace_id:
+                incident.last_trace_id = trace_id
             self._entries.move_to_end(digest)
-            self._append({
+            record = {
                 "op": "touch", "fp": digest, "seen": incident.seen_count,
                 "reused": incident.reused_count, "last_seen": incident.last_seen,
                 "ts": incident.last_seen_ts,
-            })
+            }
+            if incident.last_trace_id:
+                record["trace"] = incident.last_trace_id
+            self._append(record)
             return incident
 
     def _evict_locked(self, now: float) -> list[str]:

@@ -1,0 +1,81 @@
+"""Render a flight-recorder JSONL dump as flame-style text trees.
+
+    python -m operator_tpu.obs.view dump.jsonl            # summary list
+    python -m operator_tpu.obs.view dump.jsonl <trace-id> # one full tree
+    python -m operator_tpu.obs.view dump.jsonl --all      # every tree
+    python -m operator_tpu.obs.view dump.jsonl --blackbox # black-box only
+
+Reads the journal written by :class:`..record.FlightRecorder` (or a
+black-box dump) and renders each trace's span tree with offsets/widths
+scaled to the root span — the laptop-side twin of ``GET /traces/{id}``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+from .record import FlightRecorder, TraceRecord, render_tree
+
+
+def _print_record(record: TraceRecord, *, full: bool) -> None:
+    if record.blackbox:
+        print(f"*** BLACK BOX: {record.reason} ***")
+        if record.extra:
+            print(f"    context: {json.dumps(record.extra, sort_keys=True)}")
+    if full:
+        print(render_tree(record.trace))
+    else:
+        summary = record.summary()
+        print(
+            f"{summary['traceId']}  {summary.get('name', '?'):<20}"
+            f" {float(summary.get('durationMs') or 0.0):>9.1f}ms"
+            f"  spans={summary['spans']}  status={summary.get('status', '?')}"
+            + ("  [blackbox]" if record.blackbox else "")
+        )
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="operator_tpu.obs.view",
+        description="render a flight-recorder JSONL dump as span trees",
+    )
+    parser.add_argument("path", help="trace journal / black-box JSONL")
+    parser.add_argument("trace_id", nargs="?",
+                        help="render only this trace (full tree)")
+    parser.add_argument("--all", action="store_true",
+                        help="render every trace as a full tree")
+    parser.add_argument("--blackbox", action="store_true",
+                        help="only black-box records")
+    args = parser.parse_args(argv)
+    try:
+        records = FlightRecorder.load(args.path)
+    except FileNotFoundError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    if args.blackbox:
+        records = [r for r in records if r.blackbox]
+    if args.trace_id:
+        records = [r for r in records if r.trace_id.startswith(args.trace_id)]
+        if not records:
+            print(f"error: no trace matching {args.trace_id!r} in {args.path}",
+                  file=sys.stderr)
+            return 1
+    if not records:
+        print(f"no traces in {args.path}")
+        return 0
+    full = bool(args.trace_id or args.all)
+    try:
+        for record in records:
+            _print_record(record, full=full)
+            if full:
+                print()
+    except BrokenPipeError:  # `... | head` closed the pipe mid-listing
+        sys.stderr.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -18,6 +18,7 @@ import asyncio
 import logging
 from typing import Optional
 
+from ..obs import build_tracer
 from ..patterns.engine import PatternEngine
 from ..utils.config import OperatorConfig
 from ..utils.timing import METRICS, MetricsRegistry
@@ -55,6 +56,10 @@ class Operator:
         self.config = config or OperatorConfig()
         self.metrics = metrics or METRICS
         self.providers = providers or default_registry()
+        # per-analysis tracing + flight recorder (docs/OBSERVABILITY.md):
+        # one recorder behind the pipeline, both HTTP servers' inbound
+        # traceparent handling, and GET /traces on the health port
+        self.tracer, self.recorder = build_tracer(self.config, self.metrics)
         self._register_tpu_provider()
         self.engine = PatternEngine(
             cache_dir=self.config.pattern_cache_directory,
@@ -80,8 +85,11 @@ class Operator:
             providers=self.providers,
             metrics=self.metrics,
             memory=self.memory,
+            tracer=self.tracer,
         )
-        self.cr_cache = PodmortemCache(api)
+        self.cr_cache = PodmortemCache(
+            api, list_timeout_s=self.config.kube_call_timeout_s
+        )
         self.watcher = PodFailureWatcher(
             api, self.pipeline, config=self.config, metrics=self.metrics, cache=self.cr_cache
         )
@@ -110,6 +118,8 @@ class Operator:
                 self.readiness,
                 metrics=self.metrics,
                 memory=self.memory,
+                recorder=self.recorder,
+                tracer=self.tracer,
                 incidents_token=self.config.incidents_api_token or None,
                 host=self.config.health_host,
                 port=self.config.health_port,
@@ -192,6 +202,9 @@ class Operator:
                 # the reference's ai-interface contract, served verbatim
                 # (POST /api/v1/analysis/analyze)
                 analysis_backend=tpu_provider,
+                # inbound traceparent joins the caller's trace; the spans
+                # land in the same flight recorder /traces serves
+                tracer=self.tracer,
             )
             await server.start()
             # warmup: one throwaway generation compiles the prefill + decode
@@ -210,6 +223,7 @@ class Operator:
             warm_prompt = build_warmup_prompt()
             warm_tokens = 2 * max(1, self.config.decode_block)
             try:
+                # graftlint: disable=GL003 reason=warmup generation is deliberately unbounded: first-compile time varies by orders of magnitude across models/backends, and readiness stays cold (visible to probes) until it completes
                 await engine.generate(
                     warm_prompt, SamplingParams(max_tokens=warm_tokens)
                 )
@@ -224,6 +238,7 @@ class Operator:
                     "prefill compile"
                 )
                 try:
+                    # graftlint: disable=GL003 reason=same unbounded-warmup exception as the full-size probe above
                     await engine.generate("warmup", SamplingParams(max_tokens=1))
                 except OversizedRequest:
                     log.warning("minimal warmup also exceeds the KV cache; "
@@ -239,7 +254,10 @@ class Operator:
                 from ..serving.prompts import template_preamble
 
                 try:
-                    providers_raw = await self.api.list("AIProvider")
+                    providers_raw = await asyncio.wait_for(
+                        self.api.list("AIProvider"),
+                        timeout=self.config.kube_call_timeout_s,
+                    )
                 except Exception:  # noqa: BLE001 - an optimisation must never block startup
                     providers_raw = []
                     log.warning("AIProvider template prefix scan failed",
@@ -425,15 +443,29 @@ async def run_demo(logfile: Optional[str] = None, provider_id: str = "template")
     )
     api.set_pod_log("prod", "payment-7f9c", crash_log, previous=True)
     await api.create_obj(pod)
-    # the watcher reacts to MODIFIED (reference :107); poke the pod
-    await api.patch("Pod", "payment-7f9c", "prod", {"metadata": {"labels": {"poked": "1"}}})
+    # the watcher reacts to MODIFIED (reference :107); poke the pod.
+    # Demo calls hit the in-memory fake, but they wear the same per-call
+    # budget the production control plane does (graftlint GL003)
+    await asyncio.wait_for(
+        api.patch("Pod", "payment-7f9c", "prod",
+                  {"metadata": {"labels": {"poked": "1"}}}),
+        timeout=config.kube_call_timeout_s,
+    )
 
     await asyncio.sleep(0.1)
     await operator.watcher.drain()
 
-    events = await api.list("Event")
-    stored_pod = await api.get("Pod", "payment-7f9c", "prod")
-    podmortem = await api.get("Podmortem", "watch-payment", "podmortem-system")
+    events = await asyncio.wait_for(
+        api.list("Event"), timeout=config.kube_call_timeout_s
+    )
+    stored_pod = await asyncio.wait_for(
+        api.get("Pod", "payment-7f9c", "prod"),
+        timeout=config.kube_call_timeout_s,
+    )
+    podmortem = await asyncio.wait_for(
+        api.get("Podmortem", "watch-payment", "podmortem-system"),
+        timeout=config.kube_call_timeout_s,
+    )
     readiness = await operator.readiness.check()
     await operator.stop()
 

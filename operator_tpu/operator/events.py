@@ -15,6 +15,7 @@ Parity with the reference's EventService (SURVEY.md §5 observability):
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import re
 import uuid
@@ -115,8 +116,9 @@ class EventService:
         for target in targets:
             try:
                 await self._emit(reason, type_, message, target)
-            except ApiError as exc:
-                log.warning("failed to emit %s to %s: %s", reason, target.qualified_name(), exc)
+            except (ApiError, asyncio.TimeoutError) as exc:
+                log.warning("failed to emit %s to %s: %s", reason,
+                            target.qualified_name(), str(exc) or "timed out")
 
     async def _emit(self, reason: str, type_: str, message: str, target: K8sObject) -> None:
         event = Event()
@@ -136,7 +138,13 @@ class EventService:
             namespace=target.metadata.namespace,
             uid=target.metadata.uid,
         )
-        await self.api.create("Event", event.to_dict())
+        # bounded by the control-loop kube budget (graftlint GL003):
+        # events are a best-effort surface — a wedged apiserver costs one
+        # bounded attempt, never the analysis pipeline behind it
+        await asyncio.wait_for(
+            self.api.create("Event", event.to_dict()),
+            timeout=self.config.kube_call_timeout_s,
+        )
 
     @staticmethod
     def _event_name(target_name: str) -> str:
@@ -154,10 +162,13 @@ class EventService:
         if rs_ref is None or not pod.metadata.namespace:
             return None
         try:
-            rs_dict = await self.api.get("ReplicaSet", rs_ref.name, pod.metadata.namespace)
+            rs_dict = await asyncio.wait_for(
+                self.api.get("ReplicaSet", rs_ref.name, pod.metadata.namespace),
+                timeout=self.config.kube_call_timeout_s,
+            )
         except NotFoundError:
             return None
-        except ApiError as exc:
+        except (ApiError, asyncio.TimeoutError) as exc:
             log.debug("owner chase failed at ReplicaSet: %s", exc)
             return None
         from ..schema.kube import ReplicaSet
@@ -169,10 +180,13 @@ class EventService:
         if deploy_ref is None:
             return None
         try:
-            deploy_dict = await self.api.get("Deployment", deploy_ref.name, pod.metadata.namespace)
+            deploy_dict = await asyncio.wait_for(
+                self.api.get("Deployment", deploy_ref.name, pod.metadata.namespace),
+                timeout=self.config.kube_call_timeout_s,
+            )
         except NotFoundError:
             return None
-        except ApiError as exc:
+        except (ApiError, asyncio.TimeoutError) as exc:
             log.debug("owner chase failed at Deployment: %s", exc)
             return None
         return Deployment.parse(deploy_dict)

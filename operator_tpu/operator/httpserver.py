@@ -16,6 +16,14 @@ asyncio HTTP server exposes:
 - ``GET /incidents/query`` — free-text similarity query over the incident
   index (``?q=...&k=N``): which remembered failures does this log line
   look like?
+- ``GET /traces``        — the flight recorder's recent analysis traces
+  (``?limit=N&blackbox=1``; docs/OBSERVABILITY.md)
+- ``GET /traces/{id}``   — one trace: full span JSON plus the rendered
+  flame-style text tree (the ``obs.view`` CLI's online twin)
+
+Inbound W3C ``traceparent`` headers are honoured: the request handler
+runs under a trace joining the caller's trace id, recorded into the same
+flight recorder — a client can follow its own request into the operator.
 
 Probe responses are JSON; failures return 503 so the kubelet treats the
 pod exactly as it treats the reference's native binary.
@@ -29,6 +37,7 @@ import logging
 import urllib.parse
 from typing import TYPE_CHECKING, Optional
 
+from ..obs import FlightRecorder, Tracer, parse_traceparent, render_tree
 from ..utils.timing import METRICS, MetricsRegistry
 from .health import LivenessCheck, ReadinessCheck
 
@@ -54,6 +63,8 @@ class HealthServer:
         *,
         metrics: Optional[MetricsRegistry] = None,
         memory: "Optional[IncidentMemory]" = None,
+        recorder: Optional[FlightRecorder] = None,
+        tracer: Optional[Tracer] = None,
         incidents_token: Optional[str] = None,
         host: str = "0.0.0.0",
         port: int = 8080,
@@ -62,9 +73,15 @@ class HealthServer:
         self.readiness = readiness
         self.metrics = metrics or METRICS
         self.memory = memory
-        #: bearer token gating /incidents* (None/"" = open); probes and
-        #: /metrics stay unauthenticated — incident records quote log
-        #: evidence, which is more sensitive than latency numbers
+        #: flight recorder behind GET /traces* (None = endpoints 404)
+        self.recorder = recorder
+        #: tracer for inbound-traceparent request traces (None = headers
+        #: accepted but ignored)
+        self.tracer = tracer
+        #: bearer token gating /incidents* AND /traces* (None/"" = open);
+        #: probes and /metrics stay unauthenticated — incident records and
+        #: trace attributes quote pod identities and evidence, which is
+        #: more sensitive than latency numbers
         self.incidents_token = incidents_token or None
         self.host = host
         self.port = port
@@ -108,9 +125,13 @@ class HealthServer:
             method, target = parts[0], parts[1]
             path, _, raw_query = target.partition("?")
             query = urllib.parse.parse_qs(raw_query)
-            # drain the (bounded) header block; only Authorization is
-            # consumed — the /incidents* routes may require a token
+            # drain the (bounded) header block; Authorization (the
+            # /incidents* and /traces* token), traceparent (inbound W3C
+            # trace context) and Accept (OpenMetrics negotiation for
+            # /metrics) are the only headers consumed
             authorization = ""
+            traceparent = ""
+            accept = ""
             for _ in range(100):
                 try:
                     header = await reader.readline()
@@ -120,12 +141,42 @@ class HealthServer:
                     break
                 if header.lower().startswith(b"authorization:"):
                     authorization = header.split(b":", 1)[1].strip().decode("latin-1")
-            status, body = await self._route(
-                method, path, query, authorization=authorization
-            )
+                elif header.lower().startswith(b"traceparent:"):
+                    traceparent = header.split(b":", 1)[1].strip().decode("latin-1")
+                elif header.lower().startswith(b"accept:"):
+                    accept = header.split(b":", 1)[1].strip().decode("latin-1")
+            remote = parse_traceparent(traceparent)
+            if remote is not None and not self._authorized(authorization):
+                # recording inbound request traces consumes ring slots; on
+                # a token-gated deployment only token-holders may do that
+                # (an unauthenticated client could otherwise churn every
+                # forensic trace out of the bounded ring)
+                remote = None
+            # join the caller's distributed trace when one was offered: the
+            # handler's work is recorded under THEIR trace id, findable via
+            # GET /traces/{their-id} afterwards
+            if remote is not None and self.tracer is not None:
+                trace_ctx = self.tracer.trace(
+                    f"http {path}", trace_id=remote[0], parent_id=remote[1],
+                    attributes={"path": path},
+                )
+            else:
+                import contextlib
+
+                trace_ctx = contextlib.nullcontext()
+            with trace_ctx:
+                status, body = await self._route(
+                    method, path, query, authorization=authorization,
+                    accept=accept,
+                )
+            openmetrics = "application/openmetrics-text" in accept
             if isinstance(body, bytes):  # pre-rendered (Prometheus text)
                 payload = body
-                content_type = b"text/plain; version=0.0.4; charset=utf-8"
+                content_type = (
+                    b"application/openmetrics-text; version=1.0.0; charset=utf-8"
+                    if openmetrics
+                    else b"text/plain; version=0.0.4; charset=utf-8"
+                )
             else:
                 payload = json.dumps(body).encode()
                 content_type = b"application/json"
@@ -148,6 +199,17 @@ class HealthServer:
             except (ConnectionError, OSError):
                 pass
 
+    def _authorized(self, authorization: str) -> bool:
+        """Bearer-token check shared by the /incidents|/traces route gate
+        and the inbound-traceparent gate; no token configured = open."""
+        if not self.incidents_token:
+            return True
+        import hmac
+
+        return hmac.compare_digest(
+            authorization.encode(), f"Bearer {self.incidents_token}".encode()
+        )
+
     async def _route(
         self,
         method: str,
@@ -155,17 +217,15 @@ class HealthServer:
         query: "Optional[dict[str, list[str]]]" = None,
         *,
         authorization: str = "",
+        accept: str = "",
     ) -> "tuple[int, dict | bytes]":
         query = query or {}
         if method not in ("GET", "HEAD"):
             return 405, {"error": "method not allowed"}
-        if path.startswith("/incidents") and self.incidents_token:
-            import hmac
-
-            if not hmac.compare_digest(
-                authorization.encode(), f"Bearer {self.incidents_token}".encode()
-            ):
-                return 401, {"error": "missing or invalid bearer token"}
+        if (
+            path.startswith("/incidents") or path.startswith("/traces")
+        ) and not self._authorized(authorization):
+            return 401, {"error": "missing or invalid bearer token"}
         if path in ("/healthz/live", "/livez"):
             status = await self.liveness.check()
             return (200 if status.ready else 503), {
@@ -179,7 +239,11 @@ class HealthServer:
                 "reason": status.reason,
             }
         if path == "/metrics":
-            return 200, self.metrics.prometheus().encode()
+            # OpenMetrics only on negotiation: exemplars (trace ids on the
+            # podmortem_trace_* counters) are illegal in classic text 0.0.4
+            # — a mid-line '#' would fail the WHOLE legacy scrape
+            openmetrics = "application/openmetrics-text" in accept
+            return 200, self.metrics.prometheus(openmetrics=openmetrics).encode()
         if path == "/metrics.json":
             return 200, self.metrics.snapshot()
         if path == "/incidents":
@@ -215,4 +279,30 @@ class HealthServer:
                 if data is not None:
                     payload.append({"score": round(score, 4), **data})
             return 200, {"matches": payload}
+        if path == "/traces":
+            if self.recorder is None:
+                return 404, {"error": "flight recorder disabled"}
+            try:
+                limit = int(query.get("limit", ["50"])[0])
+            except ValueError:
+                return 400, {"error": "limit must be an integer"}
+            blackbox_only = query.get("blackbox", ["0"])[0] in ("1", "true")
+            records = self.recorder.traces(limit, blackbox_only=blackbox_only)
+            return 200, {
+                "count": len(self.recorder),
+                "traces": [r.summary() for r in records],
+            }
+        if path.startswith("/traces/"):
+            if self.recorder is None:
+                return 404, {"error": "flight recorder disabled"}
+            trace_id = path[len("/traces/"):]
+            record = self.recorder.get(trace_id)
+            if record is None:
+                return 404, {"error": f"no trace {trace_id} in the ring "
+                                      "(it may have been evicted)"}
+            payload = record.to_dict()
+            # the flame-style text tree (the obs.view CLI's rendering),
+            # so a curl is readable without tooling
+            payload["rendered"] = render_tree(record.trace)
+            return 200, payload
         return 404, {"error": f"no route {path}"}

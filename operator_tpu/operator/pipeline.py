@@ -24,6 +24,7 @@ from ..memory import (
     RecallDecision,
     build_incident_memory,
 )
+from ..obs import Span, Tracer, annotate_root
 from ..patterns.engine import PatternEngine
 from ..schema.analysis import (
     AIResponse,
@@ -105,6 +106,7 @@ class AnalysisPipeline:
         metrics: Optional[MetricsRegistry] = None,
         clock: Optional[Callable[[], float]] = None,
         memory: Optional[IncidentMemory] = None,
+        tracer: Optional[Tracer] = None,
     ) -> None:
         self.api = api
         self.engine = engine
@@ -119,6 +121,16 @@ class AnalysisPipeline:
         # recurring class pays the TPU decode once, not once per pod.
         # Injectable; the default honours config.memory_enabled.
         self.memory = memory if memory is not None else build_incident_memory(self.config)
+        # per-analysis tracing + flight recorder (operator_tpu/obs/,
+        # docs/OBSERVABILITY.md): every analysis produces a span tree;
+        # deadline-exceeded / breaker-open / engine-error analyses dump a
+        # black box.  Injectable; the default is the process-wide tracer.
+        if tracer is not None:
+            self.tracer = tracer
+        else:
+            from ..obs import TRACER
+
+            self.tracer = TRACER
         # deadline budgets + per-provider circuit breakers share one
         # injectable clock so chaos tests replay deterministically
         self._clock = clock or time.monotonic
@@ -200,29 +212,98 @@ class AnalysisPipeline:
         """The hot path (reference call stack §3.2).  Returns the analysis
         result, or None when collection failed outright.  Every stage spends
         the one ``deadline`` envelope (born at claim; a fresh default is
-        created for direct callers)."""
-        started = time.perf_counter()
+        created for direct callers).
+
+        The whole run is one trace (operator_tpu/obs/): a span per stage,
+        the trace id stamped into ``status.recentFailures[]``, and — when
+        the analysis ends ``deadline-exceeded``, a breaker opens, or the
+        engine reports a device error — a black-box dump of the full span
+        tree plus the deadline ledger and any active fault-plan seed."""
         if deadline is None:
             deadline = self._deadline_for(podmortem)
+        root: Optional[Span] = None
+        try:
+            with self.tracer.trace(
+                "analysis",
+                attributes={
+                    "pod": pod.qualified_name(),
+                    "podmortem": podmortem.qualified_name(),
+                    "failure_time": failure_time or "",
+                    "deadline_total_s": round(deadline.total_s, 3),
+                },
+            ) as root:
+                result = await self._analyze(
+                    pod, podmortem, failure_time=failure_time, deadline=deadline,
+                    trace_root=root,
+                )
+            return result
+        finally:
+            # in a FINALLY so a flagged trace dumps even when the analysis
+            # raises or is cancelled mid-flight (operator shutdown after a
+            # breaker opened) — hard failures are exactly when the
+            # forensic record matters.  The trace is fully assembled
+            # (recorded by the tracer on context exit) before this reads it.
+            if root is not None:
+                reason = root.attributes.get("blackbox")
+                if reason:
+                    self._dump_black_box(root, reason, deadline)
+
+    def _dump_black_box(self, root: Span, reason: str, deadline: Deadline) -> None:
+        """Dump the completed trace with its failure context: the deadline
+        ledger and, when a chaos fault plan is active on the api seam, its
+        seed + fired-fault fingerprint so the dump names the exact replay."""
+        recorder = getattr(self.tracer, "recorder", None)
+        if recorder is None:
+            return
+        extra: dict = {
+            "deadline": {
+                "total_s": round(deadline.total_s, 3),
+                "elapsed_s": round(deadline.elapsed(), 3),
+                "remaining_s": round(deadline.remaining(), 3),
+            },
+        }
+        plan = getattr(self.api, "fault_plan", None)
+        if plan is not None:
+            extra["fault_plan"] = {
+                "seed": plan.seed,
+                "fired": len(plan.trace()),
+                "fingerprint": plan.fingerprint(),
+            }
+        recorder.black_box(root.trace_id, reason, extra)
+
+    async def _analyze(
+        self,
+        pod: Pod,
+        podmortem: Podmortem,
+        *,
+        failure_time: Optional[str],
+        deadline: Deadline,
+        trace_root: Span,
+    ) -> Optional[AnalysisResult]:
+        started = time.perf_counter()
         self.metrics.incr("failures_detected")
-        await self.events.emit_failure_detected(pod, podmortem)
+        with self.tracer.span("emit.detected"):
+            await self.events.emit_failure_detected(pod, podmortem)
 
         # -- collect (gets a SLICE of the budget) --------------------------
         collect_s = deadline.slice(
             self.config.collect_budget_fraction, floor_s=1.0
         )
         try:
-            with self.metrics.timed("collect"):
-                failure = await asyncio.wait_for(
-                    self.collect_failure_data(
-                        pod,
-                        deadline=Deadline.start(collect_s, clock=self._clock),
-                    ),
-                    timeout=collect_s,
-                )
+            with self.tracer.span("collect", budget_s=round(collect_s, 3)):
+                with self.metrics.timed("collect"):
+                    failure = await asyncio.wait_for(
+                        self.collect_failure_data(
+                            pod,
+                            deadline=Deadline.start(collect_s, clock=self._clock),
+                        ),
+                        timeout=collect_s,
+                    )
         except asyncio.TimeoutError:
             log.error("log collection for %s exceeded its %.1fs budget slice",
                       pod.qualified_name(), collect_s)
+            if deadline.expired:  # the ENVELOPE died during collection
+                annotate_root("blackbox", "deadline-exceeded", overwrite=False)
             await self.events.emit_analysis_error(
                 pod, podmortem,
                 f"log collection exceeded its {collect_s:.1f}s budget slice",
@@ -238,11 +319,12 @@ class AnalysisPipeline:
         # -- parse (CPU/TPU pattern match; capped by the remainder) --------
         parse_s = min(self.config.parse_timeout_s, max(0.1, deadline.remaining()))
         try:
-            with self.metrics.timed("parse"):
-                result = await asyncio.wait_for(
-                    asyncio.to_thread(self.engine.analyze, failure),
-                    timeout=parse_s,
-                )
+            with self.tracer.span("parse", budget_s=round(parse_s, 3)):
+                with self.metrics.timed("parse"):
+                    result = await asyncio.wait_for(
+                        asyncio.to_thread(self.engine.analyze, failure),
+                        timeout=parse_s,
+                    )
         except asyncio.TimeoutError:
             # attribute the timeout honestly: a deadline-bound cap means
             # the BUDGET killed the parse, not the pattern engine
@@ -254,6 +336,8 @@ class AnalysisPipeline:
                 else f"pattern analysis timed out after {parse_s:.0f}s"
             )
             log.error("%s (%s)", message, pod.qualified_name())
+            if budget_bound:
+                annotate_root("blackbox", "deadline-exceeded", overwrite=False)
             await self.events.emit_analysis_error(pod, podmortem, message)
             self.metrics.incr("deadline_exceeded" if budget_bound else "parse_errors")
             return None
@@ -281,129 +365,158 @@ class AnalysisPipeline:
         provider_ref_key: Optional[str] = None
         provider: Optional[AIProvider] = None
         caching_ok = False
-        if ai_configured:
-            provider, provider_ref_key = await self._resolve_provider_identity(
-                podmortem, deadline=deadline
-            )
-            caching_ok = provider is not None and provider.spec.caching_enabled
         recall: Optional[RecallDecision] = None
         recurrence: Optional[FailureRecurrence] = None
         ai_response: Optional[AIResponse] = None
         reused = False
-        if self.memory is not None:
-            with self.metrics.timed("recall"):
-                # embedding may be a neural encoder; keep the loop free
-                recall = await asyncio.to_thread(
-                    self.memory.recall, result, pod,
-                    allow_reuse=ai_configured and caching_ok,
-                    provider_ref=provider_ref_key,
-                )
-            if recall.kind == RECALL_HIT:
-                incident = recall.incident
-                reused = True
-                self.metrics.incr("recall_hit")
-                # the hit RETURNS the unused deadline budget: everything
-                # the AI leg would have spent is handed back (recorded so
-                # the decode-seconds saved are visible on /metrics)
-                self.metrics.record(
-                    "recall_budget_returned", deadline.remaining() * 1e3
-                )
-                ai_response = AIResponse(
-                    explanation=recall.analysis.explanation,
-                    provider_id=recall.analysis.provider_id,
-                    model_id=recall.analysis.model_id,
-                    cached=True,
-                )
-                recurrence = FailureRecurrence(
-                    fingerprint=incident.fingerprint,
-                    seen_count=incident.seen_count,
-                    first_seen=incident.first_seen,
-                    reused_analysis=True,
-                )
-            elif recall.kind == RECALL_NEAR:
-                self.metrics.incr("recall_near")
-            else:
-                self.metrics.incr("recall_miss")
-
-        # -- explain (the AI leg gets whatever budget is left) -------------
-        if reused:
-            pass  # cached analysis; no generation
-        elif ai_configured:
-            if deadline.expired:
-                # the budget died before the AI leg even started: degrade
-                # to pattern-only NOW instead of dispatching a doomed call
-                message = (
-                    f"analysis deadline ({deadline.total_s:.0f}s) exhausted "
-                    "before AI generation; storing pattern-only result"
-                )
-                log.warning("%s (%s)", message, pod.qualified_name())
-                await self.events.emit_analysis_error(pod, podmortem, message)
-                ai_response = AIResponse(
-                    error=message, deadline_outcome="deadline-exceeded"
-                )
-            else:
-                priors = [
-                    PriorIncident(
-                        fingerprint=inc.fingerprint,
-                        score=round(score, 4),
-                        seen_count=inc.seen_count,
-                        severity=inc.severity,
-                        last_seen=inc.last_seen,
-                        explanation=inc.explanation,
+        with self.tracer.span("recall") as recall_span:
+            if ai_configured:
+                # its own child span: the identity fetch is an apiserver
+                # GET, and its latency must never read as incident-memory
+                # time in the trace
+                with self.tracer.span("provider.identity"):
+                    provider, provider_ref_key = await self._resolve_provider_identity(
+                        podmortem, deadline=deadline
                     )
-                    for inc, score in (recall.neighbors if recall else [])
-                ]
-                ai_response = await self._generate_explanation(
-                    pod, podmortem, result, failure, deadline=deadline,
-                    prior_incidents=priors, provider=provider,
-                )
-            self._record_deadline_outcome(ai_response)
-        elif podmortem.spec.ai_analysis_enabled:
-            log.info("podmortem %s has no aiProviderRef; storing pattern-only result",
-                     podmortem.qualified_name())
-
-        # -- remember (a hit already bumped its recurrence counters) -------
-        if self.memory is not None and recall is not None:
-            if not reused:
-                incident = await asyncio.to_thread(
-                    self.memory.insert, recall.fingerprint, result, pod, ai_response,
-                    related=[inc.fingerprint for inc, _ in recall.neighbors],
-                    # recall() already counted this sighting iff it found
-                    # the digest; otherwise a racing concurrent first
-                    # sighting is counted by the upsert itself
-                    seen_recorded=recall.incident is not None,
-                    # cachingEnabled=false also means "don't remember my
-                    # generations": recurrence is tracked, text is not
-                    provider_ref=provider_ref_key if caching_ok else None,
-                    cacheable=caching_ok,
-                )
-                if incident is not None:  # weak fingerprints are never stored
+                caching_ok = provider is not None and provider.spec.caching_enabled
+            if self.memory is not None:
+                with self.metrics.timed("recall"):
+                    # embedding may be a neural encoder; keep the loop free
+                    recall = await asyncio.to_thread(
+                        self.memory.recall, result, pod,
+                        allow_reuse=ai_configured and caching_ok,
+                        provider_ref=provider_ref_key,
+                        trace_id=trace_root.trace_id,
+                    )
+                recall_span.set(kind=recall.kind)
+                if recall.prior_trace_id:
+                    # a recurrence links back to its prior analysis's trace
+                    recall_span.set(prior_trace_id=recall.prior_trace_id)
+                if recall.kind == RECALL_HIT:
+                    incident = recall.incident
+                    reused = True
+                    self.metrics.incr("recall_hit")
+                    # the hit RETURNS the unused deadline budget: everything
+                    # the AI leg would have spent is handed back (recorded so
+                    # the decode-seconds saved are visible on /metrics)
+                    self.metrics.record(
+                        "recall_budget_returned", deadline.remaining() * 1e3
+                    )
+                    ai_response = AIResponse(
+                        explanation=recall.analysis.explanation,
+                        provider_id=recall.analysis.provider_id,
+                        model_id=recall.analysis.model_id,
+                        cached=True,
+                    )
                     recurrence = FailureRecurrence(
                         fingerprint=incident.fingerprint,
                         seen_count=incident.seen_count,
                         first_seen=incident.first_seen,
-                        reused_analysis=False,
+                        reused_analysis=True,
                     )
-            # snapshot into the OPERATOR's namespace (where restore reads
-            # it, app.py) — never the CR's, or multi-namespace fleets
-            # scatter partial snapshots that restore can't find.  Hits
-            # flush too: recurrence counters must survive a restart.
-            await self.memory.maybe_flush_to_configmap(
-                self.api, getattr(self.api, "namespace", None) or "default"
-            )
+                elif recall.kind == RECALL_NEAR:
+                    self.metrics.incr("recall_near")
+                else:
+                    self.metrics.incr("recall_miss")
+
+        # -- explain (the AI leg gets whatever budget is left) -------------
+        with self.tracer.span(
+            "explain", reused=reused, configured=ai_configured
+        ) as explain_span:
+            if reused:
+                pass  # cached analysis; no generation
+            elif ai_configured:
+                if deadline.expired:
+                    # the budget died before the AI leg even started: degrade
+                    # to pattern-only NOW instead of dispatching a doomed call
+                    message = (
+                        f"analysis deadline ({deadline.total_s:.0f}s) exhausted "
+                        "before AI generation; storing pattern-only result"
+                    )
+                    log.warning("%s (%s)", message, pod.qualified_name())
+                    await self.events.emit_analysis_error(pod, podmortem, message)
+                    ai_response = AIResponse(
+                        error=message, deadline_outcome="deadline-exceeded"
+                    )
+                else:
+                    priors = [
+                        PriorIncident(
+                            fingerprint=inc.fingerprint,
+                            score=round(score, 4),
+                            seen_count=inc.seen_count,
+                            severity=inc.severity,
+                            last_seen=inc.last_seen,
+                            explanation=inc.explanation,
+                        )
+                        for inc, score in (recall.neighbors if recall else [])
+                    ]
+                    ai_response = await self._generate_explanation(
+                        pod, podmortem, result, failure, deadline=deadline,
+                        prior_incidents=priors, provider=provider,
+                    )
+                self._record_deadline_outcome(ai_response)
+                if ai_response is not None:
+                    if ai_response.deadline_outcome:
+                        explain_span.set(outcome=ai_response.deadline_outcome)
+                    if ai_response.deadline_outcome == "deadline-exceeded":
+                        # the terminal deadline outcome — the black-box trigger
+                        annotate_root(
+                            "blackbox", "deadline-exceeded", overwrite=False
+                        )
+                    if ai_response.error:
+                        explain_span.status = "error"
+                        explain_span.error = ai_response.error[:300]
+            elif podmortem.spec.ai_analysis_enabled:
+                log.info("podmortem %s has no aiProviderRef; storing pattern-only result",
+                         podmortem.qualified_name())
+
+        # -- remember (a hit already bumped its recurrence counters) -------
+        if self.memory is not None and recall is not None:
+            with self.tracer.span("remember"):
+                if not reused:
+                    incident = await asyncio.to_thread(
+                        self.memory.insert, recall.fingerprint, result, pod, ai_response,
+                        related=[inc.fingerprint for inc, _ in recall.neighbors],
+                        # recall() already counted this sighting iff it found
+                        # the digest; otherwise a racing concurrent first
+                        # sighting is counted by the upsert itself
+                        seen_recorded=recall.incident is not None,
+                        # cachingEnabled=false also means "don't remember my
+                        # generations": recurrence is tracked, text is not
+                        provider_ref=provider_ref_key if caching_ok else None,
+                        cacheable=caching_ok,
+                        trace_id=trace_root.trace_id,
+                    )
+                    if incident is not None:  # weak fingerprints are never stored
+                        recurrence = FailureRecurrence(
+                            fingerprint=incident.fingerprint,
+                            seen_count=incident.seen_count,
+                            first_seen=incident.first_seen,
+                            reused_analysis=False,
+                        )
+                # snapshot into the OPERATOR's namespace (where restore reads
+                # it, app.py) — never the CR's, or multi-namespace fleets
+                # scatter partial snapshots that restore can't find.  Hits
+                # flush too: recurrence counters must survive a restart.
+                await self.memory.maybe_flush_to_configmap(
+                    self.api, getattr(self.api, "namespace", None) or "default"
+                )
 
         # -- store + emit --------------------------------------------------
-        with self.metrics.timed("store"):
-            await self.storage.store_analysis_results(
-                result, ai_response, pod, podmortem,
-                failure_time=failure_time, recurrence=recurrence,
-            )
+        with self.tracer.span("store"):
+            with self.metrics.timed("store"):
+                await self.storage.store_analysis_results(
+                    result, ai_response, pod, podmortem,
+                    failure_time=failure_time, recurrence=recurrence,
+                    trace_id=trace_root.trace_id,
+                )
         explanation = (
             ai_response.explanation
             if ai_response is not None and ai_response.explanation
             else result.pattern_summary_line()
         )
-        await self.events.emit_analysis_complete(pod, podmortem, result, explanation)
+        with self.tracer.span("emit.complete"):
+            await self.events.emit_analysis_complete(pod, podmortem, result, explanation)
         total_ms = (time.perf_counter() - started) * 1e3
         self.metrics.record("pipeline_total", total_ms)
         self.metrics.incr("analyses_completed")
@@ -510,6 +623,15 @@ class AnalysisPipeline:
         return provider, f"{ref_key}@{digest}"
 
     # ------------------------------------------------------------------
+    def _note_breaker_trip(self, breaker_key: str) -> None:
+        """One place counts a breaker trip AND flags the ambient trace for
+        a black-box dump — an open breaker is exactly the moment the
+        per-request timeline matters (docs/OBSERVABILITY.md)."""
+        self.metrics.incr("circuit_opened")
+        annotate_root("blackbox", "breaker-open", overwrite=False)
+        annotate_root("breaker", breaker_key)
+
+    # ------------------------------------------------------------------
     def _record_deadline_outcome(self, ai_response: Optional[AIResponse]) -> None:
         """One place turns the AI leg's budget outcome into counters (the
         Prometheus surface: podmortem_deadline_*_total).  Backends that
@@ -540,31 +662,32 @@ class AnalysisPipeline:
     ) -> AIResponse:
         ref = podmortem.spec.ai_provider_ref
         namespace = ref.namespace or podmortem.metadata.namespace or "default"
-        if provider is None:  # not pre-fetched by the recall identity step
-            try:
-                provider_dict = await asyncio.wait_for(
-                    self.api.get("AIProvider", ref.name, namespace),
-                    timeout=(
-                        deadline.remaining() if deadline is not None else None
-                    ),
-                )
-            except NotFoundError:
-                message = f"AIProvider {namespace}/{ref.name} not found"
-                log.warning("%s (podmortem %s)", message, podmortem.qualified_name())
-                await self.events.emit_analysis_error(pod, podmortem, message)
-                self.metrics.incr("provider_missing")
-                return AIResponse(error=message)
-            except (ApiError, asyncio.TimeoutError) as exc:
-                message = (
-                    f"AIProvider fetch failed: "
-                    f"{str(exc) or 'deadline budget exhausted'}"
-                )
-                await self.events.emit_analysis_error(pod, podmortem, message)
-                return AIResponse(error=message)
-            provider = AIProvider.parse(provider_dict)
-        provider_config = await resolve_provider_config(
-            self.api, provider, deadline=deadline
-        )
+        with self.tracer.span("provider.resolve", ref=f"{namespace}/{ref.name}"):
+            if provider is None:  # not pre-fetched by the recall identity step
+                try:
+                    provider_dict = await asyncio.wait_for(
+                        self.api.get("AIProvider", ref.name, namespace),
+                        timeout=(
+                            deadline.remaining() if deadline is not None else None
+                        ),
+                    )
+                except NotFoundError:
+                    message = f"AIProvider {namespace}/{ref.name} not found"
+                    log.warning("%s (podmortem %s)", message, podmortem.qualified_name())
+                    await self.events.emit_analysis_error(pod, podmortem, message)
+                    self.metrics.incr("provider_missing")
+                    return AIResponse(error=message)
+                except (ApiError, asyncio.TimeoutError) as exc:
+                    message = (
+                        f"AIProvider fetch failed: "
+                        f"{str(exc) or 'deadline budget exhausted'}"
+                    )
+                    await self.events.emit_analysis_error(pod, podmortem, message)
+                    return AIResponse(error=message)
+                provider = AIProvider.parse(provider_dict)
+            provider_config = await resolve_provider_config(
+                self.api, provider, deadline=deadline
+            )
         remaining = deadline.remaining() if deadline is not None else None
         request = AnalysisRequest(
             analysis_result=result, provider_config=provider_config,
@@ -604,7 +727,7 @@ class AnalysisPipeline:
             await self.events.emit_analysis_error(pod, podmortem, str(exc))
             self.metrics.incr("provider_errors")
             if breaker.record_failure():
-                self.metrics.incr("circuit_opened")
+                self._note_breaker_trip(breaker_key)
             return AIResponse(error=str(exc))
 
         # the AI leg gets the REMAINDER of the envelope, never more than
@@ -613,10 +736,15 @@ class AnalysisPipeline:
         if remaining is not None:
             timeout_s = min(timeout_s, remaining)
         try:
-            with self.metrics.timed("ai_generate"):
-                response = await asyncio.wait_for(
-                    backend.generate(request), timeout=timeout_s
-                )
+            with self.tracer.span(
+                "ai_generate",
+                provider=provider_config.provider_id or "template",
+                budget_s=round(timeout_s, 3),
+            ):
+                with self.metrics.timed("ai_generate"):
+                    response = await asyncio.wait_for(
+                        backend.generate(request), timeout=timeout_s
+                    )
         except asyncio.TimeoutError:
             budget_bound = remaining is not None and remaining < self.config.ai_timeout_s
             message = (
@@ -631,7 +759,7 @@ class AnalysisPipeline:
             # health: counting them would trip the breaker on a healthy
             # backend whenever upstream stages run long
             if not budget_bound and breaker.record_failure():
-                self.metrics.incr("circuit_opened")
+                self._note_breaker_trip(breaker_key)
             return AIResponse(
                 error=message, provider_id=provider_config.provider_id,
                 deadline_outcome="deadline-exceeded" if budget_bound else None,
@@ -641,7 +769,7 @@ class AnalysisPipeline:
             await self.events.emit_analysis_error(pod, podmortem, f"AI generation failed: {exc}")
             self.metrics.incr("ai_errors")
             if breaker.record_failure():
-                self.metrics.incr("circuit_opened")
+                self._note_breaker_trip(breaker_key)
             return AIResponse(error=str(exc), provider_id=provider_config.provider_id)
 
         if response.error:
@@ -651,7 +779,7 @@ class AnalysisPipeline:
             # means the BUDGET killed the leg, not the provider
             if response.deadline_outcome != "deadline-exceeded" and \
                     breaker.record_failure():
-                self.metrics.incr("circuit_opened")
+                self._note_breaker_trip(breaker_key)
         else:
             breaker.record_success()
             if cache_key is not None:

@@ -380,6 +380,16 @@ class OpenAICompatProvider:
         headers = {"Content-Type": "application/json"}
         if config.auth_token:
             headers["Authorization"] = f"Bearer {config.auth_token}"
+        # W3C trace context: the analysis trace crosses into the external
+        # backend (and any proxy between) — its serving-side spans join
+        # OUR trace id (operator_tpu/obs/, docs/OBSERVABILITY.md).
+        # Captured here on the event loop; the blocking call runs in a
+        # worker thread where the ambient span is not visible.
+        from ..obs import current_traceparent
+
+        traceparent = current_traceparent()
+        if traceparent:
+            headers["traceparent"] = traceparent
 
         def call(timeout_s: float) -> AIResponse:
             req = urllib.request.Request(

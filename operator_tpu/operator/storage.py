@@ -90,9 +90,12 @@ class AnalysisStorageService:
         *,
         failure_time: Optional[str] = None,
         recurrence: Optional[FailureRecurrence] = None,
+        trace_id: Optional[str] = None,
     ) -> None:
         """Store to both places; failures in one must not block the other
-        (reference stores annotations first, then status :60-68)."""
+        (reference stores annotations first, then status :60-68).
+        ``trace_id`` links the status entry to its flight-recorder trace
+        (GET /traces/{id}, docs/OBSERVABILITY.md)."""
         explanation = self._explanation_text(result, ai_response)
         # the durable marker is only earned by a FINAL result: AI succeeded,
         # or AI was never requested (pattern-only is the intended outcome).
@@ -106,6 +109,7 @@ class AnalysisStorageService:
         await self.store_to_podmortem_status(
             podmortem, pod, result, ai_response, explanation,
             failure_time=failure_time, recurrence=recurrence,
+            trace_id=trace_id,
         )
 
     @staticmethod
@@ -135,14 +139,23 @@ class AnalysisStorageService:
             annotations[ANNOTATION_ANALYZED_FAILURE] = failure_time
 
         async def attempt() -> bool:
-            latest = await self.api.get("Pod", pod.metadata.name, pod.metadata.namespace)
+            # each apiserver call bounded by the control-loop budget
+            # (kube_call_timeout_s, graftlint GL003): a wedged connection
+            # costs one bounded attempt, not the pipeline forever
+            latest = await asyncio.wait_for(
+                self.api.get("Pod", pod.metadata.name, pod.metadata.namespace),
+                timeout=self.config.kube_call_timeout_s,
+            )
             rv = latest.get("metadata", {}).get("resourceVersion")
-            await self.api.patch(
-                "Pod",
-                pod.metadata.name,
-                pod.metadata.namespace,
-                {"metadata": {"annotations": annotations}},
-                resource_version=rv,
+            await asyncio.wait_for(
+                self.api.patch(
+                    "Pod",
+                    pod.metadata.name,
+                    pod.metadata.namespace,
+                    {"metadata": {"annotations": annotations}},
+                    resource_version=rv,
+                ),
+                timeout=self.config.kube_call_timeout_s,
             )
             return True
 
@@ -161,6 +174,7 @@ class AnalysisStorageService:
         *,
         failure_time: Optional[str] = None,
         recurrence: Optional[FailureRecurrence] = None,
+        trace_id: Optional[str] = None,
     ) -> bool:
         if ai_response is not None and ai_response.explanation:
             analysis_status = "Analyzed"
@@ -184,10 +198,16 @@ class AnalysisStorageService:
             severity=result.summary.highest_severity,
             deadline_outcome=deadline_outcome,
             recurrence=recurrence,
+            trace_id=trace_id,
         )
 
         async def attempt() -> bool:
-            latest = await self.api.get("Podmortem", podmortem.metadata.name, podmortem.metadata.namespace)
+            latest = await asyncio.wait_for(
+                self.api.get(
+                    "Podmortem", podmortem.metadata.name, podmortem.metadata.namespace
+                ),
+                timeout=self.config.kube_call_timeout_s,
+            )
             rv = latest.get("metadata", {}).get("resourceVersion")
             status = latest.get("status") or {}
             failures = [to_dict(entry)] + list(status.get("recentFailures") or [])
@@ -198,12 +218,15 @@ class AnalysisStorageService:
                     "lastUpdateTime": now_iso(),
                 }
             )
-            await self.api.patch_status(
-                "Podmortem",
-                podmortem.metadata.name,
-                podmortem.metadata.namespace,
-                status,
-                resource_version=rv,
+            await asyncio.wait_for(
+                self.api.patch_status(
+                    "Podmortem",
+                    podmortem.metadata.name,
+                    podmortem.metadata.namespace,
+                    status,
+                    resource_version=rv,
+                ),
+                timeout=self.config.kube_call_timeout_s,
             )
             return True
 
@@ -233,6 +256,12 @@ class AnalysisStorageService:
                 return False
             except NotFoundError:
                 log.info("target of %s is gone; skipping storage", what)
+                return False
+            except asyncio.TimeoutError:
+                # the per-call kube budget (kube_call_timeout_s) expired:
+                # storing is best-effort — give up on this attempt rather
+                # than let a wedged apiserver stall the pipeline
+                log.error("timed out storing %s (kube_call_timeout_s)", what)
                 return False
             except ApiError as exc:
                 log.error("failed storing %s: %s", what, exc)

@@ -83,9 +83,19 @@ def get_failure_time(pod: Pod) -> Optional[str]:
 class PodmortemCache:
     """Informer-style cache of Podmortem CRs, kept fresh by a watch."""
 
-    def __init__(self, api: KubeApi, *, resync_delay_s: float = 1.0) -> None:
+    def __init__(
+        self,
+        api: KubeApi,
+        *,
+        resync_delay_s: float = 1.0,
+        list_timeout_s: float = 15.0,
+    ) -> None:
         self.api = api
         self.resync_delay_s = resync_delay_s
+        #: budget for the prime LIST (mirrors OperatorConfig
+        #: .kube_call_timeout_s; graftlint GL003): a wedged apiserver
+        #: connection costs one bounded prime, retried by run()
+        self.list_timeout_s = list_timeout_s
         self._items: dict[tuple[str, str], Podmortem] = {}
         self._primed = False
         self._ready = asyncio.Event()
@@ -95,7 +105,9 @@ class PodmortemCache:
         self._cursor: Optional[str] = None
 
     async def prime(self) -> None:
-        items, cursor = await self.api.list_rv("Podmortem")
+        items, cursor = await asyncio.wait_for(
+            self.api.list_rv("Podmortem"), timeout=self.list_timeout_s
+        )
         fresh: dict[tuple[str, str], Podmortem] = {}
         for raw in items:
             try:
@@ -310,7 +322,13 @@ class PodFailureWatcher:
         cursor = self._cursors.get(namespace)
         if cursor is None:
             try:
-                items, cursor = await self.api.list_rv("Pod", namespace)
+                # the sweep LIST is bounded (kube_call_timeout_s, GL003);
+                # the watch STREAM below is deliberately not — liveness
+                # comes from server-side close + resume (kubeapi.py)
+                items, cursor = await asyncio.wait_for(
+                    self.api.list_rv("Pod", namespace),
+                    timeout=self.config.kube_call_timeout_s,
+                )
                 for raw in items:
                     try:
                         await self.handle_pod_event("MODIFIED", Pod.parse(raw))

@@ -75,6 +75,9 @@ _POD_FAILURE_STATUS = _obj(
                 "reusedAnalysis": _BOOL,
             }
         ),
+        # flight-recorder trace id (operator_tpu/obs/): GET /traces/{id}
+        # on the operator health port replays this analysis's span tree
+        "traceId": _STR,
     }
 )
 

@@ -81,6 +81,10 @@ class PodFailureStatus:
     deadline_outcome: Optional[str] = None
     #: incident-memory classification (None when memory is disabled)
     recurrence: Optional[FailureRecurrence] = None
+    #: flight-recorder trace id for this analysis (operator_tpu/obs/):
+    #: ``GET /traces/{id}`` on the health port replays the span tree —
+    #: where the deadline budget went, stage by stage
+    trace_id: Optional[str] = None
 
 
 @dataclass

@@ -524,13 +524,14 @@ class AdmissionMixin:
                 (prefix_pages or [])[: prefix_shared // self.page_size],
                 jnp.int32,
             )
-            outs = self._prefix_fns[pkey](
-                self.params, staged, prefix_table, jnp.asarray(ids),
-                jnp.asarray(lengths), jnp.asarray(row_tables), self._rng,
-                jnp.asarray(temp), jnp.asarray(top_p), self.lora,
-                jnp.asarray(adapter_idx) if self.lora is not None else None,
-                *((self._guided_tables, jnp.asarray(row_aut)) if guided else ()),
-            )
+            with self._annotation("podmortem.prefill", params_list):
+                outs = self._prefix_fns[pkey](
+                    self.params, staged, prefix_table, jnp.asarray(ids),
+                    jnp.asarray(lengths), jnp.asarray(row_tables), self._rng,
+                    jnp.asarray(temp), jnp.asarray(top_p), self.lora,
+                    jnp.asarray(adapter_idx) if self.lora is not None else None,
+                    *((self._guided_tables, jnp.asarray(row_aut)) if guided else ()),
+                )
             if guided:
                 self.paged_cache, first_tokens, self._rng, first_state = outs
             else:
@@ -556,25 +557,27 @@ class AdmissionMixin:
             staged, row_tables = self._stage_page_tables(
                 n, n_pad, slot_ids, page_grants, lengths
             )
-            outs = self._prefill_fns[key](
-                self.params, staged, jnp.asarray(ids), jnp.asarray(lengths),
-                jnp.asarray(row_tables), self._rng, jnp.asarray(temp),
-                jnp.asarray(top_p), self.lora,
-                jnp.asarray(adapter_idx) if self.lora is not None else None,
-                *((self._guided_tables, jnp.asarray(row_aut)) if guided else ()),
-            )
+            with self._annotation("podmortem.prefill", params_list):
+                outs = self._prefill_fns[key](
+                    self.params, staged, jnp.asarray(ids), jnp.asarray(lengths),
+                    jnp.asarray(row_tables), self._rng, jnp.asarray(temp),
+                    jnp.asarray(top_p), self.lora,
+                    jnp.asarray(adapter_idx) if self.lora is not None else None,
+                    *((self._guided_tables, jnp.asarray(row_aut)) if guided else ()),
+                )
             if guided:
                 self.paged_cache, first_tokens, self._rng, first_state = outs
             else:
                 self.paged_cache, first_tokens, self._rng = outs
         else:
-            outs = self._prefill_fns[key](
-                self.params, self.cache, jnp.asarray(ids), jnp.asarray(lengths),
-                jnp.asarray(slot_ids), self._rng, jnp.asarray(temp), jnp.asarray(top_p),
-                self.lora,
-                jnp.asarray(adapter_idx) if self.lora is not None else None,
-                *((self._guided_tables, jnp.asarray(row_aut)) if guided else ()),
-            )
+            with self._annotation("podmortem.prefill", params_list):
+                outs = self._prefill_fns[key](
+                    self.params, self.cache, jnp.asarray(ids), jnp.asarray(lengths),
+                    jnp.asarray(slot_ids), self._rng, jnp.asarray(temp), jnp.asarray(top_p),
+                    self.lora,
+                    jnp.asarray(adapter_idx) if self.lora is not None else None,
+                    *((self._guided_tables, jnp.asarray(row_aut)) if guided else ()),
+                )
             if guided:
                 self.cache, first_tokens, self._rng, first_state = outs
             else:

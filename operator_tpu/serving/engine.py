@@ -56,6 +56,7 @@ import numpy as np
 from ..models.configs import ModelConfig
 from ..models.llama import KVCache, forward
 from ..models.tokenizer import Tokenizer
+from ..obs import span as obs_span
 from ..utils.timing import METRICS, MetricsRegistry
 from .admission import AdmissionMixin
 from .programs import ProgramBuilderMixin
@@ -891,11 +892,12 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
             ids_chunk = self._jax.lax.dynamic_slice_in_dim(
                 job.ids, job.written, step_chunk, axis=1
             )
-            job.mini, job.last_logits = self._chunk_fns[fn_key](
-                self.params, job.mini, ids_chunk, job.lengths,
-                jnp.int32(job.written), job.last_logits,
-                self.lora, job.adapter_idx,
-            )
+            with self._annotation("podmortem.prefill_chunk", job.params_list):
+                job.mini, job.last_logits = self._chunk_fns[fn_key](
+                    self.params, job.mini, ids_chunk, job.lengths,
+                    jnp.int32(job.written), job.last_logits,
+                    self.lora, job.adapter_idx,
+                )
             job.written += step_chunk
             elapsed_ms = (time.perf_counter() - t0) * 1e3
             job.chunk_ms += elapsed_ms
@@ -930,17 +932,19 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
                 len(job.taken), n_pad, job.slot_ids_np, job.page_grants,
                 job.lengths_np,
             )
-            outs = self._finish_fns[fn_key2](
-                staged, job.mini, job.lengths,
-                jnp.asarray(row_tables), job.last_logits,
-                self._rng, job.temp, job.top_p, *guided_args,
-            )
+            with self._annotation("podmortem.prefill_finish", job.params_list):
+                outs = self._finish_fns[fn_key2](
+                    staged, job.mini, job.lengths,
+                    jnp.asarray(row_tables), job.last_logits,
+                    self._rng, job.temp, job.top_p, *guided_args,
+                )
         else:
-            outs = self._finish_fns[fn_key2](
-                self.cache, job.mini, job.lengths,
-                jnp.asarray(job.slot_ids_np), job.last_logits,
-                self._rng, job.temp, job.top_p, *guided_args,
-            )
+            with self._annotation("podmortem.prefill_finish", job.params_list):
+                outs = self._finish_fns[fn_key2](
+                    self.cache, job.mini, job.lengths,
+                    jnp.asarray(job.slot_ids_np), job.last_logits,
+                    self._rng, job.temp, job.top_p, *guided_args,
+                )
         if guided:
             cache_out, first_tokens, self._rng, first_state = outs
         else:
@@ -958,6 +962,27 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
         )
         if guided:
             self._apply_guided_activation(row_aut, job.taken, first_state)
+
+    # tracing ------------------------------------------------------------
+    def _annotation(self, name: str, params_list: Optional[list] = None):
+        """Host-side profiler marker around a prefill/decode region
+        (``jax.profiler.TraceAnnotation``) carrying the obs trace tags of
+        the wave, TraceMe-encoded (``name#trace=a,b#``) so an xplane
+        capture (scripts/analyze_xplane.py) joins the flight recorder's
+        per-analysis timeline.  A TraceMe costs nanoseconds while no
+        profiler session is active, so every step wears one."""
+        tags = sorted({
+            p.trace_tag for p in (params_list or [])
+            if p is not None and getattr(p, "trace_tag", None)
+        })
+        if tags:
+            name = f"{name}#trace={','.join(tags)}#"
+        try:
+            return self._jax.profiler.TraceAnnotation(name)
+        except Exception:  # noqa: BLE001 - profiler API unavailable: annotate nothing
+            import contextlib
+
+            return contextlib.nullcontext()
 
     def _sampling_tensors(self):
         """(active_np, temp_dev, top_p_dev, active_dev), rebuilt only when
@@ -1012,7 +1037,11 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
         started = time.perf_counter()
         block = self.decode_block
         if self.num_decoding:
-            self._dispatch_block()
+            with self._annotation(
+                "podmortem.decode",
+                [s.params for s in self.slots if s.active],
+            ):
+                self._dispatch_block()
         finished: list[tuple[int, GenerationResult]] = []
         # keep at most depth-1 blocks in flight; once nothing is active the
         # leftovers are flushed (their tokens belong to finished epochs)
@@ -1471,18 +1500,37 @@ class ServingEngine:
         future: asyncio.Future = asyncio.get_running_loop().create_future()
         if on_partial is not None:
             self._partial_by_future[future] = on_partial
-        if priority <= 0:
-            await self._low_lane.acquire()  # released when the entry is popped
-        await self._queue.put(
-            (-priority, next(self._seq), (prompt, params or SamplingParams(), future))
-        )
-        # the put may have landed after close()/loop-death drained the
-        # queue; _closed/_error were set before the drain, so re-checking
-        # here closes that window
-        if (self._closed or self._error is not None) and not future.done():
-            self._partial_by_future.pop(future, None)
-            future.set_exception(RuntimeError("serving engine is closed"))
-        return await future
+        # one obs span per engine request (joins the ambient analysis /
+        # HTTP trace; detached no-op outside one): the queue-wait vs
+        # compute split below is how a decode stall becomes attributable
+        # — the result's prefill/decode times are chip-side, the rest of
+        # the wall time was spent waiting for a slot/pages/the low lane
+        submitted = time.perf_counter()
+        with obs_span("engine.generate", priority=priority) as span_:
+            if priority <= 0:
+                await self._low_lane.acquire()  # released when the entry is popped
+            await self._queue.put(
+                (-priority, next(self._seq), (prompt, params or SamplingParams(), future))
+            )
+            # the put may have landed after close()/loop-death drained the
+            # queue; _closed/_error were set before the drain, so re-checking
+            # here closes that window
+            if (self._closed or self._error is not None) and not future.done():
+                self._partial_by_future.pop(future, None)
+                future.set_exception(RuntimeError("serving engine is closed"))
+            result = await future
+            wall_ms = (time.perf_counter() - submitted) * 1e3
+            span_.set(
+                queue_wait_ms=round(
+                    max(0.0, wall_ms - result.prefill_ms - result.decode_ms), 3
+                ),
+                prefill_ms=round(result.prefill_ms, 3),
+                decode_ms=round(result.decode_ms, 3),
+                prompt_tokens=result.prompt_tokens,
+                completion_tokens=result.completion_tokens,
+                finish_reason=result.finish_reason,
+            )
+            return result
 
     # ------------------------------------------------------------------
     async def _run(self) -> None:

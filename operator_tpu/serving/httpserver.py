@@ -43,6 +43,7 @@ import time
 import uuid
 from typing import Any, Optional
 
+from ..obs import current_trace_id, parse_traceparent
 from .engine import (
     GenerationResult,
     OversizedRequest,
@@ -156,6 +157,7 @@ class CompletionServer:
         embedder: Optional[Any] = None,  # .embed(texts)->ndarray, .dim
         embedding_model_id: str = "log-embedder",
         analysis_backend: Optional[Any] = None,  # .generate(AnalysisRequest)
+        tracer: Optional[Any] = None,  # obs.Tracer for inbound traceparent
     ) -> None:
         self.engine = engine
         self.model_id = model_id
@@ -171,6 +173,12 @@ class CompletionServer:
         self.max_tokens_cap = max_tokens_cap
         self.embedder = embedder
         self.embedding_model_id = embedding_model_id
+        #: inbound W3C traceparent support (docs/OBSERVABILITY.md): a
+        #: request carrying the header runs under a trace joining the
+        #: caller's trace id, and its engine spans (queue wait vs
+        #: prefill/decode) land in the flight recorder.  None = header
+        #: accepted but ignored.
+        self.tracer = tracer
         self._server: Optional[asyncio.AbstractServer] = None
         self._started = time.time()
 
@@ -202,11 +210,36 @@ class CompletionServer:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         status, payload = 500, {"error": {"message": "internal error"}}
+        accept = ""
         try:
             method, path, headers, body = await self._read_request(reader)
-            if path.split("?", 1)[0] != "/healthz":  # probes can't carry tokens
+            accept = headers.get("accept", "")
+            auth_exempt = path.split("?", 1)[0] == "/healthz"
+            if not auth_exempt:  # probes can't carry tokens
                 self._check_auth(headers)
-            status, payload = await self._route(method, path, body, writer)
+            remote = parse_traceparent(headers.get("traceparent"))
+            if remote is not None and auth_exempt and self.api_token:
+                # recording a trace consumes bounded flight-recorder ring
+                # slots; on a token-secured server the auth-exempt probe
+                # path must not let unauthenticated clients mint them
+                remote = None
+            # join the caller's distributed trace when one was offered:
+            # the serving-side spans (engine queue wait vs prefill/decode)
+            # record under THEIR trace id, inspectable via /traces
+            if remote is not None and self.tracer is not None:
+                trace_ctx = self.tracer.trace(
+                    f"http {path.split('?', 1)[0]}",
+                    trace_id=remote[0], parent_id=remote[1],
+                    attributes={"path": path.split("?", 1)[0]},
+                )
+            else:
+                import contextlib
+
+                trace_ctx = contextlib.nullcontext()
+            with trace_ctx:
+                status, payload = await self._route(
+                    method, path, body, writer, accept=accept
+                )
         except ApiError as exc:
             status = exc.status
             payload = {"error": {"message": str(exc), "type": exc.err_type, "code": None}}
@@ -238,7 +271,12 @@ class CompletionServer:
             return
         try:
             if isinstance(payload, bytes):  # /metrics Prometheus exposition
-                data, ctype = payload, "text/plain; version=0.0.4"
+                data = payload
+                ctype = (
+                    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+                    if "application/openmetrics-text" in accept
+                    else "text/plain; version=0.0.4"
+                )
             else:
                 data, ctype = json.dumps(payload).encode(), "application/json"
             writer.write(
@@ -299,7 +337,8 @@ class CompletionServer:
 
     # -- routing ------------------------------------------------------------
 
-    async def _route(self, method: str, path: str, body: bytes, writer):
+    async def _route(self, method: str, path: str, body: bytes, writer, *,
+                     accept: str = ""):
         path = path.split("?", 1)[0]
         if method == "GET" and path == "/healthz":
             return 200, {"status": "ok", "uptime_s": round(time.time() - self._started, 1)}
@@ -309,7 +348,11 @@ class CompletionServer:
             # standalone server
             return 200, self.engine.generator.metrics.snapshot()
         if method == "GET" and path == "/metrics":
-            return 200, self.engine.generator.metrics.prometheus().encode()
+            # exemplars only under OpenMetrics negotiation (a mid-line '#'
+            # breaks the classic text 0.0.4 parser outright)
+            return 200, self.engine.generator.metrics.prometheus(
+                openmetrics="application/openmetrics-text" in accept
+            ).encode()
         if method == "GET" and path == "/v1/models":
             models = [{
                 "id": self.model_id,
@@ -469,6 +512,9 @@ class CompletionServer:
             top_p=float(top_p), adapter=self._resolve_adapter(req),
             guided_choice=tuple(guided) if guided is not None else None,
             guided_regex=regex,  # guided_json arrives lowered to a regex
+            # a traceparent-carrying request's trace id rides into the
+            # engine's profiler annotations (None outside a trace)
+            trace_tag=current_trace_id(),
         )
         return params, stop
 

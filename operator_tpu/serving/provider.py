@@ -29,6 +29,7 @@ import logging
 import os
 from typing import Optional
 
+from ..obs import annotate_root, current_trace_id
 from ..schema.analysis import AIResponse, AnalysisRequest
 from ..utils.config import OperatorConfig
 from .engine import (
@@ -180,6 +181,10 @@ class TPUNativeProvider:
             adapter=adapter,
             guided_regex=guided_regex,
             deadline=abs_deadline,
+            # the analysis trace rides into the engine's profiler
+            # annotations (podmortem.prefill/decode TraceMe tags), so an
+            # xplane capture joins the flight-recorder timeline
+            trace_tag=current_trace_id(),
         )
         try:
             # priority 10: pod-failure explanations admit ahead of external
@@ -196,6 +201,10 @@ class TPUNativeProvider:
             )
         except Exception as exc:  # noqa: BLE001 - pipeline degrades to pattern-only
             log.exception("tpu-native generation failed")
+            # a dead serve loop / device error is exactly the moment the
+            # per-request timeline matters: flag the ambient trace for a
+            # black-box dump (operator/pipeline.py reads the root attr)
+            annotate_root("blackbox", "engine-error", overwrite=False)
             return AIResponse(error=str(exc), provider_id="tpu-native", model_id=self.model_id)
         outcome = None
         if abs_deadline is not None:

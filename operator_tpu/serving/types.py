@@ -39,6 +39,11 @@ class SamplingParams:
     #: set by admission when max_tokens was clamped to fit the deadline —
     #: the finish reason then reads "deadline" instead of "length"
     deadline_clamped: bool = False
+    #: obs trace id of the request's analysis (operator_tpu/obs/): the
+    #: engine stamps it into its jax.profiler prefill/decode annotations
+    #: so an xplane capture joins the flight recorder's timeline.  None =
+    #: untraced (external API caller without a traceparent).
+    trace_tag: Optional[str] = None
 
 
 @dataclass

@@ -84,6 +84,21 @@ class OperatorConfig:
     # can carry secrets, so fleets with untrusted pod networks set this
     incidents_api_token: str = ""
 
+    # --- observability (operator_tpu/obs/, docs/OBSERVABILITY.md) ---------
+    # per-analysis tracing + flight recorder: every analysis produces a
+    # span tree; deadline-exceeded / breaker-open / engine-error analyses
+    # additionally dump a black-box record
+    obs_enabled: bool = True
+    # bounded in-memory ring of recent traces (GET /traces)
+    trace_ring_capacity: int = 256
+    # append-only JSONL of every completed trace (crash-safe, same
+    # discipline as the incident journal); unset = ring only
+    trace_journal_path: Optional[str] = None
+    # black-box dumps (full trace + deadline ledger + fault-plan seed on
+    # deadline-exceeded / breaker-open / engine-error); unset = the
+    # trace journal path (or ring only when that is unset too)
+    trace_blackbox_path: Optional[str] = None
+
     # --- storage text caps ------------------------------------------------
     # Kubernetes rejects objects whose TOTAL annotations exceed 256 KiB;
     # the stored AI text is truncated at this cap with an explicit

@@ -62,6 +62,10 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._stages: dict[str, StageStats] = {}
         self._counters: dict[str, int] = {}
+        # most recent exemplar per counter (a trace id, obs/record.py):
+        # rendered OpenMetrics-style so an alert on a counter links
+        # straight to the trace that last bumped it
+        self._exemplars: dict[str, str] = {}
 
     def stage(self, name: str) -> StageStats:
         with self._lock:
@@ -87,9 +91,11 @@ class MetricsRegistry:
         finally:
             self.record(name, (time.perf_counter() - started) * 1e3)
 
-    def incr(self, name: str, amount: int = 1) -> None:
+    def incr(self, name: str, amount: int = 1, *, exemplar: Optional[str] = None) -> None:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + amount
+            if exemplar:
+                self._exemplars[name] = exemplar
 
     def counter(self, name: str) -> int:
         with self._lock:
@@ -97,7 +103,7 @@ class MetricsRegistry:
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "stages": {
                     name: {
                         "count": s.count,
@@ -110,11 +116,23 @@ class MetricsRegistry:
                 },
                 "counters": dict(self._counters),
             }
+            if self._exemplars:
+                # trace-id exemplars ride the JSON surface unconditionally
+                # (no format constraints there, unlike the text exposition)
+                out["exemplars"] = dict(self._exemplars)
+            return out
 
-    def prometheus(self) -> str:
+    def prometheus(self, *, openmetrics: bool = False) -> str:
         """Prometheus text exposition (version 0.0.4) of the same data, so
         any standard scraper can consume the operator's metrics; stage
-        latencies render as summaries with p50/p99 quantiles."""
+        latencies render as summaries with p50/p99 quantiles.
+
+        ``openmetrics=True`` renders the OpenMetrics flavour instead
+        (trailing ``# EOF``, counter exemplars): exemplars are ONLY legal
+        there — a mid-line ``#`` in classic text makes the legacy parser
+        reject the whole scrape, so the default exposition never emits
+        them.  Servers switch on content negotiation
+        (``Accept: application/openmetrics-text``)."""
 
         def sane(name: str) -> str:
             return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
@@ -132,9 +150,26 @@ class MetricsRegistry:
                     lines.append(f'{metric}_sum{{stage="{stage}"}} {s.total_ms:.3f}')
                     lines.append(f'{metric}_count{{stage="{stage}"}} {s.count}')
             for name, value in sorted(self._counters.items()):
-                metric = f"podmortem_{sane(name)}_total"
-                lines.append(f"# TYPE {metric} counter")
-                lines.append(f"{metric} {value}")
+                family = f"podmortem_{sane(name)}"
+                metric = f"{family}_total"
+                if openmetrics:
+                    # OpenMetrics names the counter FAMILY without the
+                    # _total suffix (the sample keeps it); declaring the
+                    # family as ..._total makes the reference parser
+                    # reject the exemplar-carrying sample — and the whole
+                    # scrape with it
+                    lines.append(f"# TYPE {family} counter")
+                else:
+                    lines.append(f"# TYPE {metric} counter")
+                exemplar = self._exemplars.get(name) if openmetrics else None
+                if exemplar:
+                    lines.append(
+                        f'{metric} {value} # {{trace_id="{sane(exemplar)}"}} 1'
+                    )
+                else:
+                    lines.append(f"{metric} {value}")
+            if openmetrics:
+                lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
 

@@ -245,6 +245,75 @@ def test_gl001_nested_called_def_reports_exactly_once(tmp_path):
     assert findings[0].symbol == "entry.helper"
 
 
+def test_gl001_gl002_trace_annotations_are_inert(tmp_path):
+    """jax.profiler.TraceAnnotation / jax.named_scope / obs span context
+    managers inside (or around) compiled bodies are trace-inert: not host
+    syncs, not tracer-unsafe control flow, and their results carry no
+    taint (operator_tpu/obs/; the serving engine wraps its prefill/decode
+    dispatches in exactly these)."""
+    files = {
+        "operator_tpu/serving/annotated.py": """
+            import jax
+            import jax.numpy as jnp
+            from operator_tpu.obs import span
+
+            @jax.jit
+            def entry(x):
+                with jax.named_scope("attn"):
+                    y = jnp.exp(x)
+                with jax.profiler.TraceAnnotation("podmortem.decode"):
+                    z = y * 2
+                return z
+
+            def host_step(self, x):
+                # host orchestration (reachable via jit? no — but the
+                # span result must not taint either way)
+                with span("engine.generate") as sp:
+                    if sp:  # span objects are host values, never traced
+                        pass
+                return entry(x)
+        """,
+    }
+    for rule in ("GL001", "GL002"):
+        findings, _ = run_rule(tmp_path, rule, dict(files))
+        assert findings == [], (rule, [f.render() for f in findings])
+
+
+def test_gl001_host_sync_inside_annotation_still_flagged(tmp_path):
+    """An annotation context must not mask a real host sync inside it."""
+    findings, _ = run_rule(tmp_path, "GL001", {
+        "operator_tpu/serving/annotated.py": """
+            import jax
+
+            @jax.jit
+            def entry(x):
+                with jax.named_scope("blk"):
+                    return x.item()
+        """,
+    })
+    assert len(findings) == 1
+    assert ".item()" in findings[0].message
+
+
+def test_jnp_trace_is_not_trace_inert(tmp_path):
+    """``jnp.trace`` is the MATRIX trace (an array op) — the trace-inert
+    carve-out must not sanitize it: branching on its result inside a
+    compiled body is still tracer-unsafe."""
+    findings, _ = run_rule(tmp_path, "GL002", {
+        "operator_tpu/serving/annotated.py": """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def entry(x):
+                if jnp.trace(x) > 0:
+                    return x
+                return -x
+        """,
+    })
+    assert len(findings) == 1
+
+
 # ---------------------------------------------------------------------------
 # GL003 deadline propagation
 # ---------------------------------------------------------------------------
@@ -318,15 +387,34 @@ def test_gl003_positive_literal_none_timeout_is_not_a_budget(tmp_path):
 
 
 def test_gl003_scope_excludes_other_modules(tmp_path):
-    # same code outside the four control-plane files is not in scope
+    # same code outside the eight control-plane files is not in scope
     findings, _ = run_rule(tmp_path, "GL003", {
-        "operator_tpu/operator/storage.py": """
+        "operator_tpu/operator/health.py": """
             class S:
                 async def fetch(self, name):
                     return await self.api.get("Pod", name, "ns")
         """,
     })
     assert findings == []
+
+
+def test_gl003_widened_scope_covers_storage_events_watcher_app(tmp_path):
+    """The flight-recorder PR widened GL003 beyond the four analysis-path
+    modules (the standing ROADMAP item): storage/events/watcher/app kube
+    calls must spend kube_call_timeout_s at the call."""
+    files = {
+        f"operator_tpu/operator/{name}.py": """
+            class S:
+                async def fetch(self, name):
+                    return await self.api.get("Pod", name, "ns")
+        """
+        for name in ("storage", "events", "watcher", "app")
+    }
+    findings, _ = run_rule(tmp_path, "GL003", files)
+    assert len(findings) == 4
+    assert {f.path.split("/")[-1] for f in findings} == {
+        "storage.py", "events.py", "watcher.py", "app.py"
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -146,6 +146,15 @@ async def _run_composed_scenario(plan: FaultPlan) -> dict:
     stop = asyncio.Event()
     task = asyncio.create_task(watcher.run(stop))
     await watcher.cache.wait_ready(5)
+    # wait for the POD watch stream itself (not just the CR cache): the
+    # after=1 pass-through window below must be consumed by the failure's
+    # WATCH-delivered event — if the pod lands before the stream opens,
+    # the pre-watch sweep observes it instead and the planned drop never
+    # meets a second delivery
+    for _ in range(500):
+        if any(r.kind == "Pod" for r in api._watches):
+            break
+        await asyncio.sleep(0.002)
     # the failure's ADDED event consumes the after=1 pass-through window
     # (analysis starts), so the NEXT pod event — the pipeline's own
     # annotation patch — hits the injected stream drop and the analysis's
@@ -169,8 +178,12 @@ async def _run_composed_scenario(plan: FaultPlan) -> dict:
         "trace": plan.trace(),
         "pending": plan.pending(),
         "failures": [
-            {k: v for k, v in f.items() if k != "failureTime"} | {
-                "failureTime": f.get("failureTime")}
+            # traceId is excluded from replay identity: flight-recorder
+            # trace ids are freshly minted per run by design (the spans'
+            # CONTENT is the deterministic part) — everything else must
+            # replay byte-identically
+            {k: v for k, v in f.items() if k not in ("failureTime", "traceId")}
+            | {"failureTime": f.get("failureTime")}
             for f in failures
         ],
         "successful_status_writes": [

@@ -1,0 +1,736 @@
+"""Flight recorder + per-analysis tracing (operator_tpu/obs/, docs/OBSERVABILITY.md).
+
+Covers the span model (nesting, ambient propagation, thread-safety), the
+bounded ring + JSONL journal round-trip, black-box dumps fired by a
+replayed chaos deadline-exceeded (reusing utils/faultinject.py plans),
+W3C traceparent propagation — emitted by the OpenAI-compat provider,
+accepted by both HTTP servers — and the /traces endpoints + view CLI.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import json
+import threading
+import urllib.error
+
+import pytest
+
+from operator_tpu.obs import (
+    FlightRecorder,
+    Tracer,
+    current_trace_id,
+    current_traceparent,
+    format_traceparent,
+    parse_traceparent,
+    render_tree,
+    span,
+)
+from operator_tpu.obs.view import main as view_main
+from operator_tpu.operator.httpserver import HealthServer
+from operator_tpu.operator.health import LivenessCheck, ReadinessCheck
+from operator_tpu.operator.kubeapi import FakeKubeApi
+from operator_tpu.operator.pipeline import AnalysisPipeline
+from operator_tpu.operator.providers import OpenAICompatProvider, default_registry
+from operator_tpu.patterns.engine import PatternEngine
+from operator_tpu.schema import (
+    AIProvider,
+    AIProviderRef,
+    AIProviderSpec,
+    LabelSelector,
+    ObjectMeta,
+    Podmortem,
+    PodmortemSpec,
+)
+from operator_tpu.schema.analysis import (
+    AIProviderConfig,
+    AnalysisRequest,
+    AnalysisResult,
+)
+from operator_tpu.utils.config import OperatorConfig
+from operator_tpu.utils.faultinject import FaultPlan, raise_, times
+from operator_tpu.utils.timing import MetricsRegistry
+
+from test_watcher_pipeline import failed_pod
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------------------
+# span model
+# ---------------------------------------------------------------------------
+
+
+class TestSpans:
+    def test_nesting_parents_and_attributes(self):
+        recorder = FlightRecorder(metrics=MetricsRegistry())
+        tracer = Tracer(recorder=recorder)
+        with tracer.trace("analysis", attributes={"pod": "ns/p"}) as root:
+            with tracer.span("collect") as collect:
+                pass
+            with tracer.span("explain") as explain:
+                with span("engine.generate") as engine:  # module-level form
+                    engine.set(queue_wait_ms=1.5)
+        record = recorder.get(root.trace_id)
+        assert record is not None
+        spans = {s["name"]: s for s in record.trace["spans"]}
+        assert set(spans) == {"analysis", "collect", "explain", "engine.generate"}
+        assert "parentId" not in spans["analysis"]
+        assert spans["collect"]["parentId"] == root.span_id
+        assert spans["engine.generate"]["parentId"] == explain.span_id
+        assert spans["engine.generate"]["attributes"]["queue_wait_ms"] == 1.5
+        assert collect.trace_id == root.trace_id
+        assert record.trace["status"] == "ok"
+
+    def test_exception_marks_error_and_reraises(self):
+        recorder = FlightRecorder(metrics=MetricsRegistry())
+        tracer = Tracer(recorder=recorder)
+        with pytest.raises(ValueError):
+            with tracer.trace("analysis") as root:
+                with tracer.span("parse"):
+                    raise ValueError("boom")
+        record = recorder.get(root.trace_id)
+        spans = {s["name"]: s for s in record.trace["spans"]}
+        assert spans["parse"]["status"] == "error"
+        assert "boom" in spans["parse"]["error"]
+        assert record.trace["status"] == "error"
+
+    def test_span_outside_trace_is_detached_noop(self):
+        recorder = FlightRecorder(metrics=MetricsRegistry())
+        # no trace open: a span still times but records nothing
+        with span("engine.generate") as detached:
+            pass
+        assert detached.trace_id == ""
+        assert len(recorder) == 0
+        assert current_trace_id() is None
+        assert current_traceparent() is None
+
+    def test_thread_safety_concurrent_spans_one_trace(self):
+        """Spans appended from many threads of one trace all land (the
+        state list is lock-guarded); each thread runs in its own context
+        COPY, exactly like asyncio.to_thread."""
+        recorder = FlightRecorder(metrics=MetricsRegistry())
+        tracer = Tracer(recorder=recorder)
+        with tracer.trace("analysis") as root:
+            def work(i):
+                for j in range(10):
+                    with span(f"w{i}.{j}"):
+                        pass
+
+            threads = [
+                threading.Thread(
+                    target=contextvars.copy_context().run, args=(work, i)
+                )
+                for i in range(8)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        record = recorder.get(root.trace_id)
+        assert len(record.trace["spans"]) == 1 + 8 * 10
+        # every worker span is a child of the root (the ambient parent
+        # each context copy carried in)
+        assert all(
+            s.get("parentId") == root.span_id
+            for s in record.trace["spans"]
+            if s["name"] != "analysis"
+        )
+
+
+class TestTraceparent:
+    def test_round_trip(self):
+        header = format_traceparent("ab" * 16, "cd" * 8)
+        assert parse_traceparent(header) == ("ab" * 16, "cd" * 8)
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "junk", "00-zz-cd-01",
+        f"00-{'0' * 32}-{'cd' * 8}-01",  # all-zero trace id
+        f"00-{'ab' * 16}-{'0' * 16}-01",  # all-zero span id
+        f"ff-{'ab' * 16}-{'cd' * 8}-01",  # forbidden version
+    ])
+    def test_malformed_rejected(self, bad):
+        assert parse_traceparent(bad) is None
+
+    def test_current_traceparent_matches_ambient_span(self):
+        tracer = Tracer()
+        with tracer.trace("t") as root:
+            assert parse_traceparent(current_traceparent()) == (
+                root.trace_id, root.span_id
+            )
+            with tracer.span("child") as child:
+                assert parse_traceparent(current_traceparent()) == (
+                    root.trace_id, child.span_id
+                )
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def _trace(self, tracer, name="t"):
+        with tracer.trace(name) as root:
+            with tracer.span("stage"):
+                pass
+        return root.trace_id
+
+    def test_ring_eviction_bounded_and_counted(self):
+        metrics = MetricsRegistry()
+        recorder = FlightRecorder(capacity=3, metrics=metrics)
+        tracer = Tracer(recorder=recorder)
+        ids = [self._trace(tracer) for _ in range(5)]
+        assert len(recorder) == 3
+        assert recorder.get(ids[0]) is None  # oldest evicted
+        assert recorder.get(ids[-1]) is not None
+        assert metrics.counter("trace_evicted") == 2
+        assert metrics.counter("trace_recorded") == 5
+        # newest first
+        assert [r.trace_id for r in recorder.traces()] == list(reversed(ids[2:]))
+
+    def test_jsonl_round_trip_and_torn_line(self, tmp_path):
+        path = str(tmp_path / "traces.jsonl")
+        recorder = FlightRecorder(path=path, metrics=MetricsRegistry())
+        tracer = Tracer(recorder=recorder)
+        ids = [self._trace(tracer, f"t{i}") for i in range(3)]
+        recorder.flush()  # journal writes ride a writer thread
+        # simulate a crash mid-append: torn tail line
+        with open(path, "a", encoding="utf-8") as f:
+            f.write('{"recordedAt": 1, "trace": {"traceId": "torn')
+        loaded = FlightRecorder.load(path)
+        assert [r.trace_id for r in loaded] == ids
+        assert loaded[0].trace == recorder.get(ids[0]).trace
+
+    def test_black_box_marks_and_dumps(self, tmp_path):
+        journal = str(tmp_path / "traces.jsonl")
+        blackbox = str(tmp_path / "blackbox.jsonl")
+        metrics = MetricsRegistry()
+        recorder = FlightRecorder(
+            path=journal, blackbox_path=blackbox, metrics=metrics
+        )
+        tracer = Tracer(recorder=recorder)
+        tid = self._trace(tracer)
+        assert recorder.black_box(tid, "deadline-exceeded",
+                                  {"deadline": {"total_s": 1}}) is not None
+        assert recorder.get(tid).blackbox
+        recorder.flush()
+        dumped = FlightRecorder.load(blackbox)
+        assert len(dumped) == 1 and dumped[0].blackbox
+        assert dumped[0].reason == "deadline-exceeded"
+        assert dumped[0].extra["deadline"]["total_s"] == 1
+        assert metrics.counter("trace_blackbox") == 1
+        # unknown trace: already fell off the ring
+        assert recorder.black_box("nope", "r") is None
+        # exemplars render ONLY under OpenMetrics negotiation — a mid-line
+        # '#' would make the classic 0.0.4 parser reject the whole scrape
+        om = metrics.prometheus(openmetrics=True)
+        assert f'podmortem_trace_blackbox_total 1 # {{trace_id="{tid}"}} 1' in om
+        assert om.rstrip().endswith("# EOF")
+        # OpenMetrics counter FAMILIES drop the _total suffix (the sample
+        # keeps it) — the reference parser rejects exemplar-carrying
+        # samples of a family declared as ..._total
+        assert "# TYPE podmortem_trace_blackbox counter" in om
+        assert "# TYPE podmortem_trace_blackbox_total counter" not in om
+        classic = metrics.prometheus()
+        assert "trace_id=" not in classic
+        assert all(
+            "#" not in line.split(" ", 1)[1]
+            for line in classic.splitlines()
+            if line and not line.startswith("#") and " " in line
+        )
+        # ...and unconditionally on the JSON surface
+        assert metrics.snapshot()["exemplars"]["trace_blackbox"] == tid
+
+    def test_black_box_records_are_pinned(self):
+        """A later trace reusing a black-boxed id (a proxy echoing our
+        traceparent) must not erase the forensic record, and routine
+        traffic must not churn dumps out of the bounded ring."""
+        metrics = MetricsRegistry()
+        recorder = FlightRecorder(capacity=4, metrics=metrics)
+        tracer = Tracer(recorder=recorder)
+        bad = self._trace(tracer, "analysis")
+        recorder.black_box(bad, "deadline-exceeded")
+        # same trace id recorded again (joined remote trace): not replaced
+        with tracer.trace("http /echo", trace_id=bad):
+            pass
+        assert recorder.get(bad).blackbox
+        assert recorder.get(bad).reason == "deadline-exceeded"
+        # a flood of ordinary traces evicts around the pinned dump
+        for _ in range(10):
+            self._trace(tracer, "noise")
+        assert len(recorder) == 4
+        assert recorder.get(bad) is not None, "forensic dump was churned out"
+
+    def test_shared_journal_dedupes_blackbox_twin_on_load(self, tmp_path):
+        """With blackbox_path defaulting to the journal, a dumped trace
+        appears on disk twice (plain record + dump); load() must return
+        ONE record — the black-boxed one."""
+        path = str(tmp_path / "traces.jsonl")
+        recorder = FlightRecorder(path=path, metrics=MetricsRegistry())
+        assert recorder.blackbox_path == path  # the documented default
+        tracer = Tracer(recorder=recorder)
+        ok = self._trace(tracer, "fine")
+        bad = self._trace(tracer, "doomed")
+        recorder.black_box(bad, "deadline-exceeded")
+        recorder.flush()
+        loaded = FlightRecorder.load(path)
+        assert [r.trace_id for r in loaded] == [ok, bad]
+        assert [r.blackbox for r in loaded] == [False, True]
+
+    def test_render_tree_shape(self):
+        recorder = FlightRecorder(metrics=MetricsRegistry())
+        tracer = Tracer(recorder=recorder)
+        tid = self._trace(tracer, "analysis")
+        text = render_tree(recorder.get(tid).trace)
+        assert f"trace {tid}" in text
+        assert "analysis" in text and "stage" in text
+        assert "100.0%" in text
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario: chaos deadline-exceeded -> black-box dump
+# ---------------------------------------------------------------------------
+
+
+def _failing_opener(req, timeout=None):  # pragma: no cover - never reached
+    raise AssertionError("fault plan should fire before the transport")
+
+
+async def _deadline_exceeded_stack(tmp_path, seed: int, run_tag: str):
+    """Pipeline over a fault-planned fake apiserver: the HTTP provider's
+    every attempt raises (plan seam http.provider), the CR's 1 s envelope
+    dies inside the AI leg -> terminal deadline-exceeded."""
+    plan = FaultPlan(seed=seed)
+    plan.rule("http.provider", times(
+        6, raise_(lambda: urllib.error.URLError("injected backend down"))
+    ))
+    api = FakeKubeApi()
+    api.fault_plan = plan
+    config = OperatorConfig(pattern_cache_directory="/nonexistent")
+    metrics = MetricsRegistry()
+    recorder = FlightRecorder(
+        path=str(tmp_path / f"traces-{run_tag}.jsonl"),
+        blackbox_path=str(tmp_path / f"blackbox-{run_tag}.jsonl"),
+        metrics=metrics,
+    )
+    providers = default_registry()
+    backend = OpenAICompatProvider(opener=_failing_opener)
+    backend.fault_plan = plan
+    providers.register("openai", backend)
+    pipeline = AnalysisPipeline(
+        api, PatternEngine(), config=config, metrics=metrics,
+        providers=providers, tracer=Tracer(recorder=recorder),
+    )
+    await api.create_obj(AIProvider(
+        metadata=ObjectMeta(name="ai", namespace="prod"),
+        spec=AIProviderSpec(provider_id="openai", api_url="http://backend",
+                            model_id="m", timeout_seconds=1),
+    ))
+    podmortem = Podmortem(
+        metadata=ObjectMeta(name="pm", namespace="prod"),
+        spec=PodmortemSpec(
+            pod_selector=LabelSelector(match_labels={"app": "web"}),
+            ai_provider_ref=AIProviderRef(name="ai", namespace="prod"),
+            analysis_deadline="1",  # the whole envelope: one second
+        ),
+    )
+    await api.create_obj(podmortem)
+    pod = failed_pod()
+    await api.create_obj(pod)
+    api.set_pod_log(
+        "prod", "web-1",
+        "java.lang.OutOfMemoryError: Java heap space\n  at com.example.App\n",
+    )
+    result = await pipeline.process_pod_failure(
+        pod, podmortem, failure_time="2026-07-28T09:00:00Z"
+    )
+    return api, pipeline, recorder, plan, result
+
+
+def _span_coverage(trace: dict) -> float:
+    """Fraction of the root span's wall time covered by the union of its
+    direct children's intervals — the acceptance bar is >= 0.95."""
+    spans = trace["spans"]
+    root = next(s for s in spans if not s.get("parentId"))
+    children = [s for s in spans if s.get("parentId") == root["spanId"]]
+    intervals = sorted(
+        (s["startNs"], s["endNs"]) for s in children if s.get("endNs")
+    )
+    covered = 0
+    cursor = root["startNs"]
+    for start, end in intervals:
+        start = max(start, cursor)
+        if end > start:
+            covered += end - start
+            cursor = end
+    total = root["endNs"] - root["startNs"]
+    return covered / total if total else 0.0
+
+
+@pytest.mark.parametrize("seed", [7])
+def test_chaos_deadline_exceeded_black_box(tmp_path, seed, capsys):
+    """The acceptance criterion end to end: an analysis driven to
+    deadline-exceeded under a seeded fault plan produces a black-box
+    JSONL dump whose span tree accounts for >=95% of the wall time
+    between claim and terminal status, is viewable via the obs.view CLI
+    and GET /traces/{id}, and the trace id appears in
+    status.recentFailures[] — and the scenario REPLAYS (same plan seed,
+    second run) to a second dump with the same shape."""
+
+    async def one_run(tag):
+        api, pipeline, recorder, plan, result = await _deadline_exceeded_stack(
+            tmp_path, seed, tag
+        )
+        stored = await api.get("Podmortem", "pm", "prod")
+        return api, recorder, plan, result, stored
+
+    api, recorder, plan, result, stored = run(one_run("a"))
+    assert result is not None
+    assert plan.trace(), "the fault plan never fired — vacuous scenario"
+
+    entry = stored["status"]["recentFailures"][0]
+    assert entry["analysisStatus"] == "deadline-exceeded"
+    trace_id = entry["traceId"]
+    assert trace_id
+
+    # the black-box dump exists, names the reason and the fault plan seed
+    record = recorder.get(trace_id)
+    assert record is not None and record.blackbox
+    assert record.reason == "deadline-exceeded"
+    assert record.extra["fault_plan"]["seed"] == seed
+    assert record.extra["deadline"]["total_s"] == 1.0
+    recorder.flush()
+    dumped = FlightRecorder.load(str(tmp_path / "blackbox-a.jsonl"))
+    assert [r.trace_id for r in dumped] == [trace_id]
+
+    # the span tree accounts for >=95% of claim -> terminal status
+    assert _span_coverage(record.trace) >= 0.95
+
+    # the explain stage is where the budget died
+    spans = {s["name"]: s for s in record.trace["spans"]}
+    assert spans["explain"]["attributes"]["outcome"] == "deadline-exceeded"
+
+    # viewable via the CLI (full tree for the trace id)
+    assert view_main([str(tmp_path / "blackbox-a.jsonl"), trace_id]) == 0
+    out = capsys.readouterr().out
+    assert "BLACK BOX: deadline-exceeded" in out
+    assert f"trace {trace_id}" in out
+    assert "explain" in out
+
+    # ... and via GET /traces/{id} on the operator health server
+    async def serve():
+        server = HealthServer(
+            LivenessCheck(),
+            ReadinessCheck(FakeKubeApi(), OperatorConfig(
+                pattern_cache_directory="/nonexistent")),
+            metrics=MetricsRegistry(), recorder=recorder,
+        )
+        listing = await server._route("GET", "/traces", {"blackbox": ["1"]})
+        one = await server._route("GET", f"/traces/{trace_id}", {})
+        missing = await server._route("GET", "/traces/ffffffff", {})
+        return listing, one, missing
+
+    listing, one, missing = run(serve())
+    assert listing[0] == 200
+    assert [t["traceId"] for t in listing[1]["traces"]] == [trace_id]
+    assert one[0] == 200
+    assert one[1]["reason"] == "deadline-exceeded"
+    assert f"trace {trace_id}" in one[1]["rendered"]
+    assert missing[0] == 404
+
+    # REPLAY: an equal plan drives a second run to a second dump with the
+    # same reason and seed (the chaos determinism contract, reused here)
+    _, recorder_b, plan_b, _, stored_b = run(one_run("b"))
+    entry_b = stored_b["status"]["recentFailures"][0]
+    assert entry_b["analysisStatus"] == "deadline-exceeded"
+    record_b = recorder_b.get(entry_b["traceId"])
+    assert record_b is not None and record_b.blackbox
+    assert record_b.reason == record.reason
+    assert record_b.extra["fault_plan"]["seed"] == seed
+
+
+def test_black_box_dump_survives_analysis_exception():
+    """A trace flagged for a dump still dumps when the analysis RAISES
+    after the flag (shutdown/cancellation/unexpected error) — hard
+    failures are exactly when the forensic record matters."""
+
+    async def go():
+        api = FakeKubeApi()
+        recorder = FlightRecorder(metrics=MetricsRegistry())
+        pipeline = AnalysisPipeline(
+            api, PatternEngine(),
+            config=OperatorConfig(pattern_cache_directory="/nonexistent"),
+            metrics=MetricsRegistry(), tracer=Tracer(recorder=recorder),
+        )
+        pm = Podmortem(
+            metadata=ObjectMeta(name="pm", namespace="prod"),
+            spec=PodmortemSpec(
+                pod_selector=LabelSelector(match_labels={"app": "web"})
+            ),
+        )
+        await api.create_obj(pm)
+        pod = failed_pod()
+        await api.create_obj(pod)
+        api.set_pod_log("prod", "web-1", "OutOfMemoryError\n")
+
+        async def exploding_store(*args, **kwargs):
+            from operator_tpu.obs import annotate_root
+
+            annotate_root("blackbox", "breaker-open", overwrite=False)
+            raise RuntimeError("apiserver exploded mid-store")
+
+        pipeline.storage.store_analysis_results = exploding_store
+        with pytest.raises(RuntimeError):
+            await pipeline.process_pod_failure(pod, pm)
+        dumps = recorder.traces(blackbox_only=True)
+        assert len(dumps) == 1
+        assert dumps[0].reason == "breaker-open"
+        assert dumps[0].trace["status"] == "error"
+
+    run(go())
+
+
+def test_incident_memory_links_trace_ids(tmp_path):
+    """Incident records carry the last sighting's trace id (journal
+    round-trip included), and a recurrence's recall decision surfaces the
+    PRIOR trace id — the prior-timeline link."""
+    from operator_tpu.memory.store import Incident, IncidentStore
+
+    path = str(tmp_path / "incidents.jsonl")
+    store = IncidentStore(path)
+    store.upsert(Incident(fingerprint="fp1", template="t"))
+    store.record_recurrence("fp1", trace_id="a" * 32)
+    store.close()
+    reloaded = IncidentStore(path)
+    assert reloaded.get("fp1").last_trace_id == "a" * 32
+    reloaded.close()
+
+
+# ---------------------------------------------------------------------------
+# traceparent over the wire
+# ---------------------------------------------------------------------------
+
+
+def test_openai_provider_emits_traceparent():
+    """The OpenAI-compat path stamps the ambient trace's W3C header on
+    its outbound HTTP attempts."""
+    captured = {}
+
+    def opener(req, timeout=None):
+        import io
+
+        captured["traceparent"] = req.get_header("Traceparent")
+
+        class _Resp(io.BytesIO):
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+        return _Resp(json.dumps({
+            "choices": [{"message": {"content": "Root Cause: x."}}],
+        }).encode())
+
+    provider = OpenAICompatProvider(opener=opener)
+    request = AnalysisRequest(
+        analysis_result=AnalysisResult(),
+        provider_config=AIProviderConfig(
+            provider_id="openai", api_url="http://x", model_id="m"
+        ),
+    )
+    tracer = Tracer()
+
+    async def go():
+        with tracer.trace("analysis") as root:
+            with tracer.span("ai_generate") as parent:
+                response = await provider.generate(request)
+            return root, parent, response
+
+    root, parent, response = run(go())
+    assert response.explanation
+    assert parse_traceparent(captured["traceparent"]) == (
+        root.trace_id, parent.span_id
+    )
+
+
+def test_health_server_accepts_traceparent(tmp_path):
+    """An inbound traceparent on the operator health server records the
+    request under the CALLER's trace id."""
+    recorder = FlightRecorder(metrics=MetricsRegistry())
+    tracer = Tracer(recorder=recorder)
+    caller_trace = "ab" * 16
+
+    async def go():
+        server = HealthServer(
+            LivenessCheck(),
+            ReadinessCheck(FakeKubeApi(), OperatorConfig(
+                pattern_cache_directory=str(tmp_path))),
+            metrics=MetricsRegistry(), recorder=recorder, tracer=tracer,
+            host="127.0.0.1", port=0,
+        )
+        await server.start()
+        reader, writer = await asyncio.open_connection("127.0.0.1", server.bound_port)
+        writer.write(
+            b"GET /metrics.json HTTP/1.1\r\nHost: x\r\n"
+            b"traceparent: " + format_traceparent(caller_trace, "cd" * 8).encode()
+            + b"\r\n\r\n"
+        )
+        await writer.drain()
+        raw = await reader.read()
+        writer.close()
+        await writer.wait_closed()
+        await server.stop()
+        return raw
+
+    raw = run(go())
+    assert raw.split()[1] == b"200"
+    record = recorder.get(caller_trace)
+    assert record is not None
+    root = record.trace["spans"][0]
+    assert root["attributes"]["path"] == "/metrics.json"
+    assert root["attributes"]["remote_parent"] == "cd" * 8
+
+
+def test_health_server_traceparent_requires_token_when_gated(tmp_path):
+    """On a token-gated deployment, an unauthenticated traceparent must
+    NOT mint a trace — recording consumes bounded ring slots, so only
+    token-holders (who can read /traces anyway) get to do it."""
+    recorder = FlightRecorder(metrics=MetricsRegistry())
+    tracer = Tracer(recorder=recorder)
+
+    async def go():
+        server = HealthServer(
+            LivenessCheck(),
+            ReadinessCheck(FakeKubeApi(), OperatorConfig(
+                pattern_cache_directory=str(tmp_path))),
+            metrics=MetricsRegistry(), recorder=recorder, tracer=tracer,
+            incidents_token="sekrit", host="127.0.0.1", port=0,
+        )
+        await server.start()
+
+        async def req(trace_id, auth=b""):
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", server.bound_port)
+            writer.write(
+                b"GET /metrics.json HTTP/1.1\r\nHost: x\r\n" + auth
+                + b"traceparent: "
+                + format_traceparent(trace_id, "cd" * 8).encode() + b"\r\n\r\n"
+            )
+            await writer.drain()
+            raw = await reader.read()
+            writer.close()
+            await writer.wait_closed()
+            return raw
+
+        anon = await req("aa" * 16)
+        authed = await req("bb" * 16, auth=b"Authorization: Bearer sekrit\r\n")
+        await server.stop()
+        return anon, authed
+
+    anon, authed = run(go())
+    assert anon.split()[1] == b"200"  # the route itself is open
+    assert recorder.get("aa" * 16) is None  # ...but no trace was minted
+    assert authed.split()[1] == b"200"
+    assert recorder.get("bb" * 16) is not None
+
+
+def test_completion_api_traceparent_joins_engine_spans():
+    """traceparent through the completion API: the serving-side spans —
+    including engine.generate with its queue-wait vs prefill/decode
+    split — land in the flight recorder under the caller's trace id, and
+    the request's trace tag rides into the engine's SamplingParams."""
+    import jax
+    import jax.numpy as jnp
+
+    from operator_tpu.models import TINY_TEST, init_params
+    from operator_tpu.models.tokenizer import load_tokenizer
+    from operator_tpu.serving.engine import BatchedGenerator, ServingEngine
+    from operator_tpu.serving.httpserver import CompletionServer
+
+    recorder = FlightRecorder(metrics=MetricsRegistry())
+    tracer = Tracer(recorder=recorder)
+    caller_trace = "12" * 16
+
+    generator = BatchedGenerator(
+        init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32),
+        TINY_TEST, load_tokenizer(None),
+        max_slots=2, max_seq=64, cache_dtype=jnp.float32,
+    )
+
+    async def go():
+        engine = ServingEngine(generator, admission_wait_s=0.001)
+        server = CompletionServer(
+            engine, model_id="tiny-test", host="127.0.0.1", port=0,
+            tracer=tracer,
+        )
+        await server.start()
+        body = json.dumps({"prompt": "pod failed", "max_tokens": 4}).encode()
+        reader, writer = await asyncio.open_connection("127.0.0.1", server.bound_port)
+        writer.write(
+            b"POST /v1/completions HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
+            b"traceparent: " + format_traceparent(caller_trace, "34" * 8).encode()
+            + b"\r\nContent-Length: " + str(len(body)).encode() + b"\r\n\r\n"
+            + body
+        )
+        await writer.drain()
+        raw = await reader.read()
+        writer.close()
+        await writer.wait_closed()
+        await server.stop()
+        await engine.close()
+        return raw
+
+    raw = run(go())
+    head, _, body = raw.partition(b"\r\n\r\n")
+    assert head.split()[1] == b"200", raw[:200]
+    payload = json.loads(body)
+    assert payload["choices"][0]["text"] is not None
+
+    record = recorder.get(caller_trace)
+    assert record is not None
+    spans = {s["name"]: s for s in record.trace["spans"]}
+    assert "engine.generate" in spans
+    attrs = spans["engine.generate"]["attributes"]
+    assert {"queue_wait_ms", "prefill_ms", "decode_ms"} <= set(attrs)
+    assert attrs["completion_tokens"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# view CLI edge cases
+# ---------------------------------------------------------------------------
+
+
+class TestViewCli:
+    def test_missing_file_is_usage_error(self, tmp_path, capsys):
+        assert view_main([str(tmp_path / "nope.jsonl")]) == 2
+        assert "error" in capsys.readouterr().err
+
+    def test_unknown_trace_id(self, tmp_path, capsys):
+        path = tmp_path / "t.jsonl"
+        recorder = FlightRecorder(path=str(path), metrics=MetricsRegistry())
+        Tracer(recorder=recorder)  # construction only; no traces recorded
+        path.write_text("")
+        assert view_main([str(path), "deadbeef"]) == 1
+
+    def test_summary_and_blackbox_filter(self, tmp_path, capsys):
+        path = str(tmp_path / "t.jsonl")
+        recorder = FlightRecorder(path=path, metrics=MetricsRegistry())
+        tracer = Tracer(recorder=recorder)
+        with tracer.trace("ok-trace"):
+            pass
+        with tracer.trace("bad-trace") as bad:
+            pass
+        recorder.black_box(bad.trace_id, "breaker-open")
+        recorder.flush()
+        assert view_main([path]) == 0
+        out = capsys.readouterr().out
+        assert "ok-trace" in out and "bad-trace" in out
+        assert view_main([path, "--blackbox", "--all"]) == 0
+        out = capsys.readouterr().out
+        assert "breaker-open" in out and "ok-trace" not in out

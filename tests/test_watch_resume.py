@@ -227,6 +227,14 @@ def test_watcher_survives_410_relist_disconnect_storm():
         stop = asyncio.Event()
         task = asyncio.create_task(watcher.run(stop))
         await watcher.cache.wait_ready(5)
+        # wait for the POD watch stream itself (not just the CR cache):
+        # the storm's after=N windows count WATCH deliveries, so the
+        # failure must land after the stream is open — otherwise the
+        # pre-watch sweep observes it and the drops never meet an event
+        for _ in range(500):
+            if any(r.kind == "Pod" for r in api._watches):
+                break
+            await asyncio.sleep(0.002)
         # the failure lands while the stream is being storm-dropped
         await api.create("Pod", failed_pod().to_dict())
         # condition wait: the analysis landed AND the whole storm fired
