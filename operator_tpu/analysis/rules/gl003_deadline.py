@@ -10,7 +10,8 @@ The rule: every blocking external call in the control-plane files
 (``operator/pipeline.py``, ``providers.py``, ``patternsync.py``,
 ``kubeapi.py`` — and, since the flight-recorder PR widened the net to the
 rest of the control plane, ``storage.py``, ``events.py``, ``watcher.py``,
-``app.py``) must be budget-bound **at the call itself**:
+``app.py``, plus the HA modules ``lease.py`` and ``claims.py``) must be
+budget-bound **at the call itself**:
 
 - wrapped in ``asyncio.wait_for(...)`` (the residue of a threaded
   Deadline — ``timeout=deadline.remaining()`` — is the idiom), or
@@ -83,6 +84,13 @@ class DeadlinePropagation(Rule):
         r"operator_tpu/operator/events\.py$",
         r"operator_tpu/operator/watcher\.py$",
         r"operator_tpu/operator/app\.py$",
+        # survivable-control-plane modules (ISSUE 5): every lease
+        # acquire/renew/release call and every claim-resume kube read must
+        # spend kube_call_timeout_s AT the call — a wedged apiserver may
+        # cost one bounded tick, never the renew loop (a leader that can't
+        # step down is a split brain) or the takeover resume
+        r"operator_tpu/operator/lease\.py$",
+        r"operator_tpu/operator/claims\.py$",
     )
 
     def check(self, ctx: AnalysisContext) -> list[Finding]:

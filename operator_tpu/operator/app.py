@@ -33,6 +33,7 @@ from .health import (
 )
 from .httpserver import HealthServer
 from .kubeapi import FakeKubeApi, KubeApi
+from .lease import LeaseElector
 from .patternsync import GitSyncService, PatternLibraryReconciler
 from .pipeline import AnalysisPipeline
 from .providers import ProviderRegistry, default_registry
@@ -126,8 +127,38 @@ class Operator:
             )
         self.completion_server = None  # started on demand (completion_api_port)
         self.completion_task: Optional[asyncio.Task] = None
+        # HA (docs/ROBUSTNESS.md): with leader_election on, the control
+        # loops run only while this replica holds the Lease; standbys keep
+        # probes + the serving engine warm and take over on expiry —
+        # resuming the dead leader's non-terminal claims from the ledger
+        self.elector: Optional[LeaseElector] = None
+        if self.config.leader_election:
+            import os
+            import socket
+
+            identity = (
+                self.config.pod_name
+                or f"{socket.gethostname()}-{os.getpid()}"
+            )
+            namespace = (
+                self.config.lease_namespace
+                or getattr(api, "namespace", None)
+                or "default"
+            )
+            self.elector = LeaseElector(
+                api,
+                lease_name=self.config.lease_name,
+                namespace=namespace,
+                identity=identity,
+                duration_s=self.config.lease_duration_s,
+                renew_period_s=self.config.lease_renew_period_s,
+                retry_period_s=self.config.lease_retry_period_s,
+                kube_timeout_s=self.config.kube_call_timeout_s,
+                metrics=self.metrics,
+            )
         self._stop = asyncio.Event()
         self._tasks: list[asyncio.Task] = []
+        self._control_tasks: list[asyncio.Task] = []
 
     def _register_tpu_provider(self) -> None:
         """Lazily wire the tpu-native serving backend; on hosts without jax
@@ -177,6 +208,9 @@ class Operator:
             engine, model_id = await loop.run_in_executor(
                 None, build_serving_engine, self.config
             )
+            # the supervisor's black-box dumps land in the SAME flight
+            # recorder the analysis traces use (GET /traces serves both)
+            engine.recorder = self.recorder
             # /v1/embeddings reuses the pattern engine's embedder (MiniLM if
             # an encoder checkpoint is mounted, lexical hashing otherwise);
             # NeuralEmbedder.embed is internally locked, so sharing one
@@ -205,6 +239,7 @@ class Operator:
                 # inbound traceparent joins the caller's trace; the spans
                 # land in the same flight recorder /traces serves
                 tracer=self.tracer,
+                drain_grace_s=self.config.serving_drain_grace_s,
             )
             await server.start()
             # warmup: one throwaway generation compiles the prefill + decode
@@ -328,12 +363,149 @@ class Operator:
             self.completion_task = asyncio.create_task(
                 self._start_completion_api(), name="completion-api"
             )
-        self._tasks = [
+        if self.elector is None:
+            # single-replica mode: resume any claims a crashed predecessor
+            # left in the ledger, then run the control loops — resume must
+            # COMPLETE first, or the watcher's pre-watch sweep could claim
+            # a failure that ClaimLedger.reload() then re-lists as pending
+            # and analyzes a second time, concurrently
+            self._tasks = [
+                asyncio.create_task(
+                    self._single_replica_cycle(), name="claims-resume"
+                ),
+            ]
+        else:
+            # HA mode: contend for the Lease; the leader cycle starts and
+            # stops the control loops as leadership comes and goes
+            self._tasks = [
+                asyncio.create_task(
+                    self.elector.run(self._stop), name="leader-elector"
+                ),
+                asyncio.create_task(self._leader_cycle(), name="leader-cycle"),
+            ]
+
+    def _spawn_control_tasks(self) -> list[asyncio.Task]:
+        return [
             asyncio.create_task(self.watcher.run(self._stop), name="pod-watcher"),
             asyncio.create_task(self.podmortem_reconciler.run(self._stop), name="podmortem-reconciler"),
             asyncio.create_task(self.aiprovider_reconciler.run(self._stop), name="aiprovider-reconciler"),
             asyncio.create_task(self.pattern_reconciler.run(self._stop), name="patternlibrary-reconciler"),
         ]
+
+    async def _single_replica_cycle(self) -> None:
+        await self._resume_claims()
+        self._control_tasks = self._spawn_control_tasks()
+        try:
+            # propagate control-loop crashes (run_forever's gather watches
+            # this task); stop() cancels the control tasks directly
+            await asyncio.gather(*self._control_tasks)
+        finally:
+            # first crash cancels the SIBLINGS too — without this the
+            # surviving reconcilers keep patching CRs through stop()'s
+            # drain while the watcher is already dead
+            for task in self._control_tasks:
+                task.cancel()
+            await asyncio.gather(*self._control_tasks, return_exceptions=True)
+
+    async def _resume_claims(self) -> None:
+        try:
+            resumed = await self.pipeline.resume_pending()
+            if resumed:
+                log.info("resumed %d in-flight analyses from the claim ledger",
+                         resumed)
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 - resume is best-effort recovery
+            log.exception("claim-ledger resume failed; continuing")
+
+    async def _leader_cycle(self) -> None:
+        """Run the control loops only while holding the Lease.  On
+        takeover, first resume the previous leader's non-terminal claims
+        (idempotent status patches make a double-completed claim converge
+        anyway), THEN start the watcher — whose startup re-lists pods and
+        CRs, closing any blind window the dead leader left."""
+        assert self.elector is not None
+        while not self._stop.is_set():
+            if not await self.elector.wait_leading(self._stop):
+                return  # stopping
+            if self._stop.is_set():
+                return
+            # watch for depose through BOTH phases — resume can run for
+            # minutes of residual claim budget, and a deposed replica must
+            # not keep analyzing claims the new leader is resuming
+            lost = asyncio.create_task(
+                self.elector.wait_not_leading(self._stop),
+                name="leadership-lost",
+            )
+            crashed: list[asyncio.Task] = []
+            resume: Optional[asyncio.Task] = None
+            try:
+                resume = asyncio.create_task(
+                    self._resume_claims(), name="claims-resume"
+                )
+                await asyncio.wait(
+                    {resume, lost}, return_when=asyncio.FIRST_COMPLETED
+                )
+                if not resume.done():
+                    resume.cancel()  # deposed mid-resume
+                    await asyncio.gather(resume, return_exceptions=True)
+                    continue
+                await resume  # raises nothing: _resume_claims guards itself
+                if lost.done():
+                    continue
+                self._control_tasks = self._spawn_control_tasks()
+                done, _ = await asyncio.wait(
+                    {lost, *self._control_tasks},
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                crashed = [task for task in done if task is not lost]
+            finally:
+                # resume too: if stop() cancels THIS task mid-wait, an
+                # orphaned resume would keep analyzing past claims.close()
+                # (its terminal ledger records silently dropped)
+                settle = [lost] + ([resume] if resume is not None else [])
+                for task in settle:
+                    task.cancel()
+                await asyncio.gather(*settle, return_exceptions=True)
+                # leadership lost (or stopping, or a control loop died):
+                # halt — another replica may already be leading, and two
+                # concurrent watchers double-analyze everything
+                await self._halt_control_tasks()
+            for task in crashed:
+                if task.exception() is not None:
+                    # zombie-leader guard: a dead control loop must not
+                    # leave this replica renewing the lease with no
+                    # watcher running while the healthy standby is fenced
+                    # out.  Die loudly — run_forever exits, kubernetes
+                    # restarts the pod, the standby takes over.
+                    raise task.exception()
+
+    async def _halt_control_tasks(self) -> None:
+        deposed = not self._stop.is_set()
+        if deposed:
+            # deposed, not stopping.  FIRST — before any cancellation can
+            # run a BaseException handler that releases a claim — stop
+            # touching the shared ledger: a deposed replica's appends, or
+            # a stale compaction they trigger (os.replace from THIS
+            # process's memory), must not clobber records the new leader
+            # is writing.  The handle reopens via reload() when (if) this
+            # replica re-acquires (resume_pending).  Cancelled analyses
+            # then release their claims in this process's memory only;
+            # the new leader re-runs them from the ledger as non-terminal,
+            # which is the at-least-once contract.
+            self.pipeline.claims.abandon()
+        for task in self._control_tasks:
+            task.cancel()
+        await asyncio.gather(*self._control_tasks, return_exceptions=True)
+        self._control_tasks = []
+        if deposed:
+            # the watcher's DETACHED analysis tasks survive its
+            # cancellation, but a deposed leader must not keep analyzing —
+            # the new leader resumes the same claims from the shared
+            # ledger (concurrent double analysis).  (Graceful stop()
+            # instead drains them first, under shutdown_grace_s.)
+            self.watcher.cancel_inflight()
+            await self.watcher.drain()
 
     async def stop(self) -> None:
         self._stop.set()
@@ -347,11 +519,29 @@ class Operator:
             await self.completion_server.stop()
             await self.completion_server.engine.close()
             self.completion_server = None
-        await self.watcher.drain()
-        for task in self._tasks:
+        # graceful drain: in-flight analyses finish (their own deadlines
+        # usually end them sooner) or are cancelled at the grace boundary —
+        # a wedged analysis must not hold SIGTERM past the pod's
+        # terminationGracePeriod and get the whole process SIGKILLed with
+        # unflushed journals
+        try:
+            await asyncio.wait_for(
+                self.watcher.drain(), timeout=self.config.shutdown_grace_s
+            )
+        except asyncio.TimeoutError:
+            log.warning(
+                "in-flight analyses still running after the %.0fs shutdown "
+                "grace; cancelling them", self.config.shutdown_grace_s,
+            )
+            self.watcher.cancel_inflight()
+            await self.watcher.drain()
+        for task in [*self._tasks, *self._control_tasks]:
             task.cancel()
-        await asyncio.gather(*self._tasks, return_exceptions=True)
+        await asyncio.gather(
+            *self._tasks, *self._control_tasks, return_exceptions=True
+        )
         self._tasks = []
+        self._control_tasks = []
         if self.memory is not None:
             if self.config.memory_configmap:
                 # final forced snapshot: incidents inserted inside the last
@@ -364,6 +554,19 @@ class Operator:
                 except Exception:  # noqa: BLE001 - shutdown must complete
                     log.warning("final incident snapshot failed", exc_info=True)
             self.memory.close()  # flush+close the incident journal handle
+        if self.recorder is not None:
+            # barrier on the flight-recorder writer thread: the last
+            # analyses' traces (and any black-box dump) must be on disk
+            # before the process exits
+            try:
+                self.recorder.flush()
+            except Exception:  # noqa: BLE001 - shutdown must complete
+                log.warning("flight-recorder flush failed", exc_info=True)
+        self.pipeline.claims.close()  # terminal ledger records are on disk
+        if self.elector is not None:
+            # release LAST so the standby takes over a fully drained state
+            # (and immediately, instead of waiting out the lease duration)
+            await self.elector.release()
         log.info("operator stopped")
 
     async def run_forever(self) -> None:

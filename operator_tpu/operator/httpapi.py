@@ -60,6 +60,7 @@ _KINDS: dict[str, tuple[str, str, bool]] = {
     "Event": ("/apis/events.k8s.io/v1", "events", True),
     "ReplicaSet": ("/apis/apps/v1", "replicasets", True),
     "Deployment": ("/apis/apps/v1", "deployments", True),
+    "Lease": ("/apis/coordination.k8s.io/v1", "leases", True),
     "Podmortem": ("/apis/podmortem.tpu.dev/v1alpha1", "podmortems", True),
     "AIProvider": ("/apis/podmortem.tpu.dev/v1alpha1", "aiproviders", True),
     "PatternLibrary": ("/apis/podmortem.tpu.dev/v1alpha1", "patternlibraries", True),

@@ -15,7 +15,13 @@ depends on:
   server-side close (so watcher auto-restart logic is testable —
   reference PodFailureWatcher.java:127-135);
 - **label-selector list filtering** (reference PodmortemReconciler.java:105-111);
-- **error injection hooks** for 409 storms, 403s, and transient faults.
+- **error injection hooks** for 409 storms, 403s, and transient faults —
+  filterable by kind, so chaos tests can partition the leader away from its
+  ``coordination.k8s.io/Lease`` (operator/lease.py) while the rest of its
+  API traffic flows (Lease CRUD itself rides the generic kind-keyed store:
+  create/get/patch with resourceVersion guards behave exactly like the real
+  apiserver's optimistic concurrency, which is what leader takeover races
+  are decided by).
 """
 
 from __future__ import annotations
@@ -253,12 +259,24 @@ class FakeKubeApi(KubeApi):
         self.fault_plan = None
 
     # --- error injection --------------------------------------------------
-    def inject_errors(self, op: str, error_factory: Callable[[], Exception], times: int = 1) -> None:
+    def inject_errors(
+        self,
+        op: str,
+        error_factory: Callable[[], Exception],
+        times: int = 1,
+        *,
+        kind: Optional[str] = None,
+    ) -> None:
         """Raise ``error_factory()`` for the next ``times`` calls of ``op``
-        (op is 'get'/'list'/'create'/'patch'/'patch_status'/'delete'/'get_log')."""
+        (op is 'get'/'list'/'create'/'patch'/'patch_status'/'delete'/'get_log').
+        ``kind`` narrows the fault to one object kind — e.g. partitioning a
+        leader away from its Lease (``kind="Lease"``) without touching its
+        Pod/Podmortem traffic (tests/test_leader.py)."""
         remaining = {"n": times}
 
-        def hook(actual_op: str, kind: str, name: str) -> Optional[Exception]:
+        def hook(actual_op: str, actual_kind: str, name: str) -> Optional[Exception]:
+            if kind is not None and actual_kind != kind:
+                return None
             if actual_op == op and remaining["n"] > 0:
                 remaining["n"] -= 1
                 return error_factory()

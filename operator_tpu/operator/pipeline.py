@@ -40,6 +40,7 @@ from ..schema.meta import now_iso
 from ..utils.config import OperatorConfig
 from ..utils.deadline import Deadline
 from ..utils.timing import METRICS, MetricsRegistry
+from .claims import ClaimLedger, ClaimRecord
 from .events import EventService
 from .kubeapi import ApiError, KubeApi, NotFoundError
 from .providers import (
@@ -55,42 +56,6 @@ from .storage import AnalysisStorageService
 log = logging.getLogger(__name__)
 
 
-class FailureDedupe:
-    """Shared dedupe of (pod, failureTime) across the watcher and the
-    poll-path reconciler — one analysis per distinct failure, like the
-    reference's ``processedFailures`` map (PodFailureWatcher.java:50,180-193)
-    but (a) shared by both detection paths, (b) bounded, and (c) aware of
-    in-flight vs done so a *failed* analysis can be retried."""
-
-    _IN_FLIGHT = "in-flight"
-    _DONE = "done"
-
-    def __init__(self, max_entries: int = 10_000) -> None:
-        from collections import OrderedDict
-
-        self._states: "OrderedDict[str, str]" = OrderedDict()
-        self._max = max_entries
-
-    @staticmethod
-    def key(pod: Pod, failure_time: str) -> str:
-        return f"{pod.metadata.namespace}/{pod.metadata.name}@{failure_time}"
-
-    def try_claim(self, key: str) -> bool:
-        """Claim the failure for processing; False if already in flight or done."""
-        if key in self._states:
-            self._states.move_to_end(key)
-            return False
-        self._states[key] = self._IN_FLIGHT
-        while len(self._states) > self._max:
-            self._states.popitem(last=False)
-        return True
-
-    def mark_done(self, key: str) -> None:
-        self._states[key] = self._DONE
-
-    def release(self, key: str) -> None:
-        """Forget a failed attempt so either path may retry it."""
-        self._states.pop(key, None)
 
 
 class AnalysisPipeline:
@@ -107,6 +72,7 @@ class AnalysisPipeline:
         clock: Optional[Callable[[], float]] = None,
         memory: Optional[IncidentMemory] = None,
         tracer: Optional[Tracer] = None,
+        claims: Optional[ClaimLedger] = None,
     ) -> None:
         self.api = api
         self.engine = engine
@@ -116,7 +82,19 @@ class AnalysisPipeline:
         self.providers = providers or default_registry()
         self.metrics = metrics or METRICS
         self.cache = ResponseCache()
-        self.dedupe = FailureDedupe()
+        # the claim map shared by the watcher and the poll-path reconciler —
+        # one analysis per distinct (pod, failureTime), like the reference's
+        # ``processedFailures`` map (PodFailureWatcher.java:50,180-193) but
+        # (a) shared by both detection paths, (b) bounded, (c) retry-aware,
+        # and (d) DURABLE when config.claims_path is set: claims journal to
+        # a crash-safe ledger, and a restarted (or newly elected,
+        # operator/lease.py) operator resumes non-terminal analyses with
+        # their remaining deadline budget (resume_pending).  Injectable so
+        # chaos tests drive the wall clock (tests/test_leader.py).
+        self.claims = claims if claims is not None else ClaimLedger(
+            self.config.claims_path,
+            max_entries=self.config.claims_max_entries,
+        )
         # incident memory (docs/MEMORY.md): recall across failures so a
         # recurring class pays the TPU decode once, not once per pod.
         # Injectable; the default honours config.memory_enabled.
@@ -140,19 +118,23 @@ class AnalysisPipeline:
             clock=self._clock,
         )
 
-    def _deadline_for(self, podmortem: Podmortem) -> Deadline:
-        """One CR's analysis envelope: spec.analysisDeadline when set, else
-        the operator default (the reference's 180 s LLM budget).  PER CR —
-        a fan-out group's first analysis legitimately spending its whole
-        envelope must not starve the remaining CRs down to zero-budget
-        no-result runs."""
+    def _deadline_total_for(self, podmortem: Podmortem) -> float:
+        """One CR's full envelope in seconds: spec.analysisDeadline when
+        set, else the operator default (the reference's 180 s LLM budget)."""
         total_s = self.config.analysis_deadline_s
         if podmortem.spec.analysis_deadline:
             total_s = float(parse_refresh_interval(
                 podmortem.spec.analysis_deadline,
                 default_seconds=int(self.config.analysis_deadline_s),
             ))
-        return Deadline.start(total_s, clock=self._clock)
+        return total_s
+
+    def _deadline_for(self, podmortem: Podmortem) -> Deadline:
+        """One CR's analysis envelope, born NOW.  PER CR — a fan-out
+        group's first analysis legitimately spending its whole envelope
+        must not starve the remaining CRs down to zero-budget no-result
+        runs."""
+        return Deadline.start(self._deadline_total_for(podmortem), clock=self._clock)
 
     # ------------------------------------------------------------------
     async def process_failure_group(
@@ -166,16 +148,29 @@ class AnalysisPipeline:
         CR (reference fans out per CR, PodFailureWatcher.java:196-199).
         Returns [] if the failure was already claimed.  A fully failed group
         releases the claim so the other detection path can retry it."""
-        key = FailureDedupe.key(pod, failure_time)
-        if not self.dedupe.try_claim(key):
+        key = ClaimLedger.key(pod, failure_time)
+        # the claim record carries everything a SUCCESSOR process needs to
+        # resume this analysis if we die mid-flight: pod coordinates, the
+        # matched CR refs, and the largest per-CR envelope (resume clamps
+        # each CR to what is left of it)
+        if not self.claims.try_claim(
+            key,
+            pod_name=pod.metadata.name or "",
+            pod_namespace=pod.metadata.namespace or "",
+            failure_time=failure_time,
+            podmortems=[pm.qualified_name() for pm in podmortems],
+            deadline_total_s=max(
+                (self._deadline_total_for(pm) for pm in podmortems), default=0.0
+            ),
+        ):
             return []
-        # durable dedupe: the in-memory map dies with the process, but the
-        # analyzed-failure annotation is in etcd — a restarted operator (or
-        # the pre-watch sweep) must not re-analyze an annotated failure
+        # durable dedupe: the claim ledger may be fresh (or in-memory), but
+        # the analyzed-failure annotation is in etcd — a restarted operator
+        # (or the pre-watch sweep) must not re-analyze an annotated failure
         from .storage import ANNOTATION_ANALYZED_FAILURE
 
         if pod.metadata.annotations.get(ANNOTATION_ANALYZED_FAILURE) == failure_time:
-            self.dedupe.mark_done(key)
+            self.claims.mark_done(key)
             self.metrics.incr("dedupe_durable_hits")
             return []
         # each CR's deadline budget is BORN when its analysis starts under
@@ -185,6 +180,7 @@ class AnalysisPipeline:
         try:
             results = []
             for podmortem in podmortems:
+                self.claims.note_stage(key, f"analyze:{podmortem.qualified_name()}")
                 results.append(
                     await self.process_pod_failure(
                         pod, podmortem, failure_time=failure_time,
@@ -192,13 +188,131 @@ class AnalysisPipeline:
                     )
                 )
         except BaseException:
-            self.dedupe.release(key)
+            self.claims.release(key)
             raise
         if any(result is not None for result in results):
-            self.dedupe.mark_done(key)
+            self.claims.mark_done(key)
         else:
-            self.dedupe.release(key)
+            self.claims.release(key)
         return results
+
+    # ------------------------------------------------------------------
+    async def resume_pending(self) -> int:
+        """Crash-resume: re-run every non-terminal claim a previous process
+        — or the previous LEADER, on lease takeover — left in the ledger.
+        Each analysis restarts with the claim's REMAINING wall-clock budget
+        (a claim 50 s into a 180 s envelope resumes with ~130 s).  Status
+        patches are idempotent (operator/storage.py), so a claim that died
+        after storing still converges to exactly one recentFailures entry.
+        Returns the number of claims actually resumed."""
+        # a warm standby's ledger was read at ITS boot: re-read the shared
+        # journal NOW so takeover sees the dead leader's claims (and a
+        # fresh append handle, in case the leader compacted the file)
+        self.claims.reload()
+
+        async def _one(claim: ClaimRecord) -> int:
+            try:
+                return await self._resume_claim(claim)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - one bad claim must not block the rest
+                log.exception("claim resume failed for %s; releasing", claim.key)
+                self.claims.release(claim.key)
+                return 0
+
+        # concurrent: the watcher does not start until resume returns, so
+        # several pending claims resumed serially would leave the cluster
+        # unwatched for the SUM of their budgets; gather bounds the blind
+        # window to the slowest single claim
+        return sum(
+            await asyncio.gather(*(_one(c) for c in self.claims.take_pending()))
+        )
+
+    async def _resume_claim(self, claim: ClaimRecord) -> int:
+        from .storage import ANNOTATION_ANALYZED_FAILURE
+
+        try:
+            raw = await asyncio.wait_for(
+                self.api.get("Pod", claim.pod_name, claim.pod_namespace),
+                timeout=self.config.kube_call_timeout_s,
+            )
+        except NotFoundError:
+            self.claims.mark_done(claim.key)  # the pod is gone; nothing to analyze
+            return 0
+        except (ApiError, asyncio.TimeoutError):
+            # transient: release so the sweep/reconciler can re-claim later
+            self.claims.release(claim.key)
+            return 0
+        pod = Pod.parse(raw)
+        if pod.metadata.annotations.get(ANNOTATION_ANALYZED_FAILURE) == claim.failure_time:
+            # the previous process finished storing before it died
+            self.claims.mark_done(claim.key)
+            self.metrics.incr("dedupe_durable_hits")
+            return 0
+        podmortems: list[Podmortem] = []
+        for ref in claim.podmortems:
+            namespace, _, name = ref.partition("/")
+            try:
+                pm_raw = await asyncio.wait_for(
+                    self.api.get("Podmortem", name, namespace),
+                    timeout=self.config.kube_call_timeout_s,
+                )
+            except NotFoundError:
+                continue  # CR deleted since the claim: skip it
+            except (ApiError, asyncio.TimeoutError):
+                # transient apiserver trouble (likely: the takeover window
+                # IS an apiserver-degraded window) must not read as "CR
+                # deleted" — that path marks the claim done and drops the
+                # analysis forever.  Release so a later resume/sweep retries.
+                self.claims.release(claim.key)
+                return 0
+            podmortems.append(Podmortem.parse(pm_raw))
+        if not podmortems:
+            self.claims.mark_done(claim.key)
+            return 0
+        self.metrics.incr("claims_resumed")
+        log.info(
+            "resuming claim %s (stage %r, %.1fs of %.1fs budget left)",
+            claim.key, claim.stage,
+            self.claims.remaining_budget_s(claim), claim.deadline_total_s,
+        )
+        # which CR was mid-flight when the process died (the stage marker):
+        # refs at or before it consumed the claim's envelope and resume with
+        # the wall-clock REMAINDER; refs after it never started, so they get
+        # their own fresh envelope — exactly what the live path would have
+        # handed them (its own design note: a shared group envelope would
+        # hand later CRs whatever the first one left, possibly nothing)
+        staged_ref = claim.stage.partition(":")[2]
+        refs = claim.podmortems
+        staged_idx = refs.index(staged_ref) if staged_ref in refs else len(refs)
+        try:
+            results = []
+            for podmortem in podmortems:
+                ref = podmortem.qualified_name()
+                self.claims.note_stage(claim.key, f"resume:{ref}")
+                if ref in refs and refs.index(ref) > staged_idx:
+                    budget_s = self._deadline_total_for(podmortem)
+                else:
+                    # the resumed envelope is the smaller of the CR's own
+                    # budget and what wall-clock says is left of the claim
+                    budget_s = min(
+                        self._deadline_total_for(podmortem),
+                        self.claims.remaining_budget_s(claim),
+                    )
+                results.append(
+                    await self.process_pod_failure(
+                        pod, podmortem, failure_time=claim.failure_time,
+                        deadline=Deadline.start(budget_s, clock=self._clock),
+                    )
+                )
+        except BaseException:
+            self.claims.release(claim.key)
+            raise
+        if any(result is not None for result in results):
+            self.claims.mark_done(claim.key)
+        else:
+            self.claims.release(claim.key)
+        return 1
 
     # ------------------------------------------------------------------
     async def process_pod_failure(

@@ -37,11 +37,11 @@ ANNOTATION_ANALYSIS = "podmortem.io/analysis"
 ANNOTATION_SEVERITY = "podmortem.io/severity"
 ANNOTATION_ANALYZED_AT = "podmortem.io/analyzed-at"
 #: which failure (finishedAt) the stored analysis covers — the DURABLE
-#: dedupe marker: an operator restart loses the in-memory FailureDedupe map,
-#: and this annotation is what stops the restarted watcher/reconciler from
-#: re-analyzing a failure already annotated in etcd (the reference accepts
-#: re-analysis after restart by design, AnalysisStorageService.java:42-46;
-#: we do one better)
+#: dedupe marker: even when the claim ledger (operator/claims.py) is
+#: in-memory or freshly rotated, this annotation in etcd stops a restarted
+#: watcher/reconciler from re-analyzing an already-annotated failure (the
+#: reference accepts re-analysis after restart by design,
+#: AnalysisStorageService.java:42-46; we do one better)
 ANNOTATION_ANALYZED_FAILURE = "podmortem.io/analyzed-failure"
 ANNOTATION_MONITOR = "podmortem.io/monitor"
 
@@ -210,7 +210,34 @@ class AnalysisStorageService:
             )
             rv = latest.get("metadata", {}).get("resourceVersion")
             status = latest.get("status") or {}
-            failures = [to_dict(entry)] + list(status.get("recentFailures") or [])
+            existing = list(status.get("recentFailures") or [])
+            # IDEMPOTENT store: at-least-once execution (crash-resume,
+            # operator/claims.py — a claim that died after storing replays)
+            # must yield exactly-once status entries.  Identity is
+            # (pod, failureTime) — the same triple that keys the claim.
+            payload = to_dict(entry)
+            duplicate_index: Optional[int] = None
+            for i, prior in enumerate(existing):
+                if (
+                    prior.get("podName") == entry.pod_name
+                    and prior.get("podNamespace") == entry.pod_namespace
+                    and prior.get("failureTime") == entry.failure_time
+                ):
+                    duplicate_index = i
+                    break
+            if duplicate_index is not None:
+                prior = existing[duplicate_index]
+                if prior.get("traceId") and prior.get("traceId") == entry.trace_id:
+                    # the SAME analysis already landed (a retried patch whose
+                    # first attempt actually succeeded): nothing to write
+                    return True
+                # a resumed analysis supersedes the partial entry in place —
+                # replace, never append, so the ring holds one entry per
+                # failure no matter how many times the claim replays
+                existing[duplicate_index] = payload
+                failures = existing
+            else:
+                failures = [payload] + existing
             failures = failures[: self.config.max_recent_failures]  # ring of 10
             status.update(
                 {

@@ -204,7 +204,7 @@ class PodFailureWatcher:
         self.config = config or OperatorConfig()
         self.metrics = metrics or METRICS
         self.cache = cache or PodmortemCache(api)
-        # dedupe is shared with the reconciler via pipeline.dedupe; this map
+        # claims are shared with the reconciler via pipeline.claims; this map
         # only cheap-filters repeat MODIFIED events for an already-claimed
         # failure so we don't spawn no-op tasks per kubelet status update
         self._seen: OrderedDict[str, str] = OrderedDict()
@@ -373,3 +373,11 @@ class PodFailureWatcher:
         """Wait for in-flight pipelines (tests/shutdown)."""
         if self._tasks:
             await asyncio.gather(*self._tasks, return_exceptions=True)
+
+    def cancel_inflight(self) -> None:
+        """Cancel in-flight pipelines — the shutdown-grace boundary
+        (operator/app.py stop): a cancelled analysis RELEASES its claim in
+        the ledger, so the successor's sweep/reconciler may claim the
+        failure afresh instead of it being lost."""
+        for task in self._tasks:
+            task.cancel()

@@ -212,7 +212,9 @@ def _flash_prefill_pallas(
         q_block=q_block, kv_block=kv_block, g=g, scale=scale,
         window=sliding_window,
     )
-    any_space = pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY)
+    # jax renamed TPUMemorySpace -> MemorySpace around 0.4.38; accept both
+    memory_space = getattr(pltpu, "MemorySpace", None) or pltpu.TPUMemorySpace
+    any_space = pl.BlockSpec(memory_space=memory_space.ANY)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, kh, t // q_block),

@@ -116,9 +116,17 @@ def _best_window_kernel(
     scores = jnp.where(valid, scores, -jnp.inf)
 
     block_max = jnp.max(scores, axis=0, keepdims=True)  # [1, P_pad]
-    block_arg = (
-        jnp.argmax(scores, axis=0).astype(jnp.int32)[None, :] + step * block_w
-    )
+    # manual argmax: Mosaic lowers neither argmax nor integer reductions
+    # (this jax version fails AOT on both) — take the SMALLEST row index
+    # achieving the max (jnp.argmax's first-match tie-breaking), with the
+    # min computed in f32.  Exact while window indices stay below 2^24
+    # (~16.7M windows; a 1 GiB log at 256-byte stride is ~4M).
+    is_max = scores == block_max  # [BLOCK_W, P_pad] vs broadcast [1, P_pad]
+    block_arg = jnp.min(
+        jnp.where(is_max, row.astype(jnp.float32), jnp.inf),
+        axis=0,
+        keepdims=True,
+    ).astype(jnp.int32)
 
     better = block_max > max_scratch[...]
     idx_scratch[...] = jnp.where(better, block_arg, idx_scratch[...])

@@ -44,6 +44,8 @@ from .kube import (
     ContainerStatus,
     Deployment,
     Event,
+    Lease,
+    LeaseSpec,
     ObjectReference,
     Pod,
     PodSpec,

@@ -141,6 +141,32 @@ class Secret(K8sObject):
 
 
 @dataclass
+class LeaseSpec:
+    """coordination.k8s.io/v1 LeaseSpec — the fields leader election
+    reads/writes (operator/lease.py)."""
+
+    holder_identity: Optional[str] = None
+    lease_duration_seconds: Optional[int] = None
+    acquire_time: Optional[str] = None  # RFC3339 MicroTime
+    renew_time: Optional[str] = None  # RFC3339 MicroTime
+    lease_transitions: Optional[int] = None
+
+
+@dataclass
+class Lease(K8sObject):
+    """The leader-election lock object: whoever is in
+    ``spec.holderIdentity`` with a fresh ``renewTime`` runs the control
+    plane; everyone else is a hot standby."""
+
+    spec: LeaseSpec = field(default_factory=LeaseSpec)
+
+    def __post_init__(self) -> None:
+        super().__post_init__()
+        self.api_version = self.api_version or "coordination.k8s.io/v1"
+        self.kind = self.kind or "Lease"
+
+
+@dataclass
 class ReplicaSet(K8sObject):
     def __post_init__(self) -> None:
         super().__post_init__()

@@ -49,6 +49,7 @@ import itertools
 import logging
 import threading
 import time
+from dataclasses import dataclass
 from typing import Any, Optional, Sequence
 
 import numpy as np
@@ -75,6 +76,52 @@ from .types import (  # noqa: F401
 )
 
 log = logging.getLogger(__name__)
+
+
+class EngineStalled(RuntimeError):
+    """The decode loop made no step progress within the supervisor's stall
+    budget — the device (or its runtime) is wedged, not merely slow."""
+
+
+@dataclass
+class SupervisorPolicy:
+    """Watchdog policy for the serving engine (docs/ROBUSTNESS.md).
+
+    With a policy installed, a decode step exceeding ``stall_timeout_s`` —
+    or a serve-loop death — triggers a supervised restart: the engine
+    resets its device state, audits slot/page leaks, dumps a black-box
+    flight-recorder record, and requeues in-flight requests up to
+    ``max_requeues`` times with their residual deadlines (the deadline is
+    an absolute instant, so queue time already spent stays spent).
+    Without one (the default), the engine keeps the pre-supervisor
+    semantics: loop death fails every in-flight future and recovery is
+    lazy (``_try_recover`` on the next generate).
+    """
+
+    #: a step may legitimately hide a multi-second in-band XLA compile
+    #: (novel bucket): only a genuinely wedged device should trip this.
+    #: Must match OperatorConfig.supervisor_stall_s (the config-driven
+    #: production default) so direct constructions behave identically
+    stall_timeout_s: float = 120.0
+    #: how long to wait for an abandoned (stalled) decode thread to return
+    #: before resetting device state under it anyway
+    join_grace_s: float = 10.0
+    #: each request is re-admitted at most this many times; beyond it the
+    #: supervisor gives up and fails the caller
+    max_requeues: int = 1
+
+
+@dataclass
+class _Request:
+    """One queued/admitted generation request — kept whole (prompt +
+    params + priority) so the supervisor can re-admit it after an engine
+    restart; the bare future the queue used to carry cannot be requeued."""
+
+    prompt: str
+    params: "SamplingParams"
+    future: asyncio.Future
+    priority: int = 0
+    requeues: int = 0
 
 
 class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
@@ -1237,11 +1284,24 @@ class ServingEngine:
         *,
         admission_wait_s: float = 0.004,
         max_queue: int = 1024,
+        supervisor: Optional[SupervisorPolicy] = None,
+        recorder: Optional[Any] = None,  # obs.FlightRecorder for black boxes
     ) -> None:
         import concurrent.futures
 
         self.generator = generator
         self.admission_wait_s = admission_wait_s
+        #: watchdog policy (None = pre-supervisor semantics: loop death
+        #: fails in-flight futures, stalls hang until the step returns)
+        self._supervisor = supervisor
+        self.recorder = recorder
+        self._supervise_task: Optional[asyncio.Task] = None
+        self._supervise_wakeup = asyncio.Event()
+        self._stalled = False  # last loop death was a stall (executor abandoned)
+        self._gave_up = False  # supervisor exhausted its reset budget
+        # survivors collected by a restart in progress: close() must still
+        # fail these futures if it interrupts the supervisor mid-recovery
+        self._restarting: list[_Request] = []
         # one persistent worker: no per-step thread handoff through the
         # shared default executor (contextvars copy + pool contention), and
         # all jax dispatch happens from a single consistent thread
@@ -1260,8 +1320,8 @@ class ServingEngine:
         self._queue: asyncio.PriorityQueue = asyncio.PriorityQueue()
         self._low_lane = asyncio.Semaphore(max_queue)
         self._seq = itertools.count()
-        self._pending: dict[int, asyncio.Future] = {}  # slot id -> future
-        self._inflight: list = []  # popped from queue, not yet in _pending
+        self._pending: dict[int, _Request] = {}  # slot id -> admitted request
+        self._inflight: list[_Request] = []  # popped from queue, not yet admitted
         # streaming: future -> on_partial registered in generate(); slot ->
         # on_partial once admitted.  The generator's hook fires on the
         # decode worker; call_soon_threadsafe marshals it onto the loop.
@@ -1280,12 +1340,14 @@ class ServingEngine:
         self._reset_times: list[float] = []
         self._reset_lock = asyncio.Lock()
 
-    def _unwrap(self, item: tuple) -> tuple:
-        """Pop bookkeeping for a queue entry: low-lane slots free on pop."""
-        neg_priority, _, entry = item
+    def _unwrap(self, item: tuple) -> "_Request":
+        """Pop bookkeeping for a queue entry: low-lane slots free on pop.
+        Supervisor requeues re-enter at priority >= 1 (never through the
+        lane), so the release here stays balanced."""
+        neg_priority, _, request = item
         if neg_priority >= 0:  # priority <= 0 went through the bounded lane
             self._low_lane.release()
-        return entry
+        return request
 
     def _page_stalled(self, batch: list) -> bool:
         """True when a backpressured batch has no new pages to retry with —
@@ -1342,6 +1404,238 @@ class ServingEngine:
             self._error = None
             self._task = None  # the caller's generate() starts a fresh loop
 
+    # ------------------------------------------------------------------
+    # supervisor (SupervisorPolicy; docs/ROBUSTNESS.md)
+    # ------------------------------------------------------------------
+    async def _supervise(self) -> None:
+        """Watchdog task: woken by a serve-loop death (error or stall),
+        performs the supervised restart.  Runs for the engine's lifetime so
+        recovery is PROACTIVE — in-flight work is requeued immediately, not
+        lazily when the next caller happens to notice."""
+        while not self._closed:
+            await self._supervise_wakeup.wait()
+            self._supervise_wakeup.clear()
+            if self._closed:
+                return
+            if self._error is None:
+                continue
+            try:
+                await self._supervised_restart()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - the watchdog must outlive one bad restart
+                log.exception("supervised engine restart itself failed")
+
+    def _audit_leaks(self) -> dict:
+        """Post-reset invariant check: every slot free, every non-prefix
+        page back in the pool.  A non-empty result means reset() has a
+        reclamation bug — surfaced as podmortem_supervisor_leak_total and
+        in the black-box dump rather than silently shrinking capacity."""
+        generator = self.generator
+        leaks: dict = {}
+        free = len(generator.free_slots())
+        if free != generator.max_slots:
+            leaks["slots"] = generator.max_slots - free
+        allocator = getattr(generator, "allocator", None)
+        if allocator is not None:
+            expected = allocator.num_pages - 1 - generator.prefix_held_pages
+            if allocator.available != expected:
+                leaks["pages"] = expected - allocator.available
+        return leaks
+
+    def _dump_blackbox(self, reason: str, extra: dict) -> None:
+        """Black-box flight-recorder dump for a supervisor event — a
+        synthetic one-span trace (there is no ambient analysis trace on
+        the engine's own watchdog) carrying the restart context."""
+        recorder = self.recorder
+        if recorder is None:
+            try:
+                from ..obs import RECORDER as recorder
+            except Exception:  # noqa: BLE001 - forensics must never block recovery
+                return
+        try:
+            from ..obs import Tracer
+
+            tracer = Tracer(recorder=recorder)
+            with tracer.trace(
+                "engine.supervisor", attributes={"reason": reason}
+            ) as root:
+                pass
+            recorder.black_box(root.trace_id, reason, extra)
+        except Exception:  # noqa: BLE001 - forensics must never block recovery
+            log.warning("supervisor black-box dump failed", exc_info=True)
+
+    def _collect_survivors(self) -> "tuple[list[_Request], int]":
+        """Gather every in-flight request (admitted, in hand, queued) for
+        requeueing; requests already requeued ``max_requeues`` times are
+        failed now.  Returns (requeue list, gaveup count)."""
+        assert self._supervisor is not None
+        requests: list[_Request] = []
+        for slot_id, request in self._pending.items():
+            callback = self._partial_cbs.get(slot_id)
+            if callback is not None:
+                # re-arm streaming: the old slot id dies with the engine
+                # state, the re-admitted request gets a fresh one
+                self._partial_by_future[request.future] = callback[0]
+            requests.append(request)
+        self._pending.clear()
+        self._partial_cbs.clear()
+        requests.extend(self._inflight)
+        self._inflight.clear()
+        while not self._queue.empty():
+            requests.append(self._unwrap(self._queue.get_nowait()))
+        retry: list[_Request] = []
+        gaveup = 0
+        for request in requests:
+            if request.future.done():
+                self._partial_by_future.pop(request.future, None)
+            elif request.requeues >= self._supervisor.max_requeues:
+                self._partial_by_future.pop(request.future, None)
+                failure = RuntimeError(
+                    "request failed after a supervised engine restart "
+                    f"(requeued {request.requeues}x)"
+                )
+                failure.__cause__ = self._error
+                request.future.set_exception(failure)
+                self.generator.metrics.incr("supervisor_gaveup")
+                gaveup += 1
+            else:
+                retry.append(request)
+        return retry, gaveup
+
+    def _fail_survivors(self, retry: "list[_Request]", why: str) -> int:
+        failed = 0
+        for request in retry:
+            if request.future.done():
+                continue
+            self._partial_by_future.pop(request.future, None)
+            failure = RuntimeError(why)
+            failure.__cause__ = self._error
+            request.future.set_exception(failure)
+            self.generator.metrics.incr("supervisor_gaveup")
+            failed += 1
+        return failed
+
+    def _give_up_restart(
+        self, retry: "list[_Request]", gaveup: int, *,
+        reason: str, cause: str, message: str, outcome: str,
+    ) -> None:
+        """Terminal exit of a supervised restart: fail the survivors, mark
+        the engine given-up, drain stragglers that enqueued DURING the
+        restart (after survivor collection emptied the queue — no serve
+        loop is left to consume them), and leave a black-box dump."""
+        gaveup += self._fail_survivors(retry, message)
+        self._restarting = []
+        self._gave_up = True
+        self._fail_outstanding(RuntimeError(message))
+        self._dump_blackbox(reason, {
+            "cause": cause, "gaveup": gaveup, "requeued": 0,
+            "outcome": outcome,
+        })
+
+    async def _supervised_restart(self) -> None:
+        """The supervisor's recovery sequence: collect survivors, retire a
+        stalled decode thread, reset device state (bounded resets per
+        window — a persistent fault must surface, not thrash), audit
+        slot/page leaks, restart the loop, requeue survivors once with
+        their residual deadlines, and leave a black-box dump behind."""
+        policy = self._supervisor
+        assert policy is not None
+        loop = asyncio.get_running_loop()
+        stalled = self._stalled
+        reason = "engine-stall" if stalled else "engine-error"
+        cause = str(self._error)
+        retry, gaveup = self._collect_survivors()
+        # parked here until requeued/failed: if close() interrupts this
+        # restart, _fail_outstanding still reaches these futures
+        self._restarting = retry
+        if stalled:
+            # the wedged worker thread cannot be interrupted: ABANDON its
+            # executor and give the orphan a bounded grace to come back —
+            # in the common case (a transient runtime hiccup) it returns
+            # and the reset below runs with no concurrent mutator; in a
+            # true device hang we proceed under it after the grace (the
+            # reset rebuilds all decode state anyway)
+            import concurrent.futures
+            import threading
+
+            old = self._executor
+            self._executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="tpu-decode"
+            )
+            # a DEDICATED daemon thread performs the blocking join:
+            # parking old.shutdown(wait=True) on the shared default
+            # executor would permanently consume one of its threads every
+            # time the wedged decode thread never returns
+            joiner = threading.Thread(
+                target=lambda: old.shutdown(wait=True),
+                name="tpu-decode-reaper", daemon=True,
+            )
+            joiner.start()
+            await loop.run_in_executor(None, joiner.join, policy.join_grace_s)
+            if joiner.is_alive():
+                log.error(
+                    "stalled decode thread still wedged after %.1fs; "
+                    "resetting device state under it", policy.join_grace_s,
+                )
+            self._stalled = False
+        now = time.monotonic()
+        self._reset_times = [
+            t for t in self._reset_times if now - t < self.RESET_WINDOW_S
+        ]
+        if len(self._reset_times) >= self.MAX_RESETS_PER_WINDOW:
+            self._give_up_restart(
+                retry, gaveup, reason=reason, cause=cause,
+                message="serving engine down: supervisor reset budget exhausted",
+                outcome="reset-budget-exhausted",
+            )
+            log.error("engine supervisor giving up: %d resets within %.0fs",
+                      self.MAX_RESETS_PER_WINDOW, self.RESET_WINDOW_S)
+            return
+        self._reset_times.append(now)
+        try:
+            await loop.run_in_executor(self._executor, self.generator.reset)
+        except Exception as exc:  # noqa: BLE001 - rebuild failed: stay down
+            log.exception("supervised engine reset failed; staying down")
+            self._error = exc
+            self._give_up_restart(
+                retry, gaveup, reason=reason, cause=cause,
+                message="serving engine down: device-state reset failed",
+                outcome="reset-failed",
+            )
+            return
+        leaks = self._audit_leaks()
+        if leaks:
+            self.generator.metrics.incr("supervisor_leak")
+            log.error("post-reset leak audit failed: %s", leaks)
+        self._error = None
+        self._task = None
+        await self.start()
+        self._restarting = []
+        for request in retry:
+            request.requeues += 1
+            self.generator.metrics.incr("supervisor_requeue")
+            # requeues re-enter ABOVE the normal priority lanes (they were
+            # already admitted once) and outside the bounded low lane (its
+            # slot was released when the entry was first popped); their
+            # deadline is an absolute instant, so the residual budget
+            # carries through the restart automatically
+            await self._queue.put(
+                (-max(request.priority, 1), next(self._seq), request)
+            )
+        self.generator.metrics.incr("supervisor_restart")
+        self._dump_blackbox(reason, {
+            "cause": cause,
+            "requeued": len(retry),
+            "gaveup": gaveup,
+            "leaks": leaks,
+            "resets_in_window": len(self._reset_times),
+        })
+        log.warning(
+            "supervised engine restart (%s): %d requeued, %d failed, leaks=%s",
+            reason, len(retry), gaveup, leaks or "none",
+        )
+
     def _on_partial_from_worker(self, slot_id: int, token_ids: list) -> None:
         """Generator hook (decode worker thread) -> event-loop callback."""
         entry = self._partial_cbs.get(slot_id)
@@ -1356,16 +1650,38 @@ class ServingEngine:
         if self._task is None:
             self._loop = asyncio.get_running_loop()
             self._task = asyncio.create_task(self._run(), name="serving-engine")
+        if self._supervisor is not None and self._supervise_task is None:
+            self._supervise_task = asyncio.create_task(
+                self._supervise(), name="serving-supervisor"
+            )
 
     async def close(self) -> None:
         self._closed = True
+        # wake an idle watchdog so it observes _closed and exits.  A
+        # watchdog MID-RESTART is awaited (bounded) rather than cancelled:
+        # cancelling between survivor collection and the device-state
+        # reset would leave slots/pages allocated forever and the
+        # already-submitted reset racing this shutdown on the executor
+        self._supervise_wakeup.set()
+        supervise, self._supervise_task = self._supervise_task, None
+        if supervise is not None:
+            grace = 5.0 + (
+                self._supervisor.join_grace_s
+                if self._supervisor is not None else 0.0
+            )
+            try:
+                await asyncio.wait_for(supervise, timeout=grace)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                pass  # wedged restart: wait_for already cancelled it
+        # AFTER the watchdog settles — a restart in flight during the
+        # wait above re-creates self._task via start()
         if self._task is not None:
             self._task.cancel()
             try:
                 await self._task
             except asyncio.CancelledError:
                 pass
-            self._task = None
+        self._task = None
         self._fail_outstanding(asyncio.CancelledError("serving engine closed"))
         self._executor.shutdown(wait=False)
 
@@ -1373,18 +1689,22 @@ class ServingEngine:
         """Resolve every in-flight and queued future so callers never hang."""
         self._partial_cbs.clear()
         self._partial_by_future.clear()
-        for future in self._pending.values():
-            if not future.done():
-                future.set_exception(exc)
+        for request in self._restarting:  # supervisor interrupted mid-recovery
+            if not request.future.done():
+                request.future.set_exception(exc)
+        self._restarting = []
+        for request in self._pending.values():
+            if not request.future.done():
+                request.future.set_exception(exc)
         self._pending.clear()
-        for _, _, future in self._inflight:  # popped but not yet admitted
-            if not future.done():
-                future.set_exception(exc)
+        for request in self._inflight:  # popped but not yet admitted
+            if not request.future.done():
+                request.future.set_exception(exc)
         self._inflight.clear()
         while not self._queue.empty():
-            _, _, future = self._unwrap(self._queue.get_nowait())
-            if not future.done():
-                future.set_exception(exc)
+            request = self._unwrap(self._queue.get_nowait())
+            if not request.future.done():
+                request.future.set_exception(exc)
 
     async def precompile(self, level: str = "serving") -> dict:
         """Run the generator's program-grid precompile on the decode
@@ -1459,10 +1779,34 @@ class ServingEngine:
         and backpressured-in-hand requests are not preempted."""
         if self._closed:
             raise RuntimeError("serving engine is closed")
-        if self._error is not None:
-            await self._try_recover()
-        if self._error is not None:
-            raise RuntimeError("serving engine loop died") from self._error
+        if self._gave_up:
+            # the reset budget is a RATE limit, not a death sentence: once
+            # the window has drained, the next caller may revive the engine
+            # (the unsupervised path already recovers this way via lazy
+            # _try_recover).  Staying _gave_up forever with green probes
+            # would brick the AI leg until a human deletes the pod.
+            now = time.monotonic()
+            in_window = [
+                t for t in self._reset_times
+                if now - t < self.RESET_WINDOW_S
+            ]
+            if len(in_window) < self.MAX_RESETS_PER_WINDOW and not self._closed:
+                if self._error is not None:
+                    await self._try_recover()
+                if self._error is None:
+                    self._gave_up = False
+            if self._gave_up:
+                raise RuntimeError(
+                    "serving engine is down (supervisor reset budget exhausted)"
+                ) from self._error
+        if self._supervisor is None:
+            # unsupervised: lazy recovery on the next caller (pre-supervisor
+            # semantics).  Supervised engines restart proactively — a death
+            # observed here is mid-restart, and the queue survives it.
+            if self._error is not None:
+                await self._try_recover()
+            if self._error is not None:
+                raise RuntimeError("serving engine loop died") from self._error
         # reject unknown adapters at SUBMIT time: a bad name surfacing as a
         # ValueError inside the serve loop's admit would fail the whole
         # co-batched wave and kill the loop — one misconfigured AIProvider CR
@@ -1509,13 +1853,19 @@ class ServingEngine:
         with obs_span("engine.generate", priority=priority) as span_:
             if priority <= 0:
                 await self._low_lane.acquire()  # released when the entry is popped
-            await self._queue.put(
-                (-priority, next(self._seq), (prompt, params or SamplingParams(), future))
-            )
+            await self._queue.put((
+                -priority, next(self._seq),
+                _Request(prompt, params or SamplingParams(), future, priority),
+            ))
             # the put may have landed after close()/loop-death drained the
             # queue; _closed/_error were set before the drain, so re-checking
-            # here closes that window
-            if (self._closed or self._error is not None) and not future.done():
+            # here closes that window.  A supervised engine's queue SURVIVES
+            # a loop death (the supervisor requeues, new arrivals wait), so
+            # only _gave_up is terminal there.
+            dead = self._closed or self._gave_up or (
+                self._error is not None and self._supervisor is None
+            )
+            if dead and not future.done():
                 self._partial_by_future.pop(future, None)
                 future.set_exception(RuntimeError("serving engine is closed"))
             result = await future
@@ -1541,7 +1891,12 @@ class ServingEngine:
         except Exception as exc:  # generator/device failure: fail fast, loudly
             log.exception("serving engine loop died")
             self._error = exc
-            self._fail_outstanding(exc)
+            if self._supervisor is not None and not self._closed:
+                # keep the in-flight requests: the supervisor resets the
+                # engine and requeues them (once) instead of failing them
+                self._supervise_wakeup.set()
+            else:
+                self._fail_outstanding(exc)
 
     async def _serve(self) -> None:
         loop = asyncio.get_running_loop()
@@ -1578,19 +1933,20 @@ class ServingEngine:
                 # before any chip time was spent.
                 now = self.generator._clock()
                 live = []
-                for entry in batch:
-                    _, sampling, future = entry
+                for request in batch:
+                    future = request.future
                     if future.done():
                         self._partial_by_future.pop(future, None)
                         continue
-                    if sampling.deadline is not None and sampling.deadline <= now:
+                    deadline = request.params.deadline
+                    if deadline is not None and deadline <= now:
                         self._partial_by_future.pop(future, None)
                         self.generator.metrics.incr("admission_deadline_rejected")
                         future.set_exception(DeadlineExceeded(
                             "deadline expired while queued for admission"
                         ))
                         continue
-                    live.append(entry)
+                    live.append(request)
                 batch[:] = live
             if batch and not stalled:
                 admitted = await self._admit(batch)
@@ -1613,8 +1969,8 @@ class ServingEngine:
                 # timeouts): an abandoned request must not decode to
                 # max_tokens holding a slot and its KV pages
                 cancelled = [
-                    slot_id for slot_id, future in self._pending.items()
-                    if future.cancelled()
+                    slot_id for slot_id, request in self._pending.items()
+                    if request.future.cancelled()
                 ]
                 if cancelled:
                     freed = await loop.run_in_executor(
@@ -1629,45 +1985,86 @@ class ServingEngine:
                             self._pending.pop(slot_id, None)
                             self._partial_cbs.pop(slot_id, None)
             if self.generator.num_active:
-                finished = await loop.run_in_executor(
+                step_call = loop.run_in_executor(
                     self._executor, self.generator.step
                 )
+                if self._supervisor is not None:
+                    # stall watchdog: a step that outlives the budget means
+                    # the device (not the host) is wedged.  The worker
+                    # thread cannot be interrupted — it is ABANDONED (the
+                    # supervisor swaps executors) and the loop dies into
+                    # the supervised-restart path.
+                    try:
+                        finished = await asyncio.wait_for(
+                            step_call, self._supervisor.stall_timeout_s
+                        )
+                    except asyncio.TimeoutError:
+                        self._stalled = True
+                        raise EngineStalled(
+                            f"decode step made no progress in "
+                            f"{self._supervisor.stall_timeout_s:.1f}s"
+                        ) from None
+                else:
+                    finished = await step_call
                 for slot_id, result in finished:
                     self._partial_cbs.pop(slot_id, None)
-                    future = self._pending.pop(slot_id, None)
-                    if future is not None and not future.done():
-                        future.set_result(result)
+                    request = self._pending.pop(slot_id, None)
+                    if request is not None and not request.future.done():
+                        request.future.set_result(result)
             await asyncio.sleep(0)
 
-    async def _admit(self, batch) -> int:
+    async def _admit(self, batch: "list[_Request]") -> int:
         """Admit as much of ``batch`` as fits; returns the admitted count."""
-        prompts = [prompt for prompt, _, _ in batch]
-        params = [p for _, p, _ in batch]
+        prompts = [request.prompt for request in batch]
+        params = [request.params for request in batch]
         try:
-            slot_ids = await asyncio.get_running_loop().run_in_executor(
+            admit_call = asyncio.get_running_loop().run_in_executor(
                 self._executor, lambda: self.generator.admit(prompts, params)
             )
+            if self._supervisor is not None:
+                # the batched prefill is device work too — a wedge here is
+                # the same fault class the step watchdog guards, and the
+                # largest single dispatch; without a bound it would hang
+                # the serve loop (and every caller) forever
+                try:
+                    slot_ids = await asyncio.wait_for(
+                        admit_call, self._supervisor.stall_timeout_s
+                    )
+                except asyncio.TimeoutError:
+                    self._stalled = True
+                    raise EngineStalled(
+                        f"batched prefill made no progress in "
+                        f"{self._supervisor.stall_timeout_s:.1f}s"
+                    ) from None
+            else:
+                slot_ids = await admit_call
         except OversizedRequest as exc:
             # only the head request is impossible; fail it alone and let
             # the rest retry next round
-            _, _, future = batch[0]
+            future = batch[0].future
             self._partial_by_future.pop(future, None)
             if not future.done():
                 future.set_exception(exc)
             return 1
         except BaseException as exc:
+            if self._supervisor is not None and not isinstance(
+                exc, asyncio.CancelledError
+            ):
+                # leave the batch in _inflight: the loop death this raise
+                # becomes is supervised, and the restart requeues them
+                raise
             # the batch futures are out of the queue but not yet in
             # _pending — fail them here or their callers hang forever
-            for _, _, future in batch:
-                self._partial_by_future.pop(future, None)
-                if not future.done():
-                    future.set_exception(exc)
+            for request in batch:
+                self._partial_by_future.pop(request.future, None)
+                if not request.future.done():
+                    request.future.set_exception(exc)
             raise
-        for slot_id, (_, _, future) in zip(slot_ids, batch):
-            self._pending[slot_id] = future
-            callback = self._partial_by_future.pop(future, None)
+        for slot_id, request in zip(slot_ids, batch):
+            self._pending[slot_id] = request
+            callback = self._partial_by_future.pop(request.future, None)
             if callback is not None:
                 # future travels with the callback so the worker-side hook
                 # can drop deltas once the streaming client is gone
-                self._partial_cbs[slot_id] = (callback, future)
+                self._partial_cbs[slot_id] = (callback, request.future)
         return len(slot_ids)

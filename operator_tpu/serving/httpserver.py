@@ -158,6 +158,7 @@ class CompletionServer:
         embedding_model_id: str = "log-embedder",
         analysis_backend: Optional[Any] = None,  # .generate(AnalysisRequest)
         tracer: Optional[Any] = None,  # obs.Tracer for inbound traceparent
+        drain_grace_s: float = 30.0,  # OperatorConfig.serving_drain_grace_s
     ) -> None:
         self.engine = engine
         self.model_id = model_id
@@ -181,6 +182,13 @@ class CompletionServer:
         self.tracer = tracer
         self._server: Optional[asyncio.AbstractServer] = None
         self._started = time.time()
+        # graceful drain (docs/ROBUSTNESS.md): stop() closes the listener
+        # (no new connections), then waits for in-flight handlers — their
+        # active engine waves complete — up to this grace before returning
+        self.drain_grace_s = drain_grace_s
+        self._active_handlers = 0
+        self._drained = asyncio.Event()
+        self._drained.set()
 
     @property
     def bound_port(self) -> Optional[int]:
@@ -199,14 +207,49 @@ class CompletionServer:
         log.info("completion api listening on %s:%s", self.host, self.bound_port)
 
     async def stop(self) -> None:
+        """Graceful: stop ACCEPTING first, then let in-flight requests —
+        and the engine waves they are riding — complete within the drain
+        grace.  Requests still running at the boundary are abandoned to
+        the engine close that follows (operator/app.py stop ordering)."""
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+            try:
+                # 3.12.1+ wait_closed() ALSO waits for every connection
+                # handler — unbounded, a wedged streaming handler would
+                # hold shutdown here forever.  close() has already stopped
+                # the listener; the _drained wait below is the real
+                # (grace-bounded) drain, so bound this to a beat.
+                await asyncio.wait_for(self._server.wait_closed(), timeout=1.0)
+            except asyncio.TimeoutError:
+                pass
             self._server = None
+        if self._active_handlers:
+            try:
+                await asyncio.wait_for(
+                    self._drained.wait(), timeout=self.drain_grace_s
+                )
+            except asyncio.TimeoutError:
+                log.warning(
+                    "%d request(s) still in flight after the %.0fs drain "
+                    "grace; closing under them",
+                    self._active_handlers, self.drain_grace_s,
+                )
 
     # -- http plumbing ------------------------------------------------------
 
     async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        self._active_handlers += 1
+        self._drained.clear()
+        try:
+            await self._handle_inner(reader, writer)
+        finally:
+            self._active_handlers -= 1
+            if self._active_handlers == 0:
+                self._drained.set()
+
+    async def _handle_inner(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         status, payload = 500, {"error": {"message": "internal error"}}

@@ -37,6 +37,7 @@ from .engine import (
     DeadlineExceeded,
     SamplingParams,
     ServingEngine,
+    SupervisorPolicy,
 )
 from .prompts import build_prompt
 
@@ -382,7 +383,18 @@ def build_serving_engine(
         except Exception:  # noqa: BLE001 - an optimisation must never block startup
             log.warning("shared-prefix priming failed; serving without it",
                         exc_info=True)
-    return ServingEngine(generator), model_id
+    # supervised by default in production wiring (docs/ROBUSTNESS.md): a
+    # stalled or errored decode loop resets the engine and requeues
+    # in-flight requests once with their residual deadlines.  Direct
+    # ServingEngine(...) constructions (tests) keep the unsupervised
+    # pre-supervisor semantics unless they opt in.
+    supervisor = None
+    if config.engine_supervisor:
+        supervisor = SupervisorPolicy(
+            stall_timeout_s=config.supervisor_stall_s,
+            join_grace_s=config.supervisor_join_grace_s,
+        )
+    return ServingEngine(generator, supervisor=supervisor), model_id
 
 
 def build_tpu_native_provider(

@@ -61,6 +61,53 @@ class OperatorConfig:
     breaker_failure_threshold: int = 5
     breaker_reset_s: float = 30.0
 
+    # --- HA / survivable control plane (docs/ROBUSTNESS.md) ----------------
+    # lease-based leader election (operator/lease.py): watcher, reconcilers,
+    # pattern sync, and the pipeline run ONLY while this replica holds the
+    # coordination.k8s.io Lease; standbys keep probes + engine warm and take
+    # over (re-list + claim resume) when the leader's renewTime expires.
+    # Off by default so single-replica installs and tests are unchanged.
+    leader_election: bool = False
+    lease_name: str = "podmortem-tpu-operator"
+    lease_namespace: str = ""  # "" = the api's namespace (or "default")
+    lease_duration_s: float = 15.0
+    lease_renew_period_s: float = 5.0
+    lease_retry_period_s: float = 2.0
+    # this replica's holder identity; the deployment injects POD_NAME via
+    # the downward API, "" falls back to hostname-pid
+    pod_name: str = ""
+    # durable claim ledger (operator/claims.py): crash-safe JSONL of
+    # claim→stage→terminal transitions; a restarted (or newly elected)
+    # operator replays it and resumes non-terminal analyses with their
+    # REMAINING deadline budget.  None = in-memory only (the pre-HA
+    # dedupe semantics).  The shipped deployment points it at the
+    # pattern-cache PVC next to the incident journal.
+    claims_path: Optional[str] = None
+    claims_max_entries: int = 10_000
+    # graceful drain (SIGTERM): in-flight analyses get this long to finish
+    # (their own deadlines usually end them sooner); then tasks are
+    # cancelled, journals flushed, and the lease released
+    shutdown_grace_s: float = 30.0
+    # serving httpserver drain: after the listener closes, in-flight HTTP
+    # handlers (and the engine waves they ride) get this long to complete.
+    # Size it UNDER terminationGracePeriodSeconds minus the preStop sleep
+    # and shutdown_grace_s, or the HTTP drain can eat the whole SIGTERM
+    # budget before the analysis drain and journal flushes run
+    serving_drain_grace_s: float = 30.0
+
+    # --- serving-engine supervisor (serving/engine.py) ---------------------
+    # watchdog over the decode loop: a step making no progress within the
+    # stall budget — or a loop death — triggers an engine reset; in-flight
+    # requests are requeued ONCE with their residual deadline, then failed
+    # (podmortem_supervisor_{restart,requeue,gaveup}_total)
+    engine_supervisor: bool = True
+    # generous default: a step can legitimately hide a multi-second in-band
+    # XLA compile (novel bucket) — only a genuinely wedged device should trip
+    supervisor_stall_s: float = 120.0
+    # how long the supervisor waits for an abandoned (stalled) decode thread
+    # to come back before resetting device state under it anyway
+    supervisor_join_grace_s: float = 10.0
+
     # --- incident memory (operator_tpu/memory/, docs/MEMORY.md) -----------
     # recall across failures: exact fingerprint hit reuses the stored
     # analysis (AI leg skipped), near hit injects prior incidents into the

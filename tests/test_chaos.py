@@ -269,6 +269,140 @@ def test_engine_chaos_stall_and_device_error_no_leaks():
     assert plan.pending() == {}, plan.pending()
 
 
+def _supervised_engine(plan_metrics=None, **policy_kw):
+    """Tiny supervised engine over the CPU backend (None when jax is
+    missing — callers importorskip first)."""
+    import jax
+    import jax.numpy as jnp
+
+    from operator_tpu.models import TINY_TEST, init_params
+    from operator_tpu.models.tokenizer import ByteTokenizer
+    from operator_tpu.serving.engine import (
+        BatchedGenerator,
+        ServingEngine,
+        SupervisorPolicy,
+    )
+
+    metrics = plan_metrics or MetricsRegistry()
+    params = init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+    generator = BatchedGenerator(
+        params, TINY_TEST, ByteTokenizer(), max_slots=2, max_seq=128,
+        cache_dtype=jnp.float32, paged=True, page_size=16, decode_block=2,
+        metrics=metrics,
+    )
+    # start with a GENEROUS stall budget: the warmup request's first step
+    # legitimately hides the XLA compile, which must never read as a stall.
+    # Tests tighten policy.stall_timeout_s after warming.
+    defaults = dict(stall_timeout_s=60.0, join_grace_s=5.0)
+    defaults.update(policy_kw)
+    policy = SupervisorPolicy(**defaults)
+    engine = ServingEngine(
+        generator, admission_wait_s=0.002, supervisor=policy,
+    )
+    return engine, generator, metrics, policy
+
+
+def _assert_no_engine_leaks(generator):
+    assert len(generator.free_slots()) == generator.max_slots
+    assert generator.allocator.available == (
+        generator.allocator.num_pages - 1 - generator.prefix_held_pages
+    )
+
+
+def test_supervisor_recovers_stalled_engine_and_requeues():
+    """The engine-stall acceptance scenario: a decode step wedges past the
+    stall budget → the supervisor abandons the stuck worker thread, resets
+    the engine, REQUEUES the in-flight request (residual deadline intact),
+    and the request completes — zero slot/page leaks, restart/requeue
+    counters emitted, and a black-box dump recorded."""
+    pytest.importorskip("jax")
+    from operator_tpu.obs import FlightRecorder
+    from operator_tpu.serving.engine import SamplingParams
+
+    engine, generator, metrics, policy = _supervised_engine()
+    recorder = FlightRecorder(capacity=16, metrics=metrics)
+    engine.recorder = recorder
+
+    async def scenario():
+        await engine.start()
+        sampling = SamplingParams(max_tokens=12, temperature=0.0,
+                                  stop_on_eos=False)
+        # prewarm compiles the prefill/decode programs so the tightened
+        # stall budget below only ever races a wedged DEVICE, not a compile
+        await engine.generate("warm", SamplingParams(max_tokens=2,
+                                                     temperature=0.0,
+                                                     stop_on_eos=False))
+        policy.stall_timeout_s = 0.4
+        plan = FaultPlan(seed=5)
+        plan.rule("engine.step", [OK, sleep_(1.5)])  # 2nd step wedges >> 0.4s
+        generator.fault_plan = plan
+        result = await asyncio.wait_for(
+            engine.generate("stalled mid-decode then requeued", sampling), 30
+        )
+        generator.fault_plan = None
+        assert result.completion_tokens == 12
+        assert plan.pending() == {}, plan.pending()
+        await engine.close()
+
+    run(scenario())
+    _assert_no_engine_leaks(generator)
+    counters = metrics.snapshot()["counters"]
+    assert counters.get("supervisor_restart") == 1
+    assert counters.get("supervisor_requeue") == 1
+    assert not counters.get("supervisor_gaveup")
+    assert not counters.get("supervisor_leak")
+    # the restart left a pinned black-box record behind
+    dumps = [r for r in engine.recorder.traces() if r.blackbox]
+    assert len(dumps) == 1 and dumps[0].reason == "engine-stall"
+
+
+def test_supervisor_requeues_after_device_error_then_gives_up_when_persistent():
+    """A one-shot device error is absorbed (requeue → success); a
+    persistent one fails the caller after max_requeues with the gaveup
+    counter — never an unbounded retry storm."""
+    pytest.importorskip("jax")
+    from operator_tpu.serving.engine import SamplingParams
+
+    engine, generator, metrics, _policy = _supervised_engine()
+
+    async def scenario():
+        await engine.start()
+        sampling = SamplingParams(max_tokens=8, temperature=0.0,
+                                  stop_on_eos=False)
+        await engine.generate("warm", SamplingParams(max_tokens=2,
+                                                     temperature=0.0,
+                                                     stop_on_eos=False))
+        # one-shot fault: the in-flight request survives via requeue
+        plan = FaultPlan(seed=7)
+        plan.rule("engine.step", raise_(
+            lambda: RuntimeError("injected device error"), "device"))
+        generator.fault_plan = plan
+        result = await asyncio.wait_for(
+            engine.generate("survives one device error", sampling), 30
+        )
+        assert result.completion_tokens == 8
+        assert plan.pending() == {}
+
+        # persistent fault: requeue once, then give up loudly
+        plan2 = FaultPlan(seed=8)
+        plan2.rule("engine.step", times(20, raise_(
+            lambda: RuntimeError("injected device error"), "device")))
+        generator.fault_plan = plan2
+        with pytest.raises(RuntimeError, match="supervised engine restart"):
+            await asyncio.wait_for(
+                engine.generate("doomed under persistent fault", sampling), 30
+            )
+        generator.fault_plan = None
+        await engine.close()
+
+    run(scenario())
+    _assert_no_engine_leaks(generator)
+    counters = metrics.snapshot()["counters"]
+    assert counters.get("supervisor_requeue", 0) >= 2
+    assert counters.get("supervisor_gaveup") == 1
+    assert not counters.get("supervisor_leak")
+
+
 def test_git_clone_fails_twice_then_succeeds(tmp_path):
     """The declarative 'fail clone twice then succeed' plan drives the git
     sync seam: two Failed outcomes, then a clean sync of a real repo."""

@@ -29,6 +29,16 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+#: jaxlib builds without CPU collectives fail any cross-process psum with
+#: this message; the test is then unrunnable in the environment, not red
+_NO_CPU_COLLECTIVES = "Multiprocess computations aren't implemented on the CPU backend"
+
+
+def _skip_if_unsupported(output: str) -> None:
+    if _NO_CPU_COLLECTIVES in output:
+        pytest.skip("this jaxlib's CPU backend lacks multiprocess collectives")
+
+
 def test_two_process_dp_reduction():
     addr = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
@@ -54,6 +64,7 @@ def test_two_process_dp_reduction():
         for proc in procs:
             out, _ = proc.communicate(timeout=240)
             outputs.append(out)
+            _skip_if_unsupported(out)
             assert proc.returncode == 0, f"worker failed:\n{out}"
     finally:
         for proc in procs:
@@ -114,6 +125,7 @@ def test_two_process_sharded_decode_parity():
         for proc in procs:
             out, _ = proc.communicate(timeout=300)
             outputs.append(out)
+            _skip_if_unsupported(out)
             assert proc.returncode == 0, f"decode worker failed:\n{out}"
     finally:
         for proc in procs:

@@ -1,0 +1,626 @@
+"""Survivable control plane: lease-based leader election
+(operator/lease.py), the durable claim ledger (operator/claims.py), and the
+killed-leader takeover chaos scenario.
+
+The acceptance contract (ISSUE 5): SIGKILL the leader mid-analysis → the
+standby acquires the lease, re-lists, resumes the non-terminal analysis
+with its REMAINING deadline budget, and the cluster converges to exactly
+one status patch and one incident record — byte-identical across two
+seeded replays.
+"""
+
+import asyncio
+import json
+import random
+
+import pytest
+
+from operator_tpu.memory import IncidentMemory, IncidentStore
+from operator_tpu.operator.claims import ClaimLedger
+from operator_tpu.operator.kubeapi import ApiError, FakeKubeApi
+from operator_tpu.operator.lease import LeaseElector, parse_micro
+from operator_tpu.operator.pipeline import AnalysisPipeline
+from operator_tpu.operator.providers import default_registry
+from operator_tpu.operator.watcher import PodFailureWatcher, PodmortemCache
+from operator_tpu.patterns.engine import PatternEngine
+from operator_tpu.schema import (
+    AIProvider,
+    AIProviderRef,
+    AIProviderSpec,
+    LabelSelector,
+    ObjectMeta,
+    Podmortem,
+    PodmortemSpec,
+)
+from operator_tpu.schema.analysis import AIResponse
+from operator_tpu.utils.config import OperatorConfig
+from operator_tpu.utils.faultinject import FaultPlan, raise_, times
+from operator_tpu.utils.timing import MetricsRegistry
+
+from test_watcher_pipeline import failed_pod
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class Wall:
+    """Injectable wall clock shared by electors/ledgers in one scenario."""
+
+    def __init__(self, start: float = 1_000_000.0) -> None:
+        self.t = start
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, seconds: float) -> None:
+        self.t += seconds
+
+
+def _elector(api, wall, identity, *, metrics=None, seed=0, **kw):
+    defaults = dict(
+        lease_name="op-lease",
+        namespace="ns",
+        duration_s=15.0,
+        renew_period_s=0.02,
+        retry_period_s=0.02,
+        kube_timeout_s=5.0,
+    )
+    defaults.update(kw)
+    return LeaseElector(
+        api, identity=identity, metrics=metrics or MetricsRegistry(),
+        wall_clock=wall, rng=random.Random(seed), **defaults,
+    )
+
+
+# ---------------------------------------------------------------------------
+# elector unit behaviour
+# ---------------------------------------------------------------------------
+
+
+def test_elector_acquires_and_renews_fresh_lease():
+    async def scenario():
+        api = FakeKubeApi()
+        wall = Wall()
+        metrics = MetricsRegistry()
+        elector = _elector(api, wall, "pod-a", metrics=metrics)
+        stop = asyncio.Event()
+        task = asyncio.create_task(elector.run(stop))
+        assert await asyncio.wait_for(elector.wait_leading(stop), 5)
+        lease = await api.get("Lease", "op-lease", "ns")
+        assert lease["spec"]["holderIdentity"] == "pod-a"
+        assert lease["spec"]["leaseDurationSeconds"] == 15
+        first_renew = parse_micro(lease["spec"]["renewTime"])
+        # renewals re-stamp renewTime as the (injected) wall clock advances
+        wall.advance(3.0)
+        for _ in range(200):
+            lease = await api.get("Lease", "op-lease", "ns")
+            if parse_micro(lease["spec"]["renewTime"]) > first_renew:
+                break
+            await asyncio.sleep(0.005)
+        assert parse_micro(lease["spec"]["renewTime"]) > first_renew
+        assert metrics.counter("leader_elected") == 1
+        stop.set()
+        await asyncio.wait_for(task, 5)
+
+    run(scenario())
+
+
+def test_standby_waits_for_live_leader_then_takes_over_on_expiry():
+    async def scenario():
+        api = FakeKubeApi()
+        wall = Wall()
+        leader = _elector(api, wall, "pod-a", seed=1)
+        standby = _elector(api, wall, "pod-b", seed=2)
+        stop_a, stop_b = asyncio.Event(), asyncio.Event()
+        task_a = asyncio.create_task(leader.run(stop_a))
+        assert await asyncio.wait_for(leader.wait_leading(stop_a), 5)
+        task_b = asyncio.create_task(standby.run(stop_b))
+        # a live leader keeps renewing: the standby must NOT acquire
+        await asyncio.sleep(0.2)
+        assert not standby.is_leader
+        # "SIGKILL" the leader: its renew loop dies without releasing, and
+        # the wall clock runs past the lease duration
+        stop_a.set()
+        await asyncio.wait_for(task_a, 5)
+        # takeover requires EXPIRY, not just leader death
+        await asyncio.sleep(0.1)
+        assert not standby.is_leader
+        wall.advance(16.0)
+        assert await asyncio.wait_for(standby.wait_leading(stop_b), 5)
+        lease = await api.get("Lease", "op-lease", "ns")
+        assert lease["spec"]["holderIdentity"] == "pod-b"
+        assert lease["spec"]["leaseTransitions"] == 1
+        stop_b.set()
+        await asyncio.wait_for(task_b, 5)
+
+    run(scenario())
+
+
+def test_graceful_release_hands_over_without_waiting_out_the_lease():
+    async def scenario():
+        api = FakeKubeApi()
+        wall = Wall()
+        leader = _elector(api, wall, "pod-a", seed=3)
+        standby = _elector(api, wall, "pod-b", seed=4)
+        stop_a, stop_b = asyncio.Event(), asyncio.Event()
+        task_a = asyncio.create_task(leader.run(stop_a))
+        assert await asyncio.wait_for(leader.wait_leading(stop_a), 5)
+        task_b = asyncio.create_task(standby.run(stop_b))
+        # graceful shutdown: stop the leader's loop, then release WITHOUT
+        # advancing the wall clock — the blanked holder lets the standby
+        # in immediately, no 15s expiry wait
+        stop_a.set()
+        await asyncio.wait_for(task_a, 5)
+        await leader.release()
+        assert await asyncio.wait_for(standby.wait_leading(stop_b), 5)
+        lease = await api.get("Lease", "op-lease", "ns")
+        assert lease["spec"]["holderIdentity"] == "pod-b"
+        stop_b.set()
+        await asyncio.wait_for(
+            asyncio.gather(task_b, return_exceptions=True), 5
+        )
+
+    run(scenario())
+
+
+def test_partitioned_leader_steps_down_standby_takes_over():
+    """Fault injection partitions the leader away from its Lease (every
+    Lease op fails for it); after the lease duration it steps down, and the
+    standby — whose API traffic is healthy — takes over."""
+
+    async def scenario():
+        api_leader = FakeKubeApi()
+        wall = Wall()
+        metrics = MetricsRegistry()
+        leader = _elector(api_leader, wall, "pod-a", metrics=metrics, seed=5)
+        stop = asyncio.Event()
+        task_a = asyncio.create_task(leader.run(stop))
+        assert await asyncio.wait_for(leader.wait_leading(stop), 5)
+        # partition: every subsequent Lease get/patch from the leader fails
+        api_leader.inject_errors(
+            "get", lambda: ApiError("partitioned", 500), times=10_000,
+            kind="Lease",
+        )
+        # its clock runs past the lease duration with no successful renewal
+        wall.advance(16.0)
+        assert await asyncio.wait_for(leader.wait_not_leading(stop), 5)
+        assert metrics.counter("leader_lost") == 1
+        # the standby (same store, no partition) acquires the expired lease
+        standby = _elector(api_leader, wall, "pod-b", seed=6)
+        # the leader's partition only affects ITS hook-injected calls, but
+        # our fake injects per-api — use a fresh elector on the same api
+        # with the hooks spent beyond Lease kind only for 'get'... instead,
+        # drop the hooks to model a partition that healed for the standby
+        api_leader.error_hooks.clear()
+        task_b = asyncio.create_task(standby.run(stop))
+        assert await asyncio.wait_for(standby.wait_leading(stop), 5)
+        stop.set()
+        await asyncio.wait_for(
+            asyncio.gather(task_a, task_b, return_exceptions=True), 5
+        )
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# claim ledger
+# ---------------------------------------------------------------------------
+
+
+def test_claim_ledger_roundtrip_and_terminal_states(tmp_path):
+    path = str(tmp_path / "claims.jsonl")
+    ledger = ClaimLedger(path)
+    assert ledger.try_claim(
+        "prod/web-1@t1", pod_name="web-1", pod_namespace="prod",
+        failure_time="t1", podmortems=["ns/pm"], deadline_total_s=180.0,
+    )
+    assert not ledger.try_claim("prod/web-1@t1")  # already claimed
+    ledger.note_stage("prod/web-1@t1", "analyze:ns/pm")
+    ledger.mark_done("prod/web-1@t1")
+    assert ledger.try_claim("prod/web-2@t1", failure_time="t1")
+    ledger.release("prod/web-2@t1")
+    assert ledger.try_claim("prod/web-2@t1")  # released = retryable
+    ledger.close()
+    # a fresh process: done stays done, the re-claimed web-2 is PENDING
+    reloaded = ClaimLedger(path)
+    assert not reloaded.try_claim("prod/web-1@t1")
+    pending = reloaded.take_pending()
+    assert [c.key for c in pending] == ["prod/web-2@t1"]
+    assert reloaded.take_pending() == []  # single-shot drain
+    reloaded.close()
+
+
+def test_claim_ledger_survives_torn_tail_line(tmp_path):
+    path = str(tmp_path / "claims.jsonl")
+    ledger = ClaimLedger(path)
+    ledger.try_claim("a@1", failure_time="1", deadline_total_s=60.0)
+    ledger.mark_done("a@1")
+    ledger.try_claim("b@1", failure_time="1", deadline_total_s=60.0)
+    ledger.close()
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"op": "done", "key"')  # torn mid-crash append
+    reloaded = ClaimLedger(path)
+    assert [c.key for c in reloaded.take_pending()] == ["b@1"]
+    reloaded.close()
+
+
+def test_claim_ledger_abandon_leaves_resumable_state(tmp_path):
+    """The SIGKILL seam: abandon() drops the journal handle, so terminal
+    transitions after it never reach disk — a successor sees the claim as
+    non-terminal, exactly like a real kill."""
+    path = str(tmp_path / "claims.jsonl")
+    wall = Wall()
+    ledger = ClaimLedger(path, wall_clock=wall)
+    ledger.try_claim("k@1", failure_time="1", deadline_total_s=180.0)
+    ledger.abandon()
+    ledger.mark_done("k@1")  # lost with the "process"
+    wall.advance(50.0)
+    successor = ClaimLedger(path, wall_clock=wall)
+    pending = successor.take_pending()
+    assert len(pending) == 1 and pending[0].key == "k@1"
+    assert successor.remaining_budget_s(pending[0]) == pytest.approx(130.0)
+    successor.close()
+
+
+def test_claim_ledger_compaction_preserves_state(tmp_path):
+    path = str(tmp_path / "claims.jsonl")
+    ledger = ClaimLedger(path, compact_factor=2)
+    for i in range(200):
+        key = f"pod-{i}@t"
+        ledger.try_claim(key, failure_time="t", deadline_total_s=1.0)
+        if i % 2 == 0:
+            ledger.mark_done(key)
+        else:
+            ledger.release(key)
+    ledger.try_claim("live@t", failure_time="t", deadline_total_s=9.0)
+    ledger.close()
+    with open(path, encoding="utf-8") as f:
+        lines = [json.loads(line) for line in f if line.strip()]
+    # compaction kept the journal near the live-entry count, not 401 lines
+    assert len(lines) < 300
+    reloaded = ClaimLedger(path)
+    assert not reloaded.try_claim("pod-0@t")  # done survived compaction
+    assert [c.key for c in reloaded.take_pending()] == ["live@t"]
+    reloaded.close()
+
+
+# ---------------------------------------------------------------------------
+# killed-leader takeover resync (the acceptance chaos scenario)
+# ---------------------------------------------------------------------------
+
+
+class GatedBackend:
+    """AI backend that parks forever until released — the analysis the
+    leader is killed in the middle of.  Records every request's residual
+    deadline so the resumed run's budget is observable."""
+
+    def __init__(self) -> None:
+        self.gate = asyncio.Event()
+        self.deadlines: list = []
+        self.calls = 0
+
+    async def generate(self, request):
+        self.calls += 1
+        self.deadlines.append(request.deadline_s)
+        await self.gate.wait()
+        return AIResponse(explanation="Root Cause: resumed and completed.")
+
+
+def _takeover_plan(seed: int) -> FaultPlan:
+    """Seeded chaos riding the takeover: a 409 storm against the
+    successor's status writes (its conflict-retry discipline must still
+    converge to ONE patch)."""
+    from operator_tpu.operator.kubeapi import ConflictError
+
+    plan = FaultPlan(seed=seed)
+    plan.rule(
+        "kube.patch_status",
+        times(3, raise_(lambda: ConflictError("injected conflict"), "409")),
+        match=lambda kind, name: kind == "Podmortem",
+    )
+    return plan
+
+
+async def _run_takeover_scenario(plan: FaultPlan, claims_path: str) -> dict:
+    wall = Wall()
+    api = FakeKubeApi()
+    config = OperatorConfig(
+        pattern_cache_directory="/nonexistent",
+        conflict_backoff_base_s=0.001,
+        analysis_deadline_s=180.0,
+        claims_path=claims_path,
+    )
+
+    await api.create("AIProvider", AIProvider(
+        metadata=ObjectMeta(name="prov", namespace="ns"),
+        spec=AIProviderSpec(provider_id="gated", model_id="m",
+                            caching_enabled=False),
+    ).to_dict())
+    pm = Podmortem(
+        metadata=ObjectMeta(name="pm", namespace="ns"),
+        spec=PodmortemSpec(
+            pod_selector=LabelSelector(match_labels={"app": "web"}),
+            ai_provider_ref=AIProviderRef(name="prov", namespace="ns"),
+        ),
+    )
+    await api.create("Podmortem", pm.to_dict())
+    pod = failed_pod()
+    api.set_pod_log("prod", pod.metadata.name,
+                    "java.lang.OutOfMemoryError: Java heap space")
+    await api.create("Pod", pod.to_dict())
+
+    # --- replica A: acquires the lease, starts the analysis, gets killed
+    stop_a = asyncio.Event()
+    elector_a = _elector(api, wall, "pod-a", seed=plan.seed)
+    task_a = asyncio.create_task(elector_a.run(stop_a))
+    assert await asyncio.wait_for(elector_a.wait_leading(stop_a), 5)
+
+    backend_a = GatedBackend()  # never released: A dies mid-AI-leg
+    providers_a = default_registry()
+    providers_a.register("gated", backend_a)
+    metrics_a = MetricsRegistry()
+    pipeline_a = AnalysisPipeline(
+        api, PatternEngine(), config=config, metrics=metrics_a,
+        providers=providers_a,
+        claims=ClaimLedger(claims_path, wall_clock=wall),
+    )
+    # replica B is a WARM standby: its ledger handle is open from ITS boot
+    # — i.e. BEFORE the leader writes any claim — so takeover must re-read
+    # the shared journal, not trust this boot-time view
+    ledger_b = ClaimLedger(claims_path, wall_clock=wall)
+    analysis_a = asyncio.create_task(pipeline_a.process_failure_group(
+        pod, [pm], failure_time="2026-07-28T09:00:00Z"
+    ))
+    for _ in range(500):  # until A is parked inside the AI leg
+        if backend_a.calls:
+            break
+        await asyncio.sleep(0.005)
+    assert backend_a.calls == 1
+
+    # --- SIGKILL replica A: journal handle drops with the process (no
+    # terminal claim records), its tasks evaporate, the lease is NOT
+    # released and simply expires
+    pipeline_a.claims.abandon()
+    analysis_a.cancel()
+    stop_a.set()
+    await asyncio.gather(analysis_a, task_a, return_exceptions=True)
+    wall.advance(50.0)  # dead air: 50s of the 180s envelope burn away
+
+    # --- replica B: takes over after expiry, re-lists, resumes the claim
+    api.fault_plan = plan  # the takeover rides the seeded 409 storm
+    status_writes = []
+    original_patch_status = api.patch_status
+
+    async def spying_patch_status(kind, name, namespace, status, **kw):
+        out = await original_patch_status(kind, name, namespace, status, **kw)
+        if kind == "Podmortem":
+            status_writes.append(status)
+        return out
+
+    api.patch_status = spying_patch_status
+
+    stop_b = asyncio.Event()
+    elector_b = _elector(api, wall, "pod-b", seed=plan.seed + 1)
+    task_b = asyncio.create_task(elector_b.run(stop_b))
+    assert await asyncio.wait_for(elector_b.wait_leading(stop_b), 5)
+
+    backend_b = GatedBackend()
+    backend_b.gate.set()  # B's engine is healthy: generation completes
+    providers_b = default_registry()
+    providers_b.register("gated", backend_b)
+    metrics_b = MetricsRegistry()
+    memory_b = IncidentMemory(store=IncidentStore())
+    pipeline_b = AnalysisPipeline(
+        api, PatternEngine(), config=config, metrics=metrics_b,
+        providers=providers_b, memory=memory_b,
+        claims=ledger_b,
+    )
+    # takeover re-list: the successor's CR cache primes from a fresh LIST
+    cache_b = PodmortemCache(api, resync_delay_s=0.01)
+    watcher_b = PodFailureWatcher(
+        api, pipeline_b, config=config, metrics=metrics_b, cache=cache_b
+    )
+    watch_stop = asyncio.Event()
+    watch_task = asyncio.create_task(watcher_b.run(watch_stop))
+    assert await cache_b.wait_ready(5)
+    assert [p.metadata.name for p in cache_b.all()] == ["pm"]
+
+    resumed = await pipeline_b.resume_pending()
+
+    await watcher_b.drain()
+    watch_stop.set()
+    stop_b.set()
+    api.close_watches()
+    await asyncio.gather(watch_task, task_b, return_exceptions=True)
+    api.fault_plan = None
+
+    status = (await api.get("Podmortem", "pm", "ns")).get("status") or {}
+    failures = status.get("recentFailures") or []
+    incidents = pipeline_b.memory.store.all()
+    pipeline_b.claims.close()
+    return {
+        "resumed": resumed,
+        "trace": plan.trace(),
+        "pending_faults": plan.pending(),
+        "lease_holder": (await api.get("Lease", "op-lease", "ns"))
+        ["spec"]["holderIdentity"],
+        "resumed_deadline_s": backend_b.deadlines,
+        # traceId and the recurrence's wall-clock stamps are freshly minted
+        # per run by design; everything else must replay byte-identically
+        "failures": [
+            {
+                k: (
+                    {rk: rv for rk, rv in v.items() if rk != "firstSeen"}
+                    if k == "recurrence" and isinstance(v, dict)
+                    else v
+                )
+                for k, v in f.items()
+                if k != "traceId"
+            }
+            for f in failures
+        ],
+        "successful_status_writes": len(
+            [w for w in status_writes if w.get("recentFailures")]
+        ),
+        "incidents": [
+            (i.fingerprint, i.seen_count, i.explanation) for i in incidents
+        ],
+        "claims_resumed_counter": metrics_b.counter("claims_resumed"),
+    }
+
+
+def test_killed_leader_takeover_resumes_analysis_exactly_once(tmp_path):
+    """SIGKILL the leader mid-analysis → the standby acquires the lease,
+    re-lists, resumes the non-terminal claim with its REMAINING budget
+    (~130s of 180s after 50s of dead air), and converges to exactly one
+    status patch and one incident — byte-identical across two replays."""
+    out_a = run(_run_takeover_scenario(
+        _takeover_plan(seed=21), str(tmp_path / "a" / "claims.jsonl")))
+    out_b = run(_run_takeover_scenario(
+        _takeover_plan(seed=21), str(tmp_path / "b" / "claims.jsonl")))
+
+    assert out_a["trace"] == out_b["trace"], "fault replay diverged"
+    assert out_a["pending_faults"] == {}, out_a["pending_faults"]
+
+    for out in (out_a, out_b):
+        assert out["lease_holder"] == "pod-b"
+        assert out["resumed"] == 1
+        assert out["claims_resumed_counter"] == 1
+        # exactly once: one stored entry, one successful status write
+        assert len(out["failures"]) == 1, out["failures"]
+        entry = out["failures"][0]
+        assert entry["analysisStatus"] == "Analyzed"
+        assert entry["explanation"].startswith("Root Cause: resumed")
+        assert out["successful_status_writes"] == 1
+        # exactly one incident record in the successor's memory
+        assert len(out["incidents"]) == 1
+        assert out["incidents"][0][1] == 1  # seen exactly once
+        # the resumed AI leg ran under the RESIDUAL envelope: well below
+        # the 180s total (50s dead air + collect/parse spend), well above 0
+        assert len(out["resumed_deadline_s"]) == 1
+        assert 0 < out["resumed_deadline_s"][0] <= 130.0
+
+    # byte-identical replay (trace ids excluded: freshly minted per run)
+    assert json.dumps(out_a["failures"], sort_keys=True) == json.dumps(
+        out_b["failures"], sort_keys=True
+    )
+    assert out_a["incidents"] == out_b["incidents"]
+
+
+def test_operator_wiring_gates_control_loops_on_leadership(tmp_path):
+    """App-level wiring: with leader_election on, the Operator starts its
+    control loops only after acquiring the Lease, analyzes failures while
+    leading, and releases the Lease on stop (standby hand-off without
+    waiting out the lease duration)."""
+    from operator_tpu.operator.app import Operator
+
+    async def scenario():
+        api = FakeKubeApi()
+        api.namespace = "podmortem-system"
+        config = OperatorConfig(
+            pattern_cache_directory="/nonexistent",
+            health_port=-1,
+            leader_election=True,
+            pod_name="replica-0",
+            lease_renew_period_s=0.02,
+            lease_retry_period_s=0.02,
+            conflict_backoff_base_s=0.001,
+            claims_path=str(tmp_path / "claims.jsonl"),
+        )
+        operator = Operator(api, config=config)
+        await api.create("Podmortem", Podmortem(
+            metadata=ObjectMeta(name="pm", namespace="ns"),
+            spec=PodmortemSpec(
+                pod_selector=LabelSelector(match_labels={"app": "web"}),
+            ),
+        ).to_dict())
+        await operator.start()
+        assert operator.elector is not None
+        assert await asyncio.wait_for(
+            operator.elector.wait_leading(operator._stop), 5
+        )
+        lease = await api.get(
+            "Lease", config.lease_name, "podmortem-system"
+        )
+        assert lease["spec"]["holderIdentity"] == "replica-0"
+        # control loops are live: a failed pod gets analyzed end to end
+        for _ in range(500):
+            if operator._control_tasks and await operator.cr_cache.wait_ready(0.01):
+                break
+            await asyncio.sleep(0.005)
+        pod = failed_pod()
+        await api.create("Pod", pod.to_dict())
+        for _ in range(500):
+            status = (await api.get("Podmortem", "pm", "ns")).get("status") or {}
+            if status.get("recentFailures"):
+                break
+            await asyncio.sleep(0.01)
+        assert (status.get("recentFailures") or []), "leader never analyzed"
+        await operator.stop()
+        # graceful hand-off: the lease was RELEASED, not left to expire
+        lease = await api.get(
+            "Lease", config.lease_name, "podmortem-system"
+        )
+        assert lease["spec"]["holderIdentity"] == ""
+        # the claim reached its terminal record before shutdown
+        reloaded = ClaimLedger(config.claims_path)
+        assert reloaded.take_pending() == []
+        reloaded.close()
+
+    run(scenario())
+
+
+def test_resumed_claim_skips_already_stored_analysis(tmp_path):
+    """A claim that died AFTER storing (annotation in etcd) but before its
+    terminal ledger record resumes as a durable-dedupe hit: no second
+    analysis, no second status entry."""
+
+    async def scenario():
+        wall = Wall()
+        api = FakeKubeApi()
+        config = OperatorConfig(
+            pattern_cache_directory="/nonexistent",
+            conflict_backoff_base_s=0.001,
+            claims_path=str(tmp_path / "claims.jsonl"),
+        )
+        pm = Podmortem(
+            metadata=ObjectMeta(name="pm", namespace="ns"),
+            spec=PodmortemSpec(
+                pod_selector=LabelSelector(match_labels={"app": "web"}),
+            ),
+        )
+        await api.create("Podmortem", pm.to_dict())
+        pod = failed_pod()
+        await api.create("Pod", pod.to_dict())
+
+        metrics = MetricsRegistry()
+        pipeline = AnalysisPipeline(
+            api, PatternEngine(), config=config, metrics=metrics,
+            providers=default_registry(),
+        )
+        results = await pipeline.process_failure_group(
+            pod, [pm], failure_time="t-1"
+        )
+        assert results and results[0] is not None
+        # "crash" between the status store and the terminal ledger record:
+        # rewrite the journal without its done record
+        pipeline.claims.close()
+        path = config.claims_path
+        with open(path, encoding="utf-8") as f:
+            lines = [line for line in f if '"op": "done"' not in line]
+        with open(path, "w", encoding="utf-8") as f:
+            f.writelines(lines)
+
+        pipeline2 = AnalysisPipeline(
+            api, PatternEngine(), config=config, metrics=MetricsRegistry(),
+            providers=default_registry(),
+        )
+        resumed = await pipeline2.resume_pending()
+        assert resumed == 0  # durable-dedupe hit, not a re-analysis
+        assert pipeline2.metrics.counter("dedupe_durable_hits") == 1
+        status = (await api.get("Podmortem", "pm", "ns")).get("status") or {}
+        assert len(status.get("recentFailures") or []) == 1
+        pipeline2.claims.close()
+
+    run(scenario())
