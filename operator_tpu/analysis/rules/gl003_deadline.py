@@ -91,6 +91,14 @@ class DeadlinePropagation(Rule):
         # step down is a split brain) or the takeover resume
         r"operator_tpu/operator/lease\.py$",
         r"operator_tpu/operator/claims\.py$",
+        # multi-replica data plane (ISSUE 6): every routed dispatch must
+        # spend its residual budget AT the attempt (asyncio.wait_for on
+        # the deadline residue) — an unbudgeted replica call would let one
+        # wedged replica eat the whole analysis envelope before failover;
+        # the shared journal helper's IO rides the writer thread but any
+        # external call it ever grows must be budget-bound too
+        r"operator_tpu/router/.*\.py$",
+        r"operator_tpu/utils/journal\.py$",
     )
 
     def check(self, ctx: AnalysisContext) -> list[Finding]:

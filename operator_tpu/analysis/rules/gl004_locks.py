@@ -86,6 +86,11 @@ class LockDiscipline(Rule):
     scope = (
         r"operator_tpu/operator/.*\.py$",
         r"operator_tpu/memory/.*\.py$",
+        # multi-replica data plane + the shared journal helper (ISSUE 6):
+        # router health/ring state is mutated from concurrent dispatches,
+        # and the journal's handle moves between caller and writer thread
+        r"operator_tpu/router/.*\.py$",
+        r"operator_tpu/utils/journal\.py$",
     )
 
     def check(self, ctx: AnalysisContext) -> list[Finding]:

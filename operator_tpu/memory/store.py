@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import json
 import logging
-import os
 import threading
 import time
 from collections import OrderedDict
@@ -34,6 +33,7 @@ from typing import Callable, Iterable, Optional
 
 from ..schema.meta import now_iso
 from ..schema.serde import from_dict, to_dict
+from ..utils.journal import Journal
 
 log = logging.getLogger(__name__)
 
@@ -122,36 +122,19 @@ class IncidentStore:
         self._clock = clock or time.time
         self._lock = threading.Lock()
         self._entries: "OrderedDict[str, Incident]" = OrderedDict()
-        self._journal = None
-        self._journal_lines = 0
+        # shared crash-safe JSONL discipline (utils/journal.py): torn-line
+        # tolerant load, append+flush, temp-file+os.replace compaction.
+        # Direct (caller-thread) writes: store mutations already run off
+        # the event loop via asyncio.to_thread.
+        self._journal = Journal(path, label="incident journal")
         if path:
-            self._load_journal(path)
-            self._open_journal(path)
+            with self._lock:
+                self._journal.load(self._replay_locked)
+                self._journal.open()
+            log.info("incident store: %d incident(s) restored from %s",
+                     len(self), path)
 
-    # -- journal -------------------------------------------------------
-    def _load_journal(self, path: str) -> None:
-        if not os.path.exists(path):
-            return
-        loaded = dropped = 0
-        with open(path, encoding="utf-8", errors="replace") as handle:
-            for line in handle:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    record = json.loads(line)
-                    self._replay(record)
-                    loaded += 1
-                except (ValueError, KeyError, TypeError):
-                    # a torn tail line from a crash mid-append — or any
-                    # corrupt line — loses that one mutation, never the store
-                    dropped += 1
-        self._journal_lines = loaded
-        if dropped:
-            log.warning("incident journal %s: skipped %d corrupt line(s)", path, dropped)
-        log.info("incident store: %d incident(s) restored from %s", len(self._entries), path)
-
-    def _replay(self, record: dict) -> None:
+    def _replay_locked(self, record: dict) -> None:
         op = record.get("op")
         if op == "put":
             incident = Incident.parse(record["incident"])
@@ -173,42 +156,19 @@ class IncidentStore:
         else:
             raise KeyError(f"unknown journal op {op!r}")
 
-    def _open_journal(self, path: str) -> None:
-        directory = os.path.dirname(path)
-        if directory:
-            os.makedirs(directory, exist_ok=True)
-        self._journal = open(path, "a", encoding="utf-8")
-
     def _append(self, record: dict) -> None:
-        if self._journal is None:
-            return
-        self._journal.write(json.dumps(record, sort_keys=True) + "\n")
-        self._journal.flush()
-        self._journal_lines += 1
-        if self._journal_lines > self.compact_factor * max(len(self._entries), 16):
-            self._compact()
-
-    def _compact(self) -> None:
-        """Rewrite the journal as one ``put`` per live incident — temp file
-        then atomic replace, so a crash mid-compaction leaves the old
-        journal intact."""
-        assert self.path is not None
-        tmp = f"{self.path}.tmp"
-        with open(tmp, "w", encoding="utf-8") as handle:
-            for incident in self._entries.values():
-                handle.write(json.dumps({"op": "put", "incident": incident.to_dict()},
-                                        sort_keys=True) + "\n")
-        if self._journal is not None:
-            self._journal.close()
-        os.replace(tmp, self.path)
-        self._open_journal(self.path)
-        self._journal_lines = len(self._entries)
+        self._journal.append(record)
+        if self._journal.lines > self.compact_factor * max(len(self._entries), 16):
+            # one ``put`` per live incident — a 500x-recurring incident
+            # must not keep 500 copies of its analysis text on disk
+            self._journal.compact(
+                [{"op": "put", "incident": incident.to_dict()}
+                 for incident in self._entries.values()]
+            )
 
     def close(self) -> None:
         with self._lock:
-            if self._journal is not None:
-                self._journal.close()
-                self._journal = None
+            self._journal.close()
 
     # -- mutation ------------------------------------------------------
     def upsert(self, incident: Incident, *, bump_if_existing: bool = False) -> list[str]:

@@ -62,6 +62,7 @@ class Operator:
         # traceparent handling, and GET /traces on the health port
         self.tracer, self.recorder = build_tracer(self.config, self.metrics)
         self._register_tpu_provider()
+        self._register_http_providers()
         self.engine = PatternEngine(
             cache_dir=self.config.pattern_cache_directory,
             semantic=self._build_semantic(),
@@ -172,6 +173,33 @@ class Operator:
 
         self.providers.register_factory("tpu-native", factory)
 
+    def _register_http_providers(self) -> None:
+        """One CONFIGURED OpenAI-compat backend behind every HTTP
+        providerId (resolve() would otherwise lazily create a bare one):
+        the config's data-plane knobs (router affinity/shed/breaker
+        settings, operator_tpu/router/) reach dispatch, the operator's
+        metrics registry receives the podmortem_router_* counters, and
+        all three ids share ONE router — so per-replica breaker/health
+        history survives across CRs pointing at the same replica set.
+        Injected registries keep their own backends (tests)."""
+        from .providers import OpenAICompatProvider
+
+        http_ids = [
+            pid for pid in ("openai", "ollama", "openai-compatible")
+            if not self.providers.has(pid)
+        ]
+        if not http_ids:
+            return
+        backend = OpenAICompatProvider(
+            metrics=self.metrics,
+            router_vnodes=self.config.router_vnodes,
+            shed_pressure=self.config.router_shed_pressure,
+            replica_failure_threshold=self.config.router_replica_failure_threshold,
+            replica_reset_s=self.config.router_replica_reset_s,
+        )
+        for pid in http_ids:
+            self.providers.register(pid, backend)
+
     def _build_semantic(self):
         """Neural semantic matcher when an encoder checkpoint is mounted;
         None otherwise (lexical regex/keyword matching still runs).  A bad
@@ -240,6 +268,13 @@ class Operator:
                 # land in the same flight recorder /traces serves
                 tracer=self.tracer,
                 drain_grace_s=self.config.serving_drain_grace_s,
+                # replica identity for the data-plane router's /healthz
+                # polls (falls back to hostname inside the server)
+                replica_id=(
+                    self.config.serving_replica_id
+                    or self.config.pod_name
+                    or None
+                ),
             )
             await server.start()
             # warmup: one throwaway generation compiles the prefill + decode

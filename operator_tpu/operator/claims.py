@@ -29,14 +29,14 @@ at-least-once execution of a resumed claim still yields exactly-once
 
 from __future__ import annotations
 
-import json
 import logging
-import os
 import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable, Optional
+
+from ..utils.journal import Journal
 
 log = logging.getLogger(__name__)
 
@@ -116,48 +116,39 @@ class ClaimLedger:
         self._wall = wall_clock or time.time
         self._lock = threading.Lock()
         self._entries: "OrderedDict[str, ClaimRecord]" = OrderedDict()
-        self._journal = None
-        self._journal_lines = 0
+        # shared crash-safe JSONL discipline (utils/journal.py) on a
+        # dedicated writer thread (the obs/record.py pattern): stage/done/
+        # release transitions and compaction enqueue and return, so the
+        # ROUTINE ledger traffic — including every compaction — runs off
+        # the event loop and a slow RWX volume no longer stalls the lease
+        # renew loop on each transition.  try_claim alone WAITS for its
+        # flush (durable-before-analysis, by contract); that one wait can
+        # still queue behind an in-flight compaction on severely wedged
+        # storage — the residual, rare exposure, down from every-append.
+        self._journal = Journal(path, label="claim ledger", async_writes=True)
         #: non-terminal claims found at load: a previous process died while
         #: they were in flight.  Drained (once) by :meth:`take_pending`.
         self._pending: list[ClaimRecord] = []
         if path:
             with self._lock:
-                self._load_journal_locked(path)
-                self._open_journal_locked(path)
+                self._load_journal_locked()
+                self._journal.open()
 
     @staticmethod
     def key(pod, failure_time: str) -> str:
         """Same identity as the old ``FailureDedupe.key``."""
         return f"{pod.metadata.namespace}/{pod.metadata.name}@{failure_time}"
 
-    # -- journal (mirrors memory/store.py's torn-line discipline) -------
-    def _load_journal_locked(self, path: str) -> None:
-        if not os.path.exists(path):
-            return
-        loaded = dropped = 0
-        with open(path, encoding="utf-8", errors="replace") as handle:
-            for line in handle:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    self._replay_locked(json.loads(line))
-                    loaded += 1
-                except (ValueError, KeyError, TypeError):
-                    # a torn tail line from a crash mid-append loses that
-                    # one transition, never the ledger
-                    dropped += 1
-        self._journal_lines = loaded
-        if dropped:
-            log.warning("claim ledger %s: skipped %d corrupt line(s)", path, dropped)
+    # -- journal (the shared utils/journal.py discipline) ---------------
+    def _load_journal_locked(self) -> None:
+        self._journal.load(self._replay_locked)
         self._pending = [
             record for record in self._entries.values() if record.state == _IN_FLIGHT
         ]
         if self._pending:
             log.warning(
                 "claim ledger %s: %d non-terminal claim(s) from a previous "
-                "process await resume", path, len(self._pending),
+                "process await resume", self.path, len(self._pending),
             )
 
     def _replay_locked(self, record: dict) -> None:
@@ -179,49 +170,25 @@ class ClaimLedger:
         else:
             raise KeyError(f"unknown ledger op {op!r}")
 
-    def _open_journal_locked(self, path: str) -> None:
-        directory = os.path.dirname(path)
-        if directory:
-            os.makedirs(directory, exist_ok=True)
-        self._journal = open(path, "a", encoding="utf-8")
-
-    def _append_locked(self, record: dict) -> None:
-        if self._journal is None:
-            return
-        self._journal.write(json.dumps(record, sort_keys=True) + "\n")
-        self._journal.flush()
-        self._journal_lines += 1
-        if self._journal_lines > self.compact_factor * max(len(self._entries), 16):
+    def _append_locked(self, record: dict, *, wait: bool = False) -> None:
+        self._journal.append(record, wait=wait)
+        if self._journal.lines > self.compact_factor * max(len(self._entries), 16):
             self._compact_locked()
 
     def _compact_locked(self) -> None:
-        """One ``claim`` (+ ``done`` for terminal entries) per live claim —
-        temp file then atomic replace."""
-        assert self.path is not None
-        tmp = f"{self.path}.tmp"
-        lines = 0
-        with open(tmp, "w", encoding="utf-8") as handle:
-            for claim in self._entries.values():
-                handle.write(json.dumps(
-                    {"op": "claim", "claim": claim.to_dict()}, sort_keys=True
-                ) + "\n")
-                lines += 1
-                if claim.state == _DONE:
-                    handle.write(json.dumps(
-                        {"op": "done", "key": claim.key}, sort_keys=True
-                    ) + "\n")
-                    lines += 1
-        if self._journal is not None:
-            self._journal.close()
-        os.replace(tmp, self.path)
-        self._open_journal_locked(self.path)
-        self._journal_lines = lines
+        """One ``claim`` (+ ``done`` for terminal entries, preserving the
+        stage marker on the claim record) per live claim — serialized
+        under the lock NOW, replaced atomically on the writer thread."""
+        records: list[dict] = []
+        for claim in self._entries.values():
+            records.append({"op": "claim", "claim": claim.to_dict()})
+            if claim.state == _DONE:
+                records.append({"op": "done", "key": claim.key})
+        self._journal.compact(records)
 
     def close(self) -> None:
         with self._lock:
-            if self._journal is not None:
-                self._journal.close()
-                self._journal = None
+            self._journal.close()
 
     def reload(self) -> None:
         """Re-read the journal from disk and reopen the append handle.
@@ -238,14 +205,11 @@ class ClaimLedger:
         if not self.path:
             return
         with self._lock:
-            if self._journal is not None:
-                self._journal.close()
-                self._journal = None
+            self._journal.close()
             self._entries.clear()
             self._pending = []
-            self._journal_lines = 0
-            self._load_journal_locked(self.path)
-            self._open_journal_locked(self.path)
+            self._load_journal_locked()
+            self._journal.open()
 
     def abandon(self) -> None:
         """Chaos seam: drop the journal handle WITHOUT terminal records —
@@ -253,9 +217,7 @@ class ClaimLedger:
         mutate only this process's memory; a successor ledger opened on
         the same path sees the claims exactly as the kill left them."""
         with self._lock:
-            if self._journal is not None:
-                self._journal.close()
-                self._journal = None
+            self._journal.abandon()
 
     # -- claim lifecycle ------------------------------------------------
     def try_claim(
@@ -291,7 +253,10 @@ class ClaimLedger:
                 # with no terminal op would resurrect as pending at the
                 # next load and re-run an arbitrarily stale analysis
                 self._append_locked({"op": "release", "key": evicted_key})
-            self._append_locked({"op": "claim", "claim": claim.to_dict()})
+            # the ONE write that waits for its flush: the claim record
+            # must be durable BEFORE the analysis starts, or a crash in
+            # the gap loses the failure entirely
+            self._append_locked({"op": "claim", "claim": claim.to_dict()}, wait=True)
             return True
 
     def note_stage(self, key: str, stage: str) -> None:

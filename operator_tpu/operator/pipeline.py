@@ -567,6 +567,13 @@ class AnalysisPipeline:
                     ai_response = await self._generate_explanation(
                         pod, podmortem, result, failure, deadline=deadline,
                         prior_incidents=priors, provider=provider,
+                        # the failure-class fingerprint is the router's
+                        # affinity key: recurrences of one incident land
+                        # on the replica whose recall cache is hot
+                        fingerprint=(
+                            recall.fingerprint.digest if recall is not None
+                            else None
+                        ),
                     )
                 self._record_deadline_outcome(ai_response)
                 if ai_response is not None:
@@ -773,6 +780,7 @@ class AnalysisPipeline:
         deadline: Optional[Deadline] = None,
         prior_incidents: Optional[list[PriorIncident]] = None,
         provider: Optional[AIProvider] = None,
+        fingerprint: Optional[str] = None,
     ) -> AIResponse:
         ref = podmortem.spec.ai_provider_ref
         namespace = ref.namespace or podmortem.metadata.namespace or "default"
@@ -807,6 +815,7 @@ class AnalysisPipeline:
             analysis_result=result, provider_config=provider_config,
             failure_data=failure, deadline_s=remaining,
             prior_incidents=list(prior_incidents or []),
+            fingerprint=fingerprint,
         )
 
         cache_key = None
@@ -854,11 +863,20 @@ class AnalysisPipeline:
                 "ai_generate",
                 provider=provider_config.provider_id or "template",
                 budget_s=round(timeout_s, 3),
-            ):
+            ) as gen_span:
                 with self.metrics.timed("ai_generate"):
                     response = await asyncio.wait_for(
                         backend.generate(request), timeout=timeout_s
                     )
+                # routing forensics (operator_tpu/router/): which replica
+                # served this leg, and whether a cross-replica requeue
+                # saved it — mirrored into the stage metrics so the
+                # counter surface shows failovers without span digging
+                if response.replica_id:
+                    gen_span.set(replica=response.replica_id)
+                if response.requeues:
+                    gen_span.set(requeues=response.requeues)
+                    self.metrics.incr("analysis_requeued")
         except asyncio.TimeoutError:
             budget_bound = remaining is not None and remaining < self.config.ai_timeout_s
             message = (

@@ -25,12 +25,16 @@ import asyncio
 import hashlib
 import json
 import logging
-import time
-import urllib.error
+import urllib.parse
 import urllib.request
 from collections import OrderedDict
 from typing import Callable, Optional, Protocol
 
+from ..router import EngineRouter, Replica, RouterError, request_key
+# the breaker machinery moved to the router package (per-provider AND
+# per-replica boards share one implementation); re-exported here so every
+# existing import path keeps working
+from ..router.health import BreakerBoard, CircuitBreaker  # noqa: F401
 from ..schema.analysis import AIProviderConfig, AIResponse, AnalysisRequest
 from ..schema.crds import AIProvider
 from ..schema.kube import Secret
@@ -46,114 +50,6 @@ class AIProviderBackend(Protocol):
 
 class ProviderError(Exception):
     pass
-
-
-# --------------------------------------------------------------------------
-# per-provider circuit breaker
-# --------------------------------------------------------------------------
-
-
-class CircuitBreaker:
-    """Consecutive-failure breaker for one AI backend.
-
-    States: ``closed`` (calls flow) → after ``failure_threshold``
-    consecutive failures ``open`` (calls skipped: a dead backend must stop
-    burning the deadline budget — the pipeline falls through the existing
-    degradation ladder and stores pattern-only results) → after
-    ``reset_s`` ``half-open`` (exactly ONE probe flows) → probe success
-    closes, probe failure re-opens for another window.
-
-    The clock is injectable so chaos tests drive the state machine
-    deterministically (tests/test_chaos.py).
-    """
-
-    CLOSED = "closed"
-    OPEN = "open"
-    HALF_OPEN = "half-open"
-
-    def __init__(
-        self,
-        failure_threshold: int = 5,
-        reset_s: float = 30.0,
-        clock: Optional[Callable[[], float]] = None,
-    ) -> None:
-        self.failure_threshold = max(1, failure_threshold)
-        self.reset_s = reset_s
-        self._clock = clock or time.monotonic
-        self.state = self.CLOSED
-        self._consecutive_failures = 0
-        self._opened_at = 0.0
-        self._probe_at = 0.0
-
-    def allow(self) -> bool:
-        """May a call be attempted now?  Transitions open → half-open when
-        the reset window elapsed (that caller IS the probe; concurrent
-        callers in half-open are refused until the probe resolves).  A
-        probe whose caller died without ever reporting (cancelled task,
-        operator shutdown mid-call) must not wedge the breaker: after
-        another full window in half-open a fresh probe is admitted."""
-        now = self._clock()
-        if self.state == self.OPEN:
-            if now - self._opened_at >= self.reset_s:
-                self.state = self.HALF_OPEN
-                self._probe_at = now
-                return True
-            return False
-        if self.state == self.HALF_OPEN:
-            if now - self._probe_at >= self.reset_s:
-                self._probe_at = now
-                return True
-            return False
-        return True
-
-    def record_success(self) -> None:
-        self._consecutive_failures = 0
-        self.state = self.CLOSED
-
-    def record_failure(self) -> bool:
-        """Returns True when THIS failure opened (or re-opened) the
-        breaker — the caller's cue to count/emit the trip once."""
-        if self.state == self.HALF_OPEN:
-            self.state = self.OPEN
-            self._opened_at = self._clock()
-            return True
-        self._consecutive_failures += 1
-        if (
-            self.state == self.CLOSED
-            and self._consecutive_failures >= self.failure_threshold
-        ):
-            self.state = self.OPEN
-            self._opened_at = self._clock()
-            return True
-        return False
-
-
-class BreakerBoard:
-    """One CircuitBreaker per providerId, created on first use."""
-
-    def __init__(
-        self,
-        failure_threshold: int = 5,
-        reset_s: float = 30.0,
-        clock: Optional[Callable[[], float]] = None,
-    ) -> None:
-        self.failure_threshold = failure_threshold
-        self.reset_s = reset_s
-        self._clock = clock
-        self._breakers: dict[str, CircuitBreaker] = {}
-
-    def for_provider(self, provider_id: Optional[str]) -> CircuitBreaker:
-        pid = provider_id or "template"
-        breaker = self._breakers.get(pid)
-        if breaker is None:
-            breaker = CircuitBreaker(
-                self.failure_threshold, self.reset_s, clock=self._clock
-            )
-            self._breakers[pid] = breaker
-        return breaker
-
-    def states(self) -> dict[str, str]:
-        return {pid: b.state for pid, b in self._breakers.items()}
 
 
 # --------------------------------------------------------------------------
@@ -193,6 +89,11 @@ class ProviderRegistry:
             else:
                 raise ProviderError(f"unknown providerId {pid!r}")
         return backend
+
+    def has(self, provider_id: str) -> bool:
+        """Is a backend (or factory) already registered for this id? —
+        wiring code must not clobber an injected test/real backend."""
+        return provider_id in self._backends or provider_id in self._factories
 
     def known_ids(self) -> list[str]:
         return sorted(
@@ -343,22 +244,119 @@ class TemplateProvider:
         )
 
 
+def replica_set(api_url: str) -> list[Replica]:
+    """Parse a CR's ``apiUrl`` into the replica set it names.
+
+    ``apiUrl`` accepts a single endpoint (the pre-router form) or a
+    comma/whitespace-separated list of them — N serving replicas behind
+    one AIProvider.  Every entry must be scheme-qualified (``http://`` /
+    ``https://`` with a host): once routing multiplies endpoints, a bare
+    ``host:8000`` would fail deep inside urllib with a message naming
+    neither the CR nor the offending entry — reject it HERE with a clear
+    :class:`ProviderError` instead.  Each replica's id is its normalized
+    URL (stable across restarts, readable in spans and metrics)."""
+    replicas: list[Replica] = []
+    seen: set[str] = set()
+    for raw in api_url.replace(",", " ").split():
+        url = raw.rstrip("/")
+        parts = urllib.parse.urlsplit(url)
+        if parts.scheme not in ("http", "https") or not parts.netloc:
+            raise ProviderError(
+                f"invalid apiUrl entry {raw!r}: must be an absolute "
+                "http(s)://host[:port][/path] URL (scheme-qualified; "
+                "comma-separate multiple replicas)"
+            )
+        if url not in seen:
+            seen.add(url)
+            replicas.append(Replica(id=url, url=url))
+    if not replicas:
+        raise ProviderError("apiUrl names no endpoints")
+    return replicas
+
+
+def _completions_url(base: str) -> str:
+    """Accept any of: bare host, .../v1, or a full .../chat/completions
+    URL (the documented OpenAI base is https://api.openai.com/v1)."""
+    url = base.rstrip("/")
+    if url.endswith("/chat/completions"):
+        return url
+    if url.endswith("/v1"):
+        return f"{url}/chat/completions"
+    return f"{url}/v1/chat/completions"
+
+
 class OpenAICompatProvider:
     """OpenAI-compatible chat-completions client (covers ``openai`` and
-    ``ollama`` providerIds).  Blocking urllib runs in a worker thread; retries
-    honour the CR's maxRetries (reference defaults :78-84)."""
+    ``ollama`` providerIds).  Blocking urllib runs in a worker thread;
+    retries honour the CR's maxRetries (reference defaults :78-84).
 
-    def __init__(self, opener: Optional[Callable] = None) -> None:
+    The CR's ``apiUrl`` may name N replicas (comma-separated, or the
+    per-pod DNS names of the headless serving Service): dispatch then
+    runs through an :class:`~operator_tpu.router.EngineRouter` per
+    distinct replica set — consistent-hash affinity on the incident
+    fingerprint / prompt prefix, per-replica breakers, load-fed
+    shedding, and requeue-ONCE failover with the residual deadline
+    (docs/ROBUSTNESS.md "Multi-replica data plane").  Router state (and
+    so breaker/health history) persists across calls per replica set.
+    """
+
+    def __init__(
+        self,
+        opener: Optional[Callable] = None,
+        *,
+        metrics=None,
+        router_vnodes: int = 64,
+        shed_pressure: int = 8,
+        replica_failure_threshold: int = 3,
+        replica_reset_s: float = 10.0,
+        clock: Optional[Callable[[], float]] = None,
+    ) -> None:
         # injectable for tests; defaults to urllib
         self._opener = opener or urllib.request.urlopen
         #: opt-in chaos seam (utils/faultinject.py): consulted before each
-        #: outbound attempt under site "http.provider"
+        #: outbound attempt under site "http.provider" (ctx: attempt,
+        #: replica) — replica kills/partitions inject here
         self.fault_plan = None
+        self._metrics = metrics
+        self._router_vnodes = router_vnodes
+        self._shed_pressure = shed_pressure
+        self._replica_failure_threshold = replica_failure_threshold
+        self._replica_reset_s = replica_reset_s
+        self._clock = clock
+        #: one router per distinct replica set, created on first use —
+        #: breaker state must survive across requests or a dead replica
+        #: would be re-probed by every analysis
+        self._routers: dict[tuple[str, ...], EngineRouter] = {}
+
+    def router_for(self, replicas: list[Replica]) -> EngineRouter:
+        key = tuple(sorted(r.id for r in replicas))
+        router = self._routers.get(key)
+        if router is None:
+            router = EngineRouter(
+                replicas,
+                vnodes=self._router_vnodes,
+                shed_pressure=self._shed_pressure,
+                failure_threshold=self._replica_failure_threshold,
+                reset_s=self._replica_reset_s,
+                clock=self._clock,
+                metrics=self._metrics,
+            )
+            self._routers[key] = router
+        router.fault_plan = self.fault_plan
+        return router
 
     async def generate(self, request: AnalysisRequest) -> AIResponse:
         config = request.provider_config or AIProviderConfig()
         if not config.api_url:
             return AIResponse(error="provider has no apiUrl", provider_id=config.provider_id)
+        try:
+            replicas = replica_set(config.api_url)
+        except ProviderError as exc:
+            # a malformed apiUrl is a CONFIG error, not backend weather:
+            # surface it verbatim (it names the offending entry) instead
+            # of letting urllib produce "unknown url type" noise
+            return AIResponse(error=str(exc), provider_id=config.provider_id,
+                              model_id=config.model_id)
         from ..serving.prompts import build_prompt  # shared with tpu-native path
 
         prompt = build_prompt(request)
@@ -368,15 +366,7 @@ class OpenAICompatProvider:
             "max_tokens": config.max_tokens,
             "temperature": config.temperature,
         }
-        # accept any of: bare host, .../v1, or a full .../chat/completions URL
-        # (the documented OpenAI base is https://api.openai.com/v1)
-        url = config.api_url.rstrip("/")
-        if url.endswith("/chat/completions"):
-            pass
-        elif url.endswith("/v1"):
-            url = f"{url}/chat/completions"
-        else:
-            url = f"{url}/v1/chat/completions"
+        payload_bytes = json.dumps(body).encode()
         headers = {"Content-Type": "application/json"}
         if config.auth_token:
             headers["Authorization"] = f"Bearer {config.auth_token}"
@@ -390,10 +380,16 @@ class OpenAICompatProvider:
         traceparent = current_traceparent()
         if traceparent:
             headers["traceparent"] = traceparent
+        # idempotency key: a deterministic digest of the rendered prompt,
+        # NOT a uuid — at-least-once dispatch (the cross-replica requeue)
+        # stays deduplicatable downstream, and a seeded chaos replay
+        # produces the identical key
+        request_id = request_key(prompt)
+        headers["x-podmortem-request-id"] = request_id
 
-        def call(timeout_s: float) -> AIResponse:
+        def call(url: str, timeout_s: Optional[float]) -> AIResponse:
             req = urllib.request.Request(
-                url, data=json.dumps(body).encode(), headers=headers, method="POST"
+                url, data=payload_bytes, headers=headers, method="POST"
             )
             with self._opener(req, timeout=timeout_s) as resp:
                 payload = json.loads(resp.read().decode())
@@ -410,35 +406,62 @@ class OpenAICompatProvider:
                 ),
             )
 
-        # deadline budget: the CR's per-attempt read timeout never reaches
-        # past the residue, and the retry loop stops once it is spent —
-        # retrying a dead backend must not eat the whole analysis envelope
+        async def send(replica: Replica, attempt: int, budget_s: Optional[float]) -> AIResponse:
+            # the CR's per-attempt read timeout never reaches past the
+            # residual deadline the router hands this attempt
+            timeout_s = float(config.timeout_seconds)
+            if budget_s is not None:
+                timeout_s = min(timeout_s, budget_s)
+            if self.fault_plan is not None:
+                self.fault_plan.apply(
+                    "http.provider", attempt=attempt, replica=replica.id
+                )
+            return await asyncio.to_thread(
+                call, _completions_url(replica.url), timeout_s
+            )
+
+        # deadline budget: ABSOLUTE across the whole dispatch — retries
+        # and cross-replica requeues all spend from one envelope, so
+        # retrying a dead backend can never eat more than the residue
         budget = (
             Deadline.start(request.deadline_s)
             if request.deadline_s is not None
             else None
         )
-        last_error: Optional[str] = None
-        for attempt in range(max(1, config.max_retries)):
-            timeout_s = float(config.timeout_seconds)
-            if budget is not None:
-                residue = budget.remaining()
-                if residue <= 0.0:
-                    return AIResponse(
-                        error=f"deadline exceeded after {attempt} attempt(s): "
-                              f"{last_error or 'no attempt completed in budget'}",
-                        provider_id=config.provider_id, model_id=config.model_id,
-                        deadline_outcome="deadline-exceeded",
-                    )
-                timeout_s = min(timeout_s, residue)
-            try:
-                if self.fault_plan is not None:
-                    self.fault_plan.apply("http.provider", attempt=attempt)
-                return await asyncio.to_thread(call, timeout_s)
-            except (urllib.error.URLError, OSError, KeyError, ValueError) as exc:
-                last_error = str(exc)
-                log.warning("provider %s attempt %d failed: %s",
-                            config.provider_id, attempt + 1, exc)
-                await asyncio.sleep(min(2**attempt * 0.2, 2.0))
-        return AIResponse(error=f"provider failed after retries: {last_error}",
-                          provider_id=config.provider_id, model_id=config.model_id)
+        router = self.router_for(replicas)
+        # affinity: recurrences follow the incident fingerprint (recall
+        # caches are per replica), first sightings follow the shared
+        # prompt prefix (the prefix-cache reuse unit)
+        affinity = EngineRouter.affinity_key(
+            prefix=prompt, fingerprint=request.fingerprint
+        )
+        try:
+            outcome = await router.dispatch(
+                send,
+                key=affinity,
+                request_id=request_id,
+                deadline=budget,
+                attempts=max(1, config.max_retries),
+                tokens=max(1, config.max_tokens),
+            )
+        except RouterError as exc:
+            deadline_spent = budget is not None and budget.remaining() <= 0.0
+            last = exc.last_error
+            detail = f": {last}" if last is not None else ""
+            return AIResponse(
+                error=(
+                    f"deadline exceeded during provider dispatch{detail}"
+                    if deadline_spent
+                    else f"provider failed after retries ({exc}){detail}"
+                ),
+                provider_id=config.provider_id,
+                model_id=config.model_id,
+                deadline_outcome="deadline-exceeded" if deadline_spent else None,
+                replica_id=exc.tried[-1] if exc.tried else None,
+            )
+        response: AIResponse = outcome.response
+        # the routed replica surfaces in the response metadata — the
+        # flight recorder's span attrs and status entries both read it
+        response.replica_id = outcome.replica_id
+        response.requeues = outcome.requeues
+        return response

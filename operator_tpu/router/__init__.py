@@ -1,0 +1,40 @@
+"""Resilient multi-engine data plane (docs/ROBUSTNESS.md).
+
+A health-gated, affinity-aware failover router in front of N
+``ServingEngine`` replicas: consistent-hash placement on prompt prefix /
+incident fingerprint (``ring.py``), per-replica breakers + passive
+scoring + load reports (``health.py``), and requeue-once failover with
+residual deadlines (``core.py``).
+"""
+
+from .core import (
+    EngineRouter,
+    Replica,
+    RouteDecision,
+    RouteOutcome,
+    RouterError,
+    request_key,
+)
+from .health import (
+    BreakerBoard,
+    CircuitBreaker,
+    HealthBoard,
+    ReplicaHealth,
+    ReplicaLoad,
+)
+from .ring import HashRing
+
+__all__ = [
+    "BreakerBoard",
+    "CircuitBreaker",
+    "EngineRouter",
+    "HashRing",
+    "HealthBoard",
+    "Replica",
+    "ReplicaHealth",
+    "ReplicaLoad",
+    "RouteDecision",
+    "RouteOutcome",
+    "RouterError",
+    "request_key",
+]

@@ -1,0 +1,338 @@
+"""Health gating for the multi-replica data plane.
+
+Two layers, composed by :class:`HealthBoard`:
+
+- **circuit breakers** — :class:`CircuitBreaker` / :class:`BreakerBoard`
+  moved here from ``operator/providers.py`` (which re-exports them
+  unchanged): the consecutive-failure state machine that turns a dying
+  backend from "every call burns a deadline budget" into "calls skip it
+  until a half-open probe succeeds".  The board is keyed generically
+  (:meth:`BreakerBoard.for_key`) so one mechanism serves both the
+  per-provider breakers the pipeline has had since PR 1 and the
+  per-REPLICA breakers the router adds — a sick replica drains before it
+  hard-fails, while its siblings keep serving.
+- **passive scoring + load reports** — :class:`ReplicaHealth` keeps an
+  EWMA of observed latency, a consecutive-error count, an optional
+  probe verdict (``/healthz`` polls or an injected check), and the
+  replica's last :class:`ReplicaLoad` report (queue depth + roofline
+  decode estimate from ``ServingEngine.load_report``).  The router's
+  shed decision reads these; nothing here blocks.
+
+The clock is injectable end to end so chaos tests drive every state
+machine deterministically (tests/test_router.py).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+__all__ = [
+    "CircuitBreaker",
+    "BreakerBoard",
+    "ReplicaHealth",
+    "ReplicaLoad",
+    "HealthBoard",
+]
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker for one backend (provider or replica).
+
+    States: ``closed`` (calls flow) → after ``failure_threshold``
+    consecutive failures ``open`` (calls skipped: a dead backend must stop
+    burning the deadline budget — the pipeline falls through the existing
+    degradation ladder and stores pattern-only results) → after
+    ``reset_s`` ``half-open`` (exactly ONE probe flows) → probe success
+    closes, probe failure re-opens for another window.
+
+    The clock is injectable so chaos tests drive the state machine
+    deterministically (tests/test_chaos.py).
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half-open"
+
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        reset_s: float = 30.0,
+        clock: Optional[Callable[[], float]] = None,
+    ) -> None:
+        self.failure_threshold = max(1, failure_threshold)
+        self.reset_s = reset_s
+        self._clock = clock or time.monotonic
+        self.state = self.CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_at = 0.0
+
+    def allow(self) -> bool:
+        """May a call be attempted now?  Transitions open → half-open when
+        the reset window elapsed (that caller IS the probe; concurrent
+        callers in half-open are refused until the probe resolves).  A
+        probe whose caller died without ever reporting (cancelled task,
+        operator shutdown mid-call) must not wedge the breaker: after
+        another full window in half-open a fresh probe is admitted."""
+        now = self._clock()
+        if self.state == self.OPEN:
+            if now - self._opened_at >= self.reset_s:
+                self.state = self.HALF_OPEN
+                self._probe_at = now
+                return True
+            return False
+        if self.state == self.HALF_OPEN:
+            if now - self._probe_at >= self.reset_s:
+                self._probe_at = now
+                return True
+            return False
+        return True
+
+    def can_attempt(self) -> bool:
+        """PURE read: would :meth:`allow` admit a call now?  No state
+        transition and no probe-token consumption — the router's health
+        FILTER asks this about every replica on every route; only the
+        caller actually about to dispatch consumes via ``allow()``
+        (otherwise routing traffic whose affinity lies elsewhere would
+        burn a recovering replica's single half-open probe and starve it
+        of readmission)."""
+        now = self._clock()
+        if self.state == self.OPEN:
+            return now - self._opened_at >= self.reset_s
+        if self.state == self.HALF_OPEN:
+            return now - self._probe_at >= self.reset_s
+        return True
+
+    def record_success(self) -> None:
+        self._consecutive_failures = 0
+        self.state = self.CLOSED
+
+    def record_failure(self) -> bool:
+        """Returns True when THIS failure opened (or re-opened) the
+        breaker — the caller's cue to count/emit the trip once."""
+        if self.state == self.HALF_OPEN:
+            self.state = self.OPEN
+            self._opened_at = self._clock()
+            return True
+        self._consecutive_failures += 1
+        if (
+            self.state == self.CLOSED
+            and self._consecutive_failures >= self.failure_threshold
+        ):
+            self.state = self.OPEN
+            self._opened_at = self._clock()
+            return True
+        return False
+
+
+class BreakerBoard:
+    """One CircuitBreaker per key, created on first use.  Keys are
+    provider ids on the pipeline's board and replica ids on the
+    router's — same machinery, different granularity."""
+
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        reset_s: float = 30.0,
+        clock: Optional[Callable[[], float]] = None,
+    ) -> None:
+        self.failure_threshold = failure_threshold
+        self.reset_s = reset_s
+        self._clock = clock
+        self._breakers: dict[str, CircuitBreaker] = {}
+
+    def for_key(self, key: str) -> CircuitBreaker:
+        breaker = self._breakers.get(key)
+        if breaker is None:
+            breaker = CircuitBreaker(
+                self.failure_threshold, self.reset_s, clock=self._clock
+            )
+            self._breakers[key] = breaker
+        return breaker
+
+    def for_provider(self, provider_id: Optional[str]) -> CircuitBreaker:
+        """The pipeline's historical entry point (None → "template")."""
+        return self.for_key(provider_id or "template")
+
+    def states(self) -> dict[str, str]:
+        return {key: b.state for key, b in self._breakers.items()}
+
+
+@dataclass
+class ReplicaLoad:
+    """One replica's self-reported load — the feedback the shed decision
+    reads.  Produced by ``ServingEngine.load_report()`` and carried on
+    ``GET /healthz`` (serving/httpserver.py); all fields degrade to
+    "unknown = no pressure" so a replica that never reported is routable.
+    """
+
+    #: requests queued ahead of admission (ServingEngine._queue)
+    queue_depth: int = 0
+    #: admitted + popped-but-unadmitted requests riding the engine now
+    inflight: int = 0
+    #: measured/roofline seconds per decoded token (0.0 = unknown) — the
+    #: admission roofline's own estimate, so the router's residual-fit
+    #: check agrees with what the replica itself would clamp to
+    decode_token_s: float = 0.0
+    #: the engine's supervisor exhausted its reset budget (serving cold
+    #: until the window drains) — treated as not-ready
+    gave_up: bool = False
+
+    def pressure(self) -> int:
+        """Scalar queue pressure used for least-loaded comparison."""
+        return self.queue_depth + self.inflight
+
+    def est_wait_s(self, tokens: int) -> float:
+        """Crude roofline-queue estimate of seconds until a NEW request
+        of ``tokens`` decode tokens completes here: everything already
+        riding the engine plus this request, at the replica's own
+        per-token estimate.  0.0 when the rate is unknown."""
+        if self.decode_token_s <= 0.0:
+            return 0.0
+        return self.decode_token_s * tokens * (1 + self.pressure())
+
+    def to_dict(self) -> dict:
+        return {
+            "queueDepth": self.queue_depth,
+            "inflight": self.inflight,
+            "decodeTokenS": round(self.decode_token_s, 6),
+            "gaveUp": self.gave_up,
+        }
+
+    @classmethod
+    def parse(cls, data: dict) -> "ReplicaLoad":
+        return cls(
+            queue_depth=int(data.get("queueDepth") or 0),
+            inflight=int(data.get("inflight") or 0),
+            decode_token_s=float(data.get("decodeTokenS") or 0.0),
+            gave_up=bool(data.get("gaveUp")),
+        )
+
+
+class ReplicaHealth:
+    """Passive health of one replica: EWMA latency, consecutive errors,
+    last probe verdict, last load report."""
+
+    #: EWMA smoothing for observed latency (~last 10 calls dominate)
+    ALPHA = 0.2
+
+    def __init__(self, clock: Optional[Callable[[], float]] = None) -> None:
+        self._clock = clock or time.monotonic
+        self.latency_ms: float = 0.0
+        self.consecutive_errors: int = 0
+        self.total_errors: int = 0
+        self.total_calls: int = 0
+        #: active-probe verdict; None = never probed (treated as ready —
+        #: passive scoring and the breaker carry the gate until the first
+        #: probe lands)
+        self.probe_ready: Optional[bool] = None
+        self.probed_at: float = 0.0
+        self.load: ReplicaLoad = ReplicaLoad()
+        self.load_at: float = 0.0
+
+    def observe(self, *, ok: bool, latency_s: float = 0.0) -> None:
+        self.total_calls += 1
+        if ok:
+            self.consecutive_errors = 0
+            sample = latency_s * 1e3
+            self.latency_ms = (
+                sample if self.latency_ms == 0.0
+                else (1 - self.ALPHA) * self.latency_ms + self.ALPHA * sample
+            )
+        else:
+            self.consecutive_errors += 1
+            self.total_errors += 1
+
+    def report_load(self, load: ReplicaLoad) -> None:
+        self.load = load
+        self.load_at = self._clock()
+
+    def mark_probe(self, ready: bool) -> None:
+        self.probe_ready = ready
+        self.probed_at = self._clock()
+
+    @property
+    def ready(self) -> bool:
+        """Probe-level readiness: an explicit failing probe or a gave-up
+        load report excludes the replica from routing until it recovers."""
+        if self.load.gave_up:
+            return False
+        return self.probe_ready is not False
+
+    def to_dict(self) -> dict:
+        return {
+            "latencyMs": round(self.latency_ms, 3),
+            "consecutiveErrors": self.consecutive_errors,
+            "totalErrors": self.total_errors,
+            "totalCalls": self.total_calls,
+            "probeReady": self.probe_ready,
+            "load": self.load.to_dict(),
+        }
+
+
+class HealthBoard:
+    """Per-replica health + breaker state behind one gate.
+
+    Two admission questions, deliberately split: ``can_route`` is the
+    PURE filter (no breaker transition, no probe consumption) the router
+    asks about every replica while ranking candidates; ``admit`` is the
+    consuming form the dispatcher calls for the ONE replica it is about
+    to send to — in half-open, that dispatch IS the probe.  Passive
+    observations feed the breaker, so a replica that dies without ever
+    failing a probe still drains within ``failure_threshold`` calls."""
+
+    def __init__(
+        self,
+        *,
+        failure_threshold: int = 3,
+        reset_s: float = 10.0,
+        clock: Optional[Callable[[], float]] = None,
+    ) -> None:
+        self._clock = clock or time.monotonic
+        self.breakers = BreakerBoard(failure_threshold, reset_s, clock=clock)
+        self._health: dict[str, ReplicaHealth] = {}
+
+    def for_replica(self, replica_id: str) -> ReplicaHealth:
+        health = self._health.get(replica_id)
+        if health is None:
+            health = ReplicaHealth(clock=self._clock)
+            self._health[replica_id] = health
+        return health
+
+    def can_route(self, replica_id: str) -> bool:
+        """Pure filter: would an attempt be admitted now?  Never mutates
+        breaker state (see class doc)."""
+        return (
+            self.for_replica(replica_id).ready
+            and self.breakers.for_key(replica_id).can_attempt()
+        )
+
+    def admit(self, replica_id: str) -> bool:
+        """CONSUME admission for a call about to dispatch: transitions
+        open→half-open when the reset window elapsed (this caller is the
+        probe) and claims the probe token."""
+        return (
+            self.for_replica(replica_id).ready
+            and self.breakers.for_key(replica_id).allow()
+        )
+
+    def observe_success(self, replica_id: str, latency_s: float) -> None:
+        self.for_replica(replica_id).observe(ok=True, latency_s=latency_s)
+        self.breakers.for_key(replica_id).record_success()
+
+    def observe_failure(self, replica_id: str) -> bool:
+        """Returns True when this failure OPENED the replica's breaker
+        (the caller's cue to count the exclusion once)."""
+        self.for_replica(replica_id).observe(ok=False)
+        return self.breakers.for_key(replica_id).record_failure()
+
+    def states(self) -> dict[str, dict]:
+        return {
+            replica_id: {
+                "breaker": self.breakers.for_key(replica_id).state,
+                **health.to_dict(),
+            }
+            for replica_id, health in sorted(self._health.items())
+        }

@@ -228,6 +228,10 @@ class AnalysisRequest:
     #: near-miss recalls from incident memory, best first — prompt
     #: construction appends them under a bounded char budget
     prior_incidents: list[PriorIncident] = field(default_factory=list)
+    #: the failure-class fingerprint digest (memory/fingerprint.py) when
+    #: incident memory computed one — the router's first-choice affinity
+    #: key, so recurrences land on the replica whose recall cache is hot
+    fingerprint: Optional[str] = None
 
     def to_dict(self) -> dict[str, Any]:
         return to_dict(self)
@@ -253,6 +257,12 @@ class AIResponse:
     #: to fit the residual budget) | "deadline-exceeded" (no AI text;
     #: pipeline degrades to pattern-only).  None = budget not involved.
     deadline_outcome: Optional[str] = None
+    #: which serving replica produced this response (operator_tpu/router/)
+    #: — flight-recorder spans and routing forensics read it.  None =
+    #: unrouted backend (template, in-process tpu-native).
+    replica_id: Optional[str] = None
+    #: cross-replica requeues the request survived before completing
+    requeues: int = 0
 
     def to_dict(self) -> dict[str, Any]:
         return to_dict(self)

@@ -73,6 +73,14 @@ def main() -> None:
                 api_token=os.environ.get("OPERATOR_TPU_API_TOKEN") or None,
                 embedder=embedder,
                 analysis_backend=analysis_backend,
+                # stable replica identity for the failover router's
+                # /healthz polls: the serving Deployment injects POD_NAME
+                # (downward API); hostname otherwise
+                replica_id=(
+                    os.environ.get("SERVING_REPLICA_ID")
+                    or os.environ.get("POD_NAME")
+                    or None
+                ),
             )
         )
     except KeyboardInterrupt:

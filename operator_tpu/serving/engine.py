@@ -1646,6 +1646,24 @@ class ServingEngine:
             return
         self._loop.call_soon_threadsafe(callback, token_ids)
 
+    def load_report(self):
+        """This replica's load, in the shape the data-plane router's shed
+        decision reads (``operator_tpu/router/health.py: ReplicaLoad``):
+        queue pressure, the admission roofline's own per-token estimate
+        (so the router's residual-fit check agrees with what THIS replica
+        would clamp a deadline to), and whether the supervisor gave up.
+        Cheap loop-side reads — approximate under concurrent decode is
+        fine, the router treats it as feedback, not truth.  Served on
+        ``GET /healthz`` (serving/httpserver.py) next to the replica id."""
+        from ..router.health import ReplicaLoad
+
+        return ReplicaLoad(
+            queue_depth=self._queue.qsize(),
+            inflight=len(self._inflight) + len(self._pending),
+            decode_token_s=self.generator.decode_token_estimate_s(),
+            gave_up=self._gave_up,
+        )
+
     async def start(self) -> None:
         if self._task is None:
             self._loop = asyncio.get_running_loop()

@@ -18,7 +18,10 @@ operator/httpserver.py):
 - ``POST /v1/embeddings``        — the pattern-matching embedder (MiniLM
   when an encoder checkpoint is mounted, lexical hashing otherwise)
   exposed OpenAI-style for log-similarity tooling
-- ``GET  /healthz``              — liveness for probes
+- ``GET  /healthz``              — liveness for probes, plus this
+  replica's identity and load report (queue depth, roofline decode
+  estimate, supervisor gave-up flag) for the failover router
+  (operator_tpu/router/)
 
 ``stream: true`` serves Server-Sent Events: one OpenAI-format chunk per
 decode BLOCK (the engine's host-sync granularity — per-token events
@@ -159,9 +162,20 @@ class CompletionServer:
         analysis_backend: Optional[Any] = None,  # .generate(AnalysisRequest)
         tracer: Optional[Any] = None,  # obs.Tracer for inbound traceparent
         drain_grace_s: float = 30.0,  # OperatorConfig.serving_drain_grace_s
+        replica_id: Optional[str] = None,
     ) -> None:
         self.engine = engine
         self.model_id = model_id
+        #: this replica's stable identity in the multi-engine data plane
+        #: (operator_tpu/router/): surfaced on GET /healthz next to the
+        #: engine's load report so the failover router can poll one
+        #: endpoint for liveness, identity, and shed feedback.  The
+        #: deployment injects POD_NAME; "" falls back to hostname.
+        if not replica_id:
+            import socket
+
+            replica_id = socket.gethostname()
+        self.replica_id = replica_id
         #: wire parity with the reference's ai-interface contract
         #: (AIInterfaceRestClient.java:37-39): when a backend is wired,
         #: POST /api/v1/analysis/analyze serves AnalysisRequest->AIResponse
@@ -384,7 +398,18 @@ class CompletionServer:
                      accept: str = ""):
         path = path.split("?", 1)[0]
         if method == "GET" and path == "/healthz":
-            return 200, {"status": "ok", "uptime_s": round(time.time() - self._started, 1)}
+            # identity + load report for the data-plane router
+            # (operator_tpu/router/): one poll answers liveness, WHO this
+            # replica is, and how loaded it is — queue depth and the
+            # admission roofline's per-token estimate feed the router's
+            # shed decision, gaveUp excludes a supervisor-bricked engine
+            load = self.engine.load_report()
+            return 200, {
+                "status": "degraded" if load.gave_up else "ok",
+                "uptime_s": round(time.time() - self._started, 1),
+                "replica": self.replica_id,
+                "load": load.to_dict(),
+            }
         if method == "GET" and path == "/metrics.json":
             # per-stage latency percentiles (prefill, decode_step, ...) from
             # the engine's registry — the operator endpoint's twin for the
@@ -915,11 +940,13 @@ async def serve_forever(
     api_token: Optional[str] = None,
     embedder: Optional[Any] = None,
     analysis_backend: Optional[Any] = None,
+    replica_id: Optional[str] = None,
 ) -> None:
     """Run the completion API until cancelled (SIGINT/SIGTERM via CLI)."""
     server = CompletionServer(
         engine, model_id=model_id, host=host, port=port, api_token=api_token,
         embedder=embedder, analysis_backend=analysis_backend,
+        replica_id=replica_id,
     )
     await server.start()
     try:

@@ -61,6 +61,25 @@ class OperatorConfig:
     breaker_failure_threshold: int = 5
     breaker_reset_s: float = 30.0
 
+    # --- multi-replica data plane (operator_tpu/router/, docs/ROBUSTNESS.md)
+    # the failover router in front of N serving replicas: an AIProvider
+    # apiUrl naming several endpoints (comma-separated, or the per-pod DNS
+    # of the headless serving Service) is dispatched with consistent-hash
+    # affinity, per-replica breakers, load-fed shedding, and requeue-ONCE
+    # failover carrying the residual deadline
+    router_vnodes: int = 64
+    # queue pressure (queued + inflight) past which the affinity owner is
+    # considered overloaded and the router sheds to a lighter replica
+    router_shed_pressure: int = 8
+    # per-REPLICA breaker: tighter than the per-provider one — with N
+    # replicas a sick one should drain fast (siblings absorb the traffic),
+    # and its half-open probe re-admits it quickly once healthy
+    router_replica_failure_threshold: int = 3
+    router_replica_reset_s: float = 10.0
+    # this serving replica's identity on GET /healthz ("" = POD_NAME, then
+    # hostname) — what the router's probes and AIResponse.replica_id carry
+    serving_replica_id: str = ""
+
     # --- HA / survivable control plane (docs/ROBUSTNESS.md) ----------------
     # lease-based leader election (operator/lease.py): watcher, reconcilers,
     # pattern sync, and the pipeline run ONLY while this replica holds the

@@ -19,7 +19,13 @@ Seams (each an opt-in ``fault_plan`` attribute, zero cost when ``None``):
 - :class:`operator.patternsync.GitSyncService` — subprocess git verbs
   (``git.clone`` / ``git.fetch`` / ...);
 - :class:`operator.providers.OpenAICompatProvider` — each outbound HTTP
-  attempt (``http.provider``);
+  attempt (``http.provider``, ctx ``attempt`` + ``replica``: a rule
+  matching one replica id is a replica kill, a rule matching every
+  attempt against it is a partition);
+- :class:`router.core.EngineRouter` — each routed dispatch attempt
+  (``router.dispatch``, ctx ``replica`` + ``attempt``) — the
+  transport-agnostic replica-kill/partition seam for the multi-engine
+  data plane;
 - :class:`serving.engine.BatchedGenerator.step` — the engine step loop
   (``engine.step``: stalls and simulated device errors).
 

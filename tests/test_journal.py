@@ -1,0 +1,177 @@
+"""The shared durable-journal helper (utils/journal.py): torn-line
+tolerance, atomic compaction, and the writer-thread mode's ordering +
+durability contracts — the discipline both the incident store and the
+claim ledger now ride (their suites exercise the adopters end to end)."""
+
+import json
+import os
+
+from operator_tpu.utils.journal import Journal
+
+
+def _records(path):
+    out = []
+    with open(path, encoding="utf-8") as handle:
+        for line in handle:
+            if line.strip():
+                out.append(json.loads(line))
+    return out
+
+
+class TestSyncMode:
+    def test_append_load_roundtrip(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        journal = Journal(path, label="test")
+        journal.open()
+        for i in range(5):
+            journal.append({"op": "put", "i": i})
+        journal.close()
+
+        seen = []
+        reloaded = Journal(path, label="test")
+        assert reloaded.load(seen.append) == 5
+        assert [r["i"] for r in seen] == [0, 1, 2, 3, 4]
+        assert reloaded.lines == 5
+
+    def test_torn_tail_line_is_skipped_not_fatal(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        journal = Journal(path, label="test")
+        journal.open()
+        journal.append({"op": "put", "i": 0})
+        journal.append({"op": "put", "i": 1})
+        journal.close()
+        # simulate a crash mid-append: a torn, non-JSON tail
+        with open(path, "a", encoding="utf-8") as handle:
+            handle.write('{"op": "put", "i": 2')
+
+        seen = []
+        Journal(path, label="test").load(seen.append)
+        assert [r["i"] for r in seen] == [0, 1]
+
+    def test_replay_raising_keyerror_counts_as_dropped(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        journal = Journal(path, label="test")
+        journal.open()
+        journal.append({"op": "unknown"})
+        journal.append({"op": "put", "i": 1})
+        journal.close()
+
+        seen = []
+
+        def replay(record):
+            if record["op"] != "put":
+                raise KeyError(record["op"])
+            seen.append(record)
+
+        assert Journal(path, label="test").load(replay) == 1
+        assert [r["i"] for r in seen] == [1]
+
+    def test_compact_rewrites_atomically_and_resets_lines(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        journal = Journal(path, label="test")
+        journal.open()
+        for i in range(100):
+            journal.append({"op": "touch", "i": i})
+        journal.compact([{"op": "put", "i": "live"}])
+        assert journal.lines == 1
+        # the handle reopened on the NEW file: post-compaction appends land
+        journal.append({"op": "touch", "i": "after"})
+        journal.close()
+        ops = _records(path)
+        assert [r["i"] for r in ops] == ["live", "after"]
+        assert not os.path.exists(path + ".tmp")
+
+    def test_pathless_journal_is_inert(self):
+        journal = Journal(None)
+        journal.open()
+        journal.append({"op": "put"})
+        journal.compact([])
+        journal.flush()
+        journal.close()
+        assert journal.load(lambda r: None) == 0
+
+
+class TestWriterThreadMode:
+    def test_close_shuts_down_the_writer_thread(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        journal = Journal(path, label="test", async_writes=True)
+        journal.open()
+        assert journal._writer is not None
+        journal.close()
+        assert journal._writer is None, "closed journal must not park a thread"
+        # the reload path (close -> open) restarts the writer
+        journal.open()
+        journal.append({"op": "again"}, wait=True)
+        journal.close()
+        assert [r["op"] for r in _records(path)] == ["again"]
+
+    def test_async_appends_preserve_order(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        journal = Journal(path, label="test", async_writes=True)
+        journal.open()
+        for i in range(50):
+            journal.append({"i": i})
+        journal.flush()
+        assert [r["i"] for r in _records(path)] == list(range(50))
+        journal.close()
+
+    def test_wait_true_is_durable_before_return(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        journal = Journal(path, label="test", async_writes=True)
+        journal.open()
+        journal.append({"op": "claim"}, wait=True)
+        # no flush barrier: the waited append is ALREADY on disk
+        assert [r["op"] for r in _records(path)] == ["claim"]
+        journal.close()
+
+    def test_compact_orders_with_surrounding_appends(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        journal = Journal(path, label="test", async_writes=True)
+        journal.open()
+        journal.append({"op": "before"})
+        journal.compact([{"op": "kept"}])
+        journal.append({"op": "after"})
+        journal.flush()
+        # single writer thread: compact supersedes "before", "after" lands
+        # in the NEW file through the reopened handle
+        assert [r["op"] for r in _records(path)] == ["kept", "after"]
+        journal.close()
+
+    def test_abandon_discards_already_queued_io(self, tmp_path):
+        """The deposed-leader hazard: a compaction QUEUED before abandon()
+        must not execute after it — a stale os.replace would clobber the
+        journal the new leader is writing."""
+        import threading
+
+        path = str(tmp_path / "j.jsonl")
+        journal = Journal(path, label="test", async_writes=True)
+        journal.open()
+        journal.append({"op": "kept"}, wait=True)
+        gate = threading.Event()
+        # wedge the writer thread (the NFS-stall stand-in), then queue a
+        # compaction and an append BEHIND the wedge
+        journal._writer.submit(gate.wait)
+        journal.compact([{"op": "stale-compaction"}])
+        journal.append({"op": "stale-append"})
+        journal.abandon()   # depose: flag set while the jobs are queued
+        gate.set()          # storage unwedges; queued jobs now run
+        journal.flush()
+        assert [r["op"] for r in _records(path)] == ["kept"]
+        journal.open()
+        journal.close()
+
+    def test_abandon_discards_later_writes(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        journal = Journal(path, label="test", async_writes=True)
+        journal.open()
+        journal.append({"op": "kept"}, wait=True)
+        journal.abandon()
+        journal.append({"op": "lost"})
+        journal.compact([{"op": "lost-too"}])
+        journal.flush()
+        assert [r["op"] for r in _records(path)] == ["kept"]
+        # reopening resumes writes (the re-acquired-leadership path)
+        journal.open()
+        journal.append({"op": "resumed"}, wait=True)
+        assert [r["op"] for r in _records(path)] == ["kept", "resumed"]
+        journal.close()

@@ -1,0 +1,653 @@
+"""The multi-replica data plane (operator_tpu/router/): hash-ring
+stability, breaker-gated exclusion, load-fed shedding, residual-deadline
+failover — and the acceptance chaos scenarios: a replica killed mid-stream
+with the request completing on a survivor (byte-identical across two
+seeded replays, exactly-once effects), and a seeded overload storm that
+sheds to the least-loaded healthy replica with zero rejections while any
+replica has headroom."""
+
+import asyncio
+import json
+import urllib.error
+
+import pytest
+
+from operator_tpu.obs import FlightRecorder, Tracer
+from operator_tpu.operator.kubeapi import FakeKubeApi
+from operator_tpu.operator.pipeline import AnalysisPipeline
+from operator_tpu.operator.providers import (
+    OpenAICompatProvider,
+    ProviderError,
+    default_registry,
+    replica_set,
+)
+from operator_tpu.patterns.engine import PatternEngine
+from operator_tpu.router import (
+    EngineRouter,
+    HashRing,
+    Replica,
+    ReplicaLoad,
+    RouterError,
+)
+from operator_tpu.schema import (
+    AIProvider,
+    AIProviderRef,
+    AIProviderSpec,
+    LabelSelector,
+    ObjectMeta,
+    Podmortem,
+    PodmortemSpec,
+)
+from operator_tpu.schema.analysis import (
+    AIProviderConfig,
+    AnalysisRequest,
+    AnalysisResult,
+)
+from operator_tpu.utils.config import OperatorConfig
+from operator_tpu.utils.deadline import Deadline
+from operator_tpu.utils.faultinject import FaultPlan, raise_
+from operator_tpu.utils.timing import MetricsRegistry
+
+from test_watcher_pipeline import failed_pod
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# --------------------------------------------------------------------------
+# hash ring
+# --------------------------------------------------------------------------
+
+
+class TestHashRing:
+    KEYS = [f"key-{i}" for i in range(300)]
+
+    def test_preference_is_distinct_and_complete(self):
+        ring = HashRing(["r1", "r2", "r3"], vnodes=32)
+        for key in self.KEYS[:20]:
+            order = ring.preference(key)
+            assert sorted(order) == ["r1", "r2", "r3"]
+            assert order[0] == ring.owner(key)
+
+    def test_distribution_is_roughly_even(self):
+        ring = HashRing(["r1", "r2", "r3", "r4"], vnodes=64)
+        counts: dict = {}
+        for key in self.KEYS:
+            counts[ring.owner(key)] = counts.get(ring.owner(key), 0) + 1
+        # 300 keys over 4 replicas: no replica should own an extreme share
+        assert all(20 <= n <= 150 for n in counts.values()), counts
+
+    def test_remove_only_remaps_the_dead_replicas_keys(self):
+        ring = HashRing(["r1", "r2", "r3", "r4"], vnodes=64)
+        before = {key: ring.owner(key) for key in self.KEYS}
+        ring.remove("r2")
+        for key, owner in before.items():
+            if owner != "r2":
+                # consistent hashing: survivors keep every key they owned
+                assert ring.owner(key) == owner
+            else:
+                assert ring.owner(key) != "r2"
+
+    def test_add_only_steals_keys_for_the_new_replica(self):
+        ring = HashRing(["r1", "r2", "r3"], vnodes=64)
+        before = {key: ring.owner(key) for key in self.KEYS}
+        ring.add("r4")
+        moved = [key for key in self.KEYS if ring.owner(key) != before[key]]
+        assert moved, "a new replica must take over part of the space"
+        assert all(ring.owner(key) == "r4" for key in moved)
+        # ~1/4 of the space moves, not half the ring
+        assert len(moved) < len(self.KEYS) // 2
+
+    def test_failover_order_stable_under_exclusion(self):
+        ring = HashRing(["r1", "r2", "r3"], vnodes=32)
+        order = ring.preference("some-key")
+        # the failover candidate is simply the next distinct owner on the
+        # walk — what dispatch uses when order[0] is excluded
+        assert order[1] in ("r1", "r2", "r3") and order[1] != order[0]
+
+
+# --------------------------------------------------------------------------
+# health gating + placement
+# --------------------------------------------------------------------------
+
+
+def _key_preferring(router: EngineRouter, replica_id: str) -> str:
+    for i in range(1000):
+        key = f"probe-{i}"
+        decision = router.route(key)
+        assert decision is not None
+        if decision.replica.id == replica_id:
+            return key
+    raise AssertionError(f"no key prefers {replica_id}")
+
+
+class TestHealthGating:
+    def _router(self, clock, **kw):
+        kw.setdefault("failure_threshold", 2)
+        kw.setdefault("reset_s", 10.0)
+        return EngineRouter(
+            ["a", "b"], clock=lambda: clock["t"],
+            metrics=MetricsRegistry(), **kw,
+        )
+
+    def test_breaker_gated_exclusion_and_half_open_readmission(self):
+        clock = {"t": 0.0}
+        router = self._router(clock)
+        key = _key_preferring(router, "a")
+        # two consecutive failures open a's breaker
+        assert router.health.observe_failure("a") is False
+        assert router.health.observe_failure("a") is True
+        decision = router.route(key)
+        assert decision.replica.id == "b", "open breaker must exclude a"
+        # reset window elapses: the half-open probe readmits a
+        clock["t"] += 11.0
+        assert router.route(key).replica.id == "a"
+        # route() is a PURE filter: ranking a (here, and for traffic whose
+        # affinity lies elsewhere) must NOT consume the single half-open
+        # probe token — only a dispatch does
+        for _ in range(5):
+            router.route(key)
+        assert router.health.breakers.for_key("a").state == "open"
+
+        async def send_ok(replica, attempt, budget_s):
+            return replica.id
+
+        async def send_fail(replica, attempt, budget_s):
+            if replica.id == "a":
+                raise RuntimeError("probe fails")
+            return replica.id
+
+        # the dispatch IS the probe; its failure re-opens and traffic
+        # returns to b (the failover) immediately
+        outcome = run(router.dispatch(send_fail, key=key, attempts=2))
+        assert outcome.response == "b" and outcome.requeues == 1
+        assert router.health.breakers.for_key("a").state == "open"
+        # within the fresh window a stays excluded even for its own key
+        assert router.route(key).replica.id == "b"
+        # next window: a successful probe dispatch closes the breaker
+        clock["t"] += 11.0
+        outcome = run(router.dispatch(send_ok, key=key, attempts=1))
+        assert outcome.response == "a"
+        assert router.health.breakers.for_key("a").state == "closed"
+
+    def test_failing_probe_and_gave_up_load_exclude(self):
+        clock = {"t": 0.0}
+        router = self._router(clock)
+        key = _key_preferring(router, "a")
+        router.mark_probe("a", False)
+        assert router.route(key).replica.id == "b"
+        router.mark_probe("a", True)
+        assert router.route(key).replica.id == "a"
+        # a supervisor-bricked engine reports gaveUp on /healthz
+        router.report_load("a", ReplicaLoad(gave_up=True))
+        assert router.route(key).replica.id == "b"
+        router.report_load("a", ReplicaLoad())
+        assert router.route(key).replica.id == "a"
+
+    def test_no_healthy_replica_returns_none(self):
+        clock = {"t": 0.0}
+        router = self._router(clock)
+        for _ in range(2):
+            router.health.observe_failure("a")
+            router.health.observe_failure("b")
+        assert router.route("anything") is None
+
+
+class TestShedding:
+    def test_sheds_to_least_loaded_when_owner_overloaded(self):
+        router = EngineRouter(
+            ["a", "b", "c"], shed_pressure=4, metrics=MetricsRegistry()
+        )
+        key = _key_preferring(router, "a")
+        router.report_load("a", ReplicaLoad(queue_depth=6))
+        router.report_load("b", ReplicaLoad(queue_depth=2))
+        router.report_load("c", ReplicaLoad(queue_depth=1))
+        decision = router.route(key)
+        assert decision.shed and decision.replica.id == "c"
+        assert decision.affinity_owner == "a"
+        # owner back under the threshold: affinity wins again
+        router.report_load("a", ReplicaLoad(queue_depth=1))
+        decision = router.route(key)
+        assert not decision.shed and decision.replica.id == "a"
+
+    def test_roofline_residual_fit_sheds_even_under_threshold(self):
+        router = EngineRouter(
+            ["a", "b"], shed_pressure=50, metrics=MetricsRegistry()
+        )
+        key = _key_preferring(router, "a")
+        # owner: 2 requests ahead at 0.5 s/token -> a 64-token request
+        # waits ~96 s; sibling is idle and fits the 40 s residue
+        router.report_load("a", ReplicaLoad(queue_depth=2, decode_token_s=0.5))
+        router.report_load("b", ReplicaLoad(queue_depth=0, decode_token_s=0.5))
+        decision = router.route(key, deadline_s=40.0, tokens=64)
+        assert decision.shed and decision.replica.id == "b"
+        # no deadline pressure: affinity wins despite the queue
+        decision = router.route(key, tokens=64)
+        assert not decision.shed and decision.replica.id == "a"
+
+    def test_all_overloaded_routes_least_loaded_never_rejects(self):
+        router = EngineRouter(
+            ["a", "b"], shed_pressure=2, metrics=MetricsRegistry()
+        )
+        key = _key_preferring(router, "a")
+        router.report_load("a", ReplicaLoad(queue_depth=9))
+        router.report_load("b", ReplicaLoad(queue_depth=5))
+        decision = router.route(key)
+        assert decision is not None and decision.replica.id == "b"
+
+
+# --------------------------------------------------------------------------
+# dispatch: residual-deadline failover
+# --------------------------------------------------------------------------
+
+
+class TestDispatch:
+    def test_requeue_carries_residual_deadline(self):
+        clock = {"t": 0.0}
+        metrics = MetricsRegistry()
+        router = EngineRouter(
+            ["a", "b"], clock=lambda: clock["t"], metrics=metrics
+        )
+        deadline = Deadline.start(10.0, clock=lambda: clock["t"])
+        budgets: list = []
+        served: list = []
+
+        async def send(replica, attempt, budget_s):
+            budgets.append(round(budget_s, 3))
+            if not served:
+                served.append(replica.id)
+                clock["t"] += 3.0  # the dying replica ate 3 s of budget
+                raise RuntimeError("replica died mid-stream")
+            served.append(replica.id)
+            return "ok"
+
+        outcome = run(router.dispatch(
+            send, key="k", deadline=deadline, attempts=3
+        ))
+        assert outcome.response == "ok"
+        assert outcome.requeues == 1
+        # the requeued attempt got the RESIDUAL envelope, not a fresh one
+        assert budgets == [10.0, 7.0]
+        assert served[0] != served[1], "requeue must land on a DIFFERENT replica"
+        assert outcome.replica_id == served[1]
+        counters = metrics.snapshot()["counters"]
+        assert counters.get("router_failover") == 1
+        assert counters.get("router_routed") == 1
+
+    def test_failover_budget_is_one_requeue(self):
+        metrics = MetricsRegistry()
+        router = EngineRouter(["a", "b", "c"], metrics=metrics)
+
+        async def send(replica, attempt, budget_s):
+            raise RuntimeError(f"{replica.id} down")
+
+        with pytest.raises(RouterError, match="requeue"):
+            run(router.dispatch(send, key="k", attempts=6))
+        # requeued ONCE onto a second replica, then failed loudly — never
+        # a tour of the whole fleet
+        assert metrics.snapshot()["counters"].get("router_failover") == 1
+
+    def test_expired_deadline_refuses_dispatch(self):
+        clock = {"t": 0.0}
+        router = EngineRouter(
+            ["a"], clock=lambda: clock["t"], metrics=MetricsRegistry()
+        )
+        deadline = Deadline.start(5.0, clock=lambda: clock["t"])
+        clock["t"] += 6.0
+
+        async def send(replica, attempt, budget_s):  # pragma: no cover
+            raise AssertionError("must not dispatch on a dead budget")
+
+        with pytest.raises(RouterError, match="deadline"):
+            run(router.dispatch(send, deadline=deadline))
+
+    def test_single_replica_retries_are_not_failovers(self):
+        metrics = MetricsRegistry()
+        router = EngineRouter(["solo"], metrics=metrics)
+        calls = {"n": 0}
+
+        async def send(replica, attempt, budget_s):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise RuntimeError("flaky")
+            return "ok"
+
+        outcome = run(router.dispatch(send, key="k", attempts=5, backoff_s=0.0))
+        assert outcome.response == "ok" and outcome.requeues == 0
+        assert calls["n"] == 3
+        assert not metrics.snapshot()["counters"].get("router_failover")
+
+
+# --------------------------------------------------------------------------
+# seeded overload storm (acceptance: shed to least-loaded, zero rejections
+# while any replica has headroom, spans in the flight recorder)
+# --------------------------------------------------------------------------
+
+
+def test_overload_storm_sheds_and_never_rejects_with_headroom():
+    import random
+
+    metrics = MetricsRegistry()
+    recorder = FlightRecorder(capacity=128, metrics=metrics)
+    tracer = Tracer(recorder=recorder)
+    router = EngineRouter(
+        ["a", "b", "c"], shed_pressure=4, metrics=metrics
+    )
+    pressure = {"a": 0, "b": 0, "c": 0}
+    rng = random.Random(42)
+
+    async def storm():
+        for i in range(40):
+            key = f"fp:{rng.randrange(6)}"  # six failure classes recurring
+
+            async def send(replica, attempt, budget_s):
+                pressure[replica.id] += 1  # the request now rides it
+                router.report_load(
+                    replica.id, ReplicaLoad(queue_depth=pressure[replica.id])
+                )
+                return replica.id
+            with tracer.trace(f"storm-{i}"):
+                outcome = await router.dispatch(send, key=key, request_id=str(i))
+            # seeded drain: earlier requests finish while the storm runs
+            if i % 3 == 2:
+                victim = rng.choice(["a", "b", "c"])
+                pressure[victim] = max(0, pressure[victim] - 2)
+                router.report_load(
+                    victim, ReplicaLoad(queue_depth=pressure[victim])
+                )
+            assert outcome.replica_id in pressure
+
+    run(storm())  # raises RouterError on any rejection — there must be none
+    counters = metrics.snapshot()["counters"]
+    assert counters.get("router_routed") == 40
+    assert counters.get("router_shed", 0) > 0, "storm never shed: vacuous"
+    assert not counters.get("router_no_replica")
+    # every request's routing is in the flight recorder as a span
+    dispatch_spans = [
+        s for record in recorder.traces()
+        for s in record.trace["spans"] if s["name"] == "router.dispatch"
+    ]
+    assert len(dispatch_spans) == 40
+    assert all(s["attributes"]["replica"] in pressure for s in dispatch_spans)
+
+
+# --------------------------------------------------------------------------
+# provider-level: URL validation, replica set parsing, metadata
+# --------------------------------------------------------------------------
+
+
+class TestProviderUrls:
+    def test_replica_set_splits_and_normalizes(self):
+        replicas = replica_set("http://h1:8000, https://h2/v1 http://h1:8000/")
+        assert [r.id for r in replicas] == ["http://h1:8000", "https://h2/v1"]
+
+    def test_schemeless_url_is_a_clear_provider_error(self):
+        with pytest.raises(ProviderError, match="invalid apiUrl"):
+            replica_set("h1:8000")
+        with pytest.raises(ProviderError, match="scheme-qualified"):
+            replica_set("http://good, bare-host")
+        with pytest.raises(ProviderError, match="no endpoints"):
+            replica_set("   ")
+
+    def test_generate_surfaces_config_error_not_urllib_noise(self):
+        provider = OpenAICompatProvider(metrics=MetricsRegistry())
+        request = AnalysisRequest(
+            analysis_result=AnalysisResult(),
+            provider_config=AIProviderConfig(
+                provider_id="openai", api_url="backend:8000", model_id="m"
+            ),
+        )
+        response = run(provider.generate(request))
+        assert response.error and "invalid apiUrl" in response.error
+        assert "backend:8000" in response.error
+
+
+def _opener_serving(payload_text="Root Cause: ok."):
+    """Always-succeeding OpenAI-compatible transport; records requests."""
+    import io
+
+    seen = []
+
+    class _Resp(io.BytesIO):
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    def opener(req, timeout=None):
+        seen.append(req)
+        body = {
+            "choices": [{"message": {"content": payload_text}}],
+            "usage": {"prompt_tokens": 10, "completion_tokens": 5},
+        }
+        return _Resp(json.dumps(body).encode())
+
+    opener.seen = seen
+    return opener
+
+
+class TestProviderRouting:
+    def _request(self, api_url, fingerprint=None):
+        return AnalysisRequest(
+            analysis_result=AnalysisResult(),
+            provider_config=AIProviderConfig(
+                provider_id="openai", api_url=api_url, model_id="m",
+                max_retries=3,
+            ),
+            fingerprint=fingerprint,
+        )
+
+    def test_replica_id_surfaces_in_response_metadata(self):
+        opener = _opener_serving()
+        provider = OpenAICompatProvider(opener, metrics=MetricsRegistry())
+        response = run(provider.generate(self._request("http://fake/v1")))
+        assert response.explanation == "Root Cause: ok."
+        assert response.replica_id == "http://fake/v1"
+        assert response.requeues == 0
+
+    def test_idempotency_key_is_deterministic(self):
+        opener = _opener_serving()
+        provider = OpenAICompatProvider(opener, metrics=MetricsRegistry())
+        run(provider.generate(self._request("http://fake/v1")))
+        run(provider.generate(self._request("http://fake/v1")))
+        keys = [r.get_header("X-podmortem-request-id") for r in opener.seen]
+        assert keys[0] and keys[0] == keys[1]
+
+    def test_fingerprint_affinity_pins_a_replica(self):
+        opener = _opener_serving()
+        metrics = MetricsRegistry()
+        provider = OpenAICompatProvider(opener, metrics=metrics)
+        urls = "http://r1:8000,http://r2:8000,http://r3:8000"
+        chosen = set()
+        for _ in range(4):
+            response = run(provider.generate(
+                self._request(urls, fingerprint="deadbeef" * 8)
+            ))
+            chosen.add(response.replica_id)
+        assert len(chosen) == 1, "same fingerprint must keep its replica"
+
+
+# --------------------------------------------------------------------------
+# acceptance chaos: replica killed mid-stream, full pipeline, two replays
+# --------------------------------------------------------------------------
+
+
+async def _run_replica_kill(seed: int) -> dict:
+    plan = FaultPlan(seed=seed)
+    # the FIRST dispatch attempt dies on whichever replica affinity chose
+    # — a replica killed mid-stream under the request
+    plan.rule("http.provider", raise_(
+        lambda: urllib.error.URLError("replica killed mid-stream"), "kill"
+    ))
+    api = FakeKubeApi()
+    api.fault_plan = plan
+    metrics = MetricsRegistry()
+    recorder = FlightRecorder(capacity=32, metrics=metrics)
+    config = OperatorConfig(
+        pattern_cache_directory="/nonexistent",
+        conflict_backoff_base_s=0.001,
+        analysis_deadline_s=30.0,
+    )
+    providers = default_registry()
+    opener = _opener_serving("Root Cause: survived the failover.")
+    backend = OpenAICompatProvider(opener, metrics=metrics)
+    backend.fault_plan = plan
+    providers.register("openai", backend)
+    pipeline = AnalysisPipeline(
+        api, PatternEngine(), config=config, metrics=metrics,
+        providers=providers, tracer=Tracer(recorder=recorder),
+    )
+    await api.create("AIProvider", AIProvider(
+        metadata=ObjectMeta(name="prov", namespace="ns"),
+        spec=AIProviderSpec(
+            provider_id="openai", model_id="m",
+            api_url="http://replica-a:8000,http://replica-b:8000",
+            max_retries=3, caching_enabled=True,
+        ),
+    ).to_dict())
+    pm = Podmortem(
+        metadata=ObjectMeta(name="pm", namespace="ns"),
+        spec=PodmortemSpec(
+            pod_selector=LabelSelector(match_labels={"app": "web"}),
+            ai_provider_ref=AIProviderRef(name="prov", namespace="ns"),
+        ),
+    )
+    await api.create("Podmortem", pm.to_dict())
+
+    status_writes: list[dict] = []
+    original_patch_status = api.patch_status
+
+    async def spying_patch_status(kind, name, namespace, status, **kw):
+        out = await original_patch_status(kind, name, namespace, status, **kw)
+        if kind == "Podmortem":
+            status_writes.append(status)
+        return out
+
+    api.patch_status = spying_patch_status
+
+    pod = failed_pod()
+    api.set_pod_log("prod", "web-1", "java.lang.OutOfMemoryError: heap\n")
+    await api.create("Pod", pod.to_dict())
+    results = await pipeline.process_failure_group(
+        pod, [pm], failure_time="t-0"
+    )
+    assert len(results) == 1 and results[0] is not None
+
+    status = (await api.get("Podmortem", "pm", "ns")).get("status") or {}
+    failures = status.get("recentFailures") or []
+    # the analysis trace carries the per-attempt routing spans
+    entry = failures[0] if failures else {}
+    record = recorder.get(entry.get("traceId", ""))
+    dispatch_spans = [
+        s for s in (record.trace["spans"] if record else [])
+        if s["name"] == "router.dispatch"
+    ]
+    return {
+        "trace": plan.trace(),
+        "pending": plan.pending(),
+        "failures": [
+            # traceId and the wall-clock stamp are freshly minted per run
+            # by design; everything else must replay byte-identically
+            {k: v for k, v in f.items() if k not in ("traceId", "timestamp")}
+            for f in failures
+        ],
+        "successful_status_writes": len(
+            [w for w in status_writes if w.get("recentFailures")]
+        ),
+        "incidents": [
+            (i.fingerprint, i.seen_count)
+            for i in pipeline.memory.store.all()
+        ],
+        "counters": {
+            k: v for k, v in metrics.snapshot()["counters"].items()
+            if k.startswith(("router_", "analysis_", "analyses_"))
+        },
+        "dispatch_spans": [
+            {
+                "replica": s["attributes"]["replica"],
+                "requeue": s["attributes"]["requeue"],
+                "status": s["status"],
+            }
+            for s in dispatch_spans
+        ],
+    }
+
+
+def test_replica_kill_mid_stream_fails_over_deterministically():
+    """The acceptance scenario: the replica serving the request is killed
+    mid-stream; the request is requeued ONCE on the surviving replica
+    with its residual deadline and completes there — exactly one status
+    patch and one incident, byte-identical across two seeded replays,
+    with the routing recorded as spans in the flight recorder."""
+    out_a = run(_run_replica_kill(seed=13))
+    out_b = run(_run_replica_kill(seed=13))
+
+    assert out_a["trace"] == out_b["trace"], "fault replay diverged"
+    assert out_a["pending"] == {}, f"planned kill never fired: {out_a['pending']}"
+    assert out_a == out_b, "replay must be byte-identical"
+
+    for out in (out_a,):
+        assert len(out["failures"]) == 1
+        entry = out["failures"][0]
+        assert entry["analysisStatus"] == "Analyzed"
+        # completed within the residual deadline despite the kill
+        assert entry["deadlineOutcome"] == "completed"
+        assert out["successful_status_writes"] == 1
+        assert len(out["incidents"]) == 1
+        counters = out["counters"]
+        assert counters.get("analyses_completed") == 1
+        assert counters.get("router_failover") == 1
+        assert counters.get("router_routed") == 1
+        assert counters.get("analysis_requeued") == 1
+        # two dispatch spans: the killed attempt (error) then the
+        # survivor (ok), on DIFFERENT replicas, requeue marked
+        spans = out["dispatch_spans"]
+        assert len(spans) == 2
+        assert spans[0]["status"] == "error" and spans[1]["status"] == "ok"
+        assert spans[0]["replica"] != spans[1]["replica"]
+        assert spans[1]["requeue"] == 1
+
+
+def test_replica_kill_breaker_drains_follow_up_traffic():
+    """After enough kills open a replica's breaker, follow-up requests
+    route straight to the survivor — the sick replica drains without
+    burning attempts (router_excluded counts the trip once)."""
+    metrics = MetricsRegistry()
+    clock = {"t": 0.0}
+    router = EngineRouter(
+        ["a", "b"], failure_threshold=2, reset_s=30.0,
+        clock=lambda: clock["t"], metrics=metrics,
+    )
+    plan = FaultPlan(seed=3)
+    # every dispatch against replica a dies — a partitioned replica
+    plan.rule("router.dispatch", [
+        raise_(lambda: urllib.error.URLError("partitioned"), "part")
+        for _ in range(2)
+    ], match=lambda replica, attempt: replica == "a")
+    router.fault_plan = plan
+    key = _key_preferring(router, "a")
+    served: list = []
+
+    async def send(replica, attempt, budget_s):
+        served.append(replica.id)
+        return replica.id
+
+    async def scenario():
+        # two requests: each first hits a (killed), fails over to b; the
+        # second kill opens a's breaker
+        for _ in range(2):
+            outcome = await router.dispatch(send, key=key, attempts=3)
+            assert outcome.replica_id == "b"
+        # breaker now open: the next request never touches a
+        outcome = await router.dispatch(send, key=key, attempts=3)
+        assert outcome.replica_id == "b" and outcome.requeues == 0
+
+    run(scenario())
+    assert served == ["b", "b", "b"]
+    assert plan.pending() == {}
+    counters = metrics.snapshot()["counters"]
+    assert counters.get("router_excluded") == 1
+    assert counters.get("router_failover") == 2
+    assert router.health.breakers.for_key("a").state == "open"
