@@ -91,6 +91,10 @@ class LockDiscipline(Rule):
         # and the journal's handle moves between caller and writer thread
         r"operator_tpu/router/.*\.py$",
         r"operator_tpu/utils/journal\.py$",
+        # continuous-batching scheduler (ISSUE 7): row state is mutated
+        # from the decode worker while submit paths enqueue/cancel —
+        # any lock that grows here must follow the discipline
+        r"operator_tpu/serving/sched/.*\.py$",
     )
 
     def check(self, ctx: AnalysisContext) -> list[Finding]:

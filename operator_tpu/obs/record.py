@@ -22,13 +22,13 @@ from __future__ import annotations
 
 import json
 import logging
-import os
 import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from ..utils.journal import Journal
 from ..utils.timing import METRICS, MetricsRegistry
 from .span import Trace
 
@@ -96,19 +96,26 @@ class FlightRecorder:
         self._clock = clock or time.time
         self._lock = threading.Lock()
         self._ring: "OrderedDict[str, TraceRecord]" = OrderedDict()
-        # one writer thread owns all journal appends: record() runs on the
+        # journal appends ride the shared durable-journal helper
+        # (utils/journal.py) in writer-thread mode: record() runs on the
         # asyncio event loop (the tracer's context exit), and a per-trace
-        # open+write+flush on a slow disk — the exact condition black-box
-        # forensics target — must stall the writer, never the loop.  A
-        # single worker preserves append order; pending writes drain at
-        # interpreter exit (ThreadPoolExecutor joins atexit).
-        self._writer = None
-        if self.path or self.blackbox_path:
-            import concurrent.futures
-
-            self._writer = concurrent.futures.ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="flight-recorder"
+        # write+flush on a slow disk — the exact condition black-box
+        # forensics target — must stall the writer thread, never the
+        # loop.  One Journal per distinct path (the black-box path
+        # defaults to the main journal, sharing its instance) keeps
+        # append order per file; pending writes drain via flush().
+        # Folding onto the helper (ROADMAP leftover, PR 6) means the
+        # torn-line/compaction discipline can no longer drift from the
+        # incident store's and claim ledger's.
+        self._journals: dict[str, "Journal"] = {}
+        for journal_path in {
+            p for p in (self.path, self.blackbox_path) if p
+        }:
+            journal = Journal(
+                journal_path, label="flight-recorder", async_writes=True
             )
+            journal.open()
+            self._journals[journal_path] = journal
 
     # -- ingest --------------------------------------------------------
     def record(self, trace: "Trace | dict") -> TraceRecord:
@@ -180,33 +187,21 @@ class FlightRecorder:
         return record
 
     def _append(self, path: Optional[str], payload: dict) -> None:
-        if not path or self._writer is None:
+        """Enqueue one record to the path's journal (Journal serializes
+        NOW — the record is live and mutated under the ring lock — and
+        writes on its writer thread; IO failure is logged, never raised:
+        a full disk must not fail the analysis being recorded)."""
+        if not path:
             return
-        # serialize NOW (the record is live and mutated under the ring
-        # lock), write on the writer thread
-        line = json.dumps(payload, sort_keys=True) + "\n"
-        self._writer.submit(self._append_sync, path, line)
-
-    @staticmethod
-    def _append_sync(path: str, line: str) -> None:
-        try:
-            directory = os.path.dirname(path)
-            if directory:
-                os.makedirs(directory, exist_ok=True)
-            with open(path, "a", encoding="utf-8") as handle:
-                handle.write(line)
-                handle.flush()
-        except OSError:
-            # journaling is best-effort durability: a full disk must not
-            # fail the analysis whose trace was being recorded
-            log.warning("flight recorder journal append failed (%s)", path,
-                        exc_info=True)
+        journal = self._journals.get(path)
+        if journal is not None:
+            journal.append(payload)
 
     def flush(self, timeout: Optional[float] = 5.0) -> None:
         """Barrier: returns once every previously submitted journal write
         has hit disk (tests, pre-shutdown forensics)."""
-        if self._writer is not None:
-            self._writer.submit(lambda: None).result(timeout)
+        for journal in self._journals.values():
+            journal.flush(timeout)
 
     # -- queries -------------------------------------------------------
     def get(self, trace_id: str) -> Optional[TraceRecord]:

@@ -61,6 +61,9 @@ class Operator:
         # one recorder behind the pipeline, both HTTP servers' inbound
         # traceparent handling, and GET /traces on the health port
         self.tracer, self.recorder = build_tracer(self.config, self.metrics)
+        #: the shared HTTP backend whose routers the background /healthz
+        #: poll loop feeds (None when an injected registry owns providers)
+        self._http_backend = None
         self._register_tpu_provider()
         self._register_http_providers()
         self.engine = PatternEngine(
@@ -197,6 +200,9 @@ class Operator:
             replica_failure_threshold=self.config.router_replica_failure_threshold,
             replica_reset_s=self.config.router_replica_reset_s,
         )
+        # the background /healthz poll loop (start()) feeds this
+        # backend's routers so shedding has load data between analyses
+        self._http_backend = backend
         for pid in http_ids:
             self.providers.register(pid, backend)
 
@@ -418,6 +424,15 @@ class Operator:
                 ),
                 asyncio.create_task(self._leader_cycle(), name="leader-cycle"),
             ]
+        if self._http_backend is not None and self.config.router_health_poll_s > 0:
+            # background /healthz polling: load-fed shedding needs load
+            # reports even when no analysis traffic is producing them.
+            # Runs on leaders AND standbys (breaker/health state is then
+            # already warm at takeover); each probe bounded by
+            # kube_call_timeout_s
+            self._tasks.append(asyncio.create_task(
+                self._health_poll_loop(), name="replica-health-poll"
+            ))
 
     def _spawn_control_tasks(self) -> list[asyncio.Task]:
         return [
@@ -441,6 +456,31 @@ class Operator:
             for task in self._control_tasks:
                 task.cancel()
             await asyncio.gather(*self._control_tasks, return_exceptions=True)
+
+    async def _health_poll_loop(self) -> None:
+        """Periodic ``/healthz`` sweep over every routed serving replica
+        (OpenAICompatProvider.poll_replica_health): probe verdicts and
+        load reports land in the router's HealthBoard so the shed
+        decision has data BETWEEN analyses, not only when request
+        traffic happens to feed ``report_load``.  Transient poll
+        failures are the signal (the replica is marked not-ready), never
+        a crash; the loop exits on stop."""
+        assert self._http_backend is not None
+        interval = self.config.router_health_poll_s
+        while not self._stop.is_set():
+            try:
+                await asyncio.wait_for(self._stop.wait(), timeout=interval)
+                return  # stopping
+            except asyncio.TimeoutError:
+                pass
+            try:
+                await self._http_backend.poll_replica_health(
+                    timeout_s=self.config.kube_call_timeout_s
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - polling must outlive one bad sweep
+                log.warning("replica health poll sweep failed", exc_info=True)
 
     async def _resume_claims(self) -> None:
         try:

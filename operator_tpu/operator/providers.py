@@ -345,6 +345,76 @@ class OpenAICompatProvider:
         router.fault_plan = self.fault_plan
         return router
 
+    async def poll_replica_health(self, *, timeout_s: float = 5.0) -> int:
+        """Active ``GET /healthz`` sweep over every routed replica set,
+        feeding each router's HealthBoard (probe verdict + load report).
+
+        Without this, load reports arrive only when request traffic
+        happens to feed ``report_load`` — between analyses the shed
+        decision flies blind and only the passive breaker gates a sick
+        replica (ROADMAP multi-engine item (b)).  The operator runs it
+        on a background cadence (``router_health_poll_s``); each probe
+        is a blocking urllib GET in a worker thread bounded by
+        ``timeout_s`` at the call.  A failed probe marks the replica
+        not-ready (the router's health gate skips it) — never raises.
+        Returns the number of replicas successfully polled."""
+        from ..router.health import ReplicaLoad
+
+        async def poll_one(router: EngineRouter, replica: Replica) -> bool:
+            split = urllib.parse.urlsplit(replica.url)
+            health_url = f"{split.scheme}://{split.netloc}/healthz"
+
+            def probe(url=health_url):
+                if self.fault_plan is not None:
+                    # chaos seam: partition/timeout scenarios inject here
+                    self.fault_plan.apply("http.healthz", replica=replica.id)
+                req = urllib.request.Request(url, method="GET")
+                with self._opener(req, timeout=timeout_s) as resp:
+                    payload = json.loads(resp.read().decode())
+                if not isinstance(payload, dict) or not isinstance(
+                    payload.get("status"), str
+                ):
+                    # valid JSON but not our shape (an LB answering "ok"
+                    # or {"healthy": true} in front of a dead engine):
+                    # same verdict as an unreachable replica — a foreign
+                    # body must neither readmit the replica nor escape
+                    # the per-probe handling below (one odd replica
+                    # aborting the WHOLE sweep would blind the health
+                    # feed for every healthy sibling too)
+                    raise ValueError(f"foreign /healthz body: {payload!r}")
+                return payload
+
+            try:
+                payload = await asyncio.to_thread(probe)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - a dead replica IS the signal
+                router.mark_probe(replica.id, False)
+                if self._metrics is not None:
+                    self._metrics.incr("router_health_poll_failed")
+                return False
+            # only the one status OUR serving /healthz emits counts as
+            # ready; "degraded" (supervisor gave up) and anything foreign
+            # leave the replica gated
+            router.mark_probe(replica.id, payload["status"] == "ok")
+            load = payload.get("load")
+            if isinstance(load, dict):
+                router.report_load(replica.id, ReplicaLoad.parse(load))
+            if self._metrics is not None:
+                self._metrics.incr("router_health_poll")
+            return True
+
+        # fan the probes out: serially, N black-holed replicas would
+        # hold the sweep N x timeout_s — stale health data exactly when
+        # replicas are failing, the condition the poll exists for.  The
+        # sweep's wall time is ONE probe timeout regardless of fleet size
+        results = await asyncio.gather(*(
+            poll_one(router, replica)
+            for router in list(self._routers.values())
+            for replica in router.replicas()
+        ))
+        return sum(results)
+
     async def generate(self, request: AnalysisRequest) -> AIResponse:
         config = request.provider_config or AIProviderConfig()
         if not config.api_url:

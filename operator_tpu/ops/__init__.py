@@ -27,6 +27,10 @@ from .paged_attention import (
     paged_attention,
     paged_attention_reference,
 )
+from .ragged_attention import (
+    ragged_attention_reference,
+    ragged_paged_attention,
+)
 
 __all__ = [
     "best_window_scores",
@@ -36,4 +40,6 @@ __all__ = [
     "PagedKVCache",
     "paged_attention",
     "paged_attention_reference",
+    "ragged_attention_reference",
+    "ragged_paged_attention",
 ]

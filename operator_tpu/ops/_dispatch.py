@@ -26,3 +26,15 @@ def on_tpu(*arrays: jax.Array) -> bool:
     if default is not None:
         return getattr(default, "platform", None) == "tpu"
     return jax.default_backend() == "tpu"
+
+
+def any_memory_space():
+    """``pl.BlockSpec(memory_space=ANY)`` across jax versions: the enum
+    was renamed TPUMemorySpace -> MemorySpace around 0.4.38.  The ONE
+    compat shim for every kernel that keeps an operand in HBM for manual
+    DMA (paged_attention v2, flash_prefill, ragged_attention)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    memory_space = getattr(pltpu, "MemorySpace", None) or pltpu.TPUMemorySpace
+    return pl.BlockSpec(memory_space=memory_space.ANY)

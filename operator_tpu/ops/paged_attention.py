@@ -363,9 +363,9 @@ def _paged_attention_pallas_v2(
         scale=scale,
         window=sliding_window,
     )
-    # jax renamed TPUMemorySpace -> MemorySpace around 0.4.38; accept both
-    memory_space = getattr(pltpu, "MemorySpace", None) or pltpu.TPUMemorySpace
-    any_space = pl.BlockSpec(memory_space=memory_space.ANY)
+    from ._dispatch import any_memory_space
+
+    any_space = any_memory_space()
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b,),

@@ -1,0 +1,320 @@
+"""Ragged mixed-phase paged attention: one kernel for prefill AND decode.
+
+The serving engine historically ran two program families — batched
+prefill over a right-padded ``[B, T]`` bucket and a fixed
+``decode_block`` program over ``[B, 1]`` tokens — as separate phases, so
+a long prefill stalled every in-flight decode and short decodes padded
+out the block while the MXU idled (BENCH_r02: decode MFU 0.0064).  This
+module is the kernel half of the fix (PAPERS.md: *Ragged Paged
+Attention*, arxiv 2604.15464): ONE program where every batch row sits at
+an arbitrary position — a decode row contributes one query token, a
+prefill row contributes its next chunk — against the shared paged KV
+cache (``ops/paged_attention.py`` layout).
+
+Contract (KV is written to the pages BEFORE attention runs, so the
+kernel is a pure read over the page pool):
+
+    q         [B, C, QH, D]  this step's query tokens, row-padded past
+                             ``q_count[b]`` (padding rows are ignored)
+    k_pages   [num_pages, page_size, KH, D]  (single layer)
+    v_pages   likewise
+    page_table [B, pages_per_seq] int32
+    kv_len    [B] int32  valid tokens in the row's pages INCLUDING this
+                         step's writes
+    q_count   [B] int32  live query rows this step (0 = inactive row)
+
+Query token ``i`` of row ``b`` sits at absolute position
+``kv_len[b] - q_count[b] + i`` and attends causally over positions
+``<= `` its own.  A decode row is the ``q_count == 1`` special case; a
+whole-prompt prefill is ``q_count == kv_len``; a mid-prompt chunk is
+anything in between — one program covers all three, which is what lets
+the scheduler (serving/sched/) dispatch a mixed wave every step.
+
+The Pallas kernel walks each row's live pages with in-kernel
+double-buffered DMAs steered by the scalar-prefetched page table (the
+``_paged_attn_kernel_v2`` design: exactly ``ceil(kv_len/page)`` pages
+move from HBM) and keeps a flash-attention running (max, sum, acc) per
+(query row, head) in VMEM.  The dense reference is the oracle for parity
+tests and the CPU path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ._flash_common import finalize, init_state, update_state
+
+_LANE = 128
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# dense reference (oracle + CPU path)
+# ---------------------------------------------------------------------------
+
+
+def ragged_attention_reference(
+    q: jax.Array,  # [B, C, QH, D]
+    k_pages: jax.Array,  # [num_pages, page_size, KH, D]
+    v_pages: jax.Array,
+    page_table: jax.Array,  # [B, pages_per_seq]
+    kv_len: jax.Array,  # [B]
+    q_count: jax.Array,  # [B]
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    """Gather-then-attend oracle.  Returns [B, C, QH, D] in q.dtype.
+
+    Rows past ``q_count`` (and rows of inactive slots) produce garbage —
+    callers gather only the valid rows, exactly as the kernel's
+    flash-state finalize leaves NaN in fully-masked rows."""
+    b, c, qh, d = q.shape
+    kh = k_pages.shape[2]
+    g = qh // kh
+    page_size = k_pages.shape[1]
+    max_seq = page_table.shape[1] * page_size
+
+    k = k_pages[page_table].reshape(b, max_seq, kh, d)
+    v = v_pages[page_table].reshape(b, max_seq, kh, d)
+
+    q_grouped = q.reshape(b, c, kh, g, d)
+    scores = jnp.einsum(
+        "bckgd,bskd->bkgcs", q_grouped, k, preferred_element_type=jnp.float32
+    ) * (d**-0.5)
+    kv_pos = jnp.arange(max_seq, dtype=jnp.int32)[None, None, :]  # [1, 1, S]
+    q_pos = (
+        (kv_len - q_count)[:, None]
+        + jnp.arange(c, dtype=jnp.int32)[None, :]
+    )[:, :, None]  # [B, C, 1]
+    mask = (kv_pos <= q_pos) & (kv_pos < kv_len[:, None, None])
+    if sliding_window is not None:
+        mask = mask & (kv_pos > q_pos - sliding_window)
+    scores = jnp.where(mask[:, None, None, :, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgcs,bskd->bckgd", probs, v)
+    return out.reshape(b, c, qh, d).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+
+def _ragged_attn_kernel(
+    # scalar prefetch
+    pt_ref,  # [B, pages_per_seq] int32 (SMEM)
+    len_ref,  # [B] int32 kv_len (SMEM)
+    cnt_ref,  # [B] int32 q_count (SMEM)
+    # blocks
+    q_ref,  # [1, C, QH, D] (VMEM)
+    k_hbm,  # [num_pages, page_size, KH, D] (stays in HBM)
+    v_hbm,
+    out_ref,  # [1, C, QH, D] f32
+    # scratch
+    k_buf,  # [2, page_size, KH, D] VMEM double buffer
+    v_buf,
+    sem,  # DMA semaphores [2, 2]
+    m_scratch,  # [C*QH, LANE] f32 running max
+    l_scratch,  # [C*QH, LANE] f32 running denominator
+    acc_scratch,  # [C*QH, D] f32
+    *,
+    c: int,
+    kv_heads: int,
+    q_per_kv: int,
+    page_size: int,
+    scale: float,
+    window: Optional[int] = None,
+):
+    """One grid step per batch row; the row's q chunk rides a BlockSpec
+    while its live KV pages stream through a manual double-buffered DMA
+    walk (the ``ops/paged_attention.py`` v2 design).  Flash-state rows
+    are laid out head-major — row ``h*C*G + i*G + j`` is query token
+    ``i`` of q head ``h*G + j`` — so the per-kv-head GQA dots write
+    contiguous slabs; the finalize transposes back to [C, QH, D]."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b = pl.program_id(0)
+    seq_len = len_ref[b]
+    count = cnt_ref[b]
+    q_base = seq_len - count  # absolute position of q row 0
+    # rows with no work this step (count == 0: inactive, or live but
+    # unscheduled under a saturated token budget) walk ZERO pages — their
+    # output is garbage by contract, so the DMAs and matmuls would be
+    # pure waste exactly when the step is already compute-bound
+    num_live = jnp.where(count > 0, pl.cdiv(seq_len, page_size), 0)
+    first = 0
+    if window is not None:
+        # earliest kv ANY live q row can see: q_base - window + 1
+        first = jnp.maximum(q_base - window + 1, 0) // page_size
+
+    slab = c * q_per_kv  # flash rows per kv head (token-major within)
+    total = kv_heads * slab
+
+    init_state(m_scratch, l_scratch, acc_scratch)
+
+    def dma(slot, j):
+        return (
+            pltpu.make_async_copy(
+                k_hbm.at[pt_ref[b, j]], k_buf.at[slot], sem.at[slot, 0]
+            ),
+            pltpu.make_async_copy(
+                v_hbm.at[pt_ref[b, j]], v_buf.at[slot], sem.at[slot, 1]
+            ),
+        )
+
+    @pl.when(num_live > first)
+    def _prologue():
+        for copy in dma(first % 2, first):
+            copy.start()
+
+    q = q_ref[0].astype(jnp.float32)  # [C, QH, D]
+    # flash rows: kv-head slabs stacked, token-major inside each — row
+    # h*slab + i*G + j is query token i of q head h*G + j.  Its q
+    # position depends only on the token index within the slab.
+    row_iota = jax.lax.broadcasted_iota(jnp.int32, (total, page_size), 0)
+    q_pos = q_base + (row_iota % slab) // q_per_kv
+
+    def body(j, _):
+        slot = j % 2
+
+        @pl.when(j + 1 < num_live)
+        def _prefetch_next():
+            for copy in dma((j + 1) % 2, j + 1):
+                copy.start()
+
+        for copy in dma(slot, j):
+            copy.wait()
+
+        k = k_buf[slot]  # [page, KH, D]
+        v = v_buf[slot]
+        kv_pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (total, page_size), 1
+        )
+
+        # scores for every slab against this page, stacked [total, page]
+        parts = []
+        for h in range(kv_heads):
+            q_h = q[:, h * q_per_kv : (h + 1) * q_per_kv, :].reshape(slab, -1)
+            k_h = k[:, h, :].astype(jnp.float32)  # [page, D]
+            parts.append(
+                jax.lax.dot_general(
+                    q_h, k_h, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+        s = jnp.concatenate(parts, axis=0) * scale
+        mask = (kv_pos <= q_pos) & (kv_pos < seq_len)
+        if window is not None:
+            mask = mask & (kv_pos > q_pos - window)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        def values(p):
+            outs = []
+            for h in range(kv_heads):
+                p_h = p[h * slab : (h + 1) * slab]
+                v_h = v[:, h, :].astype(jnp.float32)
+                outs.append(
+                    jax.lax.dot_general(
+                        p_h, v_h, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                )
+            return jnp.concatenate(outs, axis=0)
+
+        update_state(m_scratch, l_scratch, acc_scratch, s, values)
+        return 0
+
+    jax.lax.fori_loop(first, num_live, body, 0)
+    out = finalize(l_scratch, acc_scratch)  # [KH*C*G, D]
+    # slab h holds [C, G, D]; write it into the head band of [C, QH, D]
+    for h in range(kv_heads):
+        out_ref[0, :, h * q_per_kv : (h + 1) * q_per_kv, :] = (
+            out[h * slab : (h + 1) * slab].reshape(c, q_per_kv, -1)
+            .astype(out_ref.dtype)
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "sliding_window"))
+def _ragged_attention_pallas(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    page_table: jax.Array,
+    kv_len: jax.Array,
+    q_count: jax.Array,
+    *,
+    interpret: bool = False,
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, c, qh, d = q.shape
+    _, page_size, kh, _ = k_pages.shape
+    scale = d**-0.5
+    rows = c * qh  # total flash rows (all kv-head slabs stacked)
+
+    kernel = functools.partial(
+        _ragged_attn_kernel,
+        c=c,
+        kv_heads=kh,
+        q_per_kv=qh // kh,
+        page_size=page_size,
+        scale=scale,
+        window=sliding_window,
+    )
+    from ._dispatch import any_memory_space
+
+    any_space = any_memory_space()
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, c, qh, d), lambda b, pt, ln, cn: (b, 0, 0, 0)),
+            any_space,
+            any_space,
+        ],
+        out_specs=pl.BlockSpec((1, c, qh, d), lambda b, pt, ln, cn: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, page_size, kh, d), k_pages.dtype),
+            pltpu.VMEM((2, page_size, kh, d), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.VMEM((rows, _LANE), jnp.float32),
+            pltpu.VMEM((rows, _LANE), jnp.float32),
+            pltpu.VMEM((rows, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, c, qh, d), jnp.float32),
+        interpret=interpret,
+    )(page_table, kv_len, q_count, q, k_pages, v_pages)
+    return out.astype(q.dtype)
+
+
+def ragged_paged_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    page_table: jax.Array,
+    kv_len: jax.Array,
+    q_count: jax.Array,
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    """Dispatch: Pallas kernel on TPU, dense reference elsewhere."""
+    from ._dispatch import on_tpu
+
+    if on_tpu(q, k_pages):
+        return _ragged_attention_pallas(
+            q, k_pages, v_pages, page_table, kv_len, q_count,
+            sliding_window=sliding_window,
+        )
+    return ragged_attention_reference(
+        q, k_pages, v_pages, page_table, kv_len, q_count,
+        sliding_window=sliding_window,
+    )

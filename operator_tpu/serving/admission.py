@@ -9,6 +9,14 @@ and the warmup program-grid precompile whose whole point is that admission
 can never select a program that was not compiled before readiness flipped.
 
 Mixed into :class:`serving.engine.BatchedGenerator`.
+
+With ``sched_mode=continuous`` (serving/sched/, docs/SERVING.md) wave
+FORMATION moves behind the scheduler: admission becomes token-level per
+step and the batched-prefill dispatch below is not used.  The POLICY
+stays here — the scheduler calls :meth:`deadline_policy` and
+:meth:`_truncate_prompt`, and shares the budget/page formulas
+(``types.prompt_budget`` / ``types.pages_needed``) — so the two modes
+cannot diverge on what gets admitted, clamped, or refused.
 """
 
 from __future__ import annotations
@@ -21,7 +29,14 @@ from typing import Sequence
 import numpy as np
 
 from ..models.llama import KVCache
-from .types import OversizedRequest, SamplingParams, _bucket, _PrefillJob
+from .types import (
+    OversizedRequest,
+    SamplingParams,
+    _bucket,
+    _PrefillJob,
+    pages_needed,
+    prompt_budget,
+)
 
 log = logging.getLogger(__name__)
 
@@ -354,8 +369,9 @@ class AdmissionMixin:
         token_lists = []
         for prompt, sampling in zip(prompts, params_list):
             ids = self.tokenizer.encode(prompt)
-            # leave room for at least one generated token
-            budget = self.max_seq - max(1, min(sampling.max_tokens, self.max_seq // 2))
+            # shared budget formula (types.prompt_budget): the continuous
+            # scheduler's enqueue truncates with the same one
+            budget = prompt_budget(self.max_seq, sampling.max_tokens)
             token_lists.append(self._truncate_prompt(ids, budget))
         return self._admit_tokens(token_lists, params_list, started)
 
@@ -379,8 +395,10 @@ class AdmissionMixin:
             )
             pool = self.allocator.num_pages - 1 - self.prefix_held_pages
             for toks, sampling in zip(token_lists, params_list):
-                total = min(len(toks) + sampling.max_tokens, self.max_seq)
-                need = -(-total // self.page_size) - shared // self.page_size
+                need = pages_needed(
+                    len(toks), sampling.max_tokens, self.max_seq,
+                    self.page_size,
+                ) - shared // self.page_size
                 if need > pool:
                     if not page_grants:
                         raise OversizedRequest(

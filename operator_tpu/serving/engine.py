@@ -886,6 +886,12 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
         jnp = self._jnp
         self.metrics.record("prefill", prefill_ms)
         self.metrics.record("prefill_batch", float(len(taken)))
+        if self.num_decoding:
+            # wave-engine phase separation: this admission's prefill
+            # compute ran while decode slots sat idle — the stall the
+            # continuous scheduler (serving/sched/) exists to remove;
+            # recorded so bench.py can put a number on the difference
+            self.metrics.record("decode_stall", prefill_ms)
 
         # paged mode tracks positions in _host_offsets + paged_cache.lengths
         # only; the device offsets array belongs to the contiguous path
@@ -1084,6 +1090,12 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
         started = time.perf_counter()
         block = self.decode_block
         if self.num_decoding:
+            # HELD slots (decoding + chunk-prefill reserved) over
+            # capacity — the same definition the continuous scheduler's
+            # sched_occupancy uses, so bench.py compares like with like
+            self.metrics.record(
+                "batch_occupancy", 100.0 * self.num_active / self.max_slots
+            )
             with self._annotation(
                 "podmortem.decode",
                 [s.params for s in self.slots if s.active],
@@ -1286,11 +1298,19 @@ class ServingEngine:
         max_queue: int = 1024,
         supervisor: Optional[SupervisorPolicy] = None,
         recorder: Optional[Any] = None,  # obs.FlightRecorder for black boxes
+        scheduler: Optional[Any] = None,  # sched.Scheduler: continuous mode
     ) -> None:
         import concurrent.futures
 
         self.generator = generator
         self.admission_wait_s = admission_wait_s
+        #: continuous-batching scheduler (serving/sched/): when set, the
+        #: serve loop runs schedule→dispatch→commit steps over ragged
+        #: mixed prefill+decode waves instead of the wave machinery —
+        #: _pending is then keyed by scheduler req id, not slot id
+        self._sched = scheduler
+        if scheduler is not None:
+            scheduler.partial_hook = self._on_partial_from_worker
         #: watchdog policy (None = pre-supervisor semantics: loop death
         #: fails in-flight futures, stalls hang until the step returns)
         self._supervisor = supervisor
@@ -1370,6 +1390,14 @@ class ServingEngine:
     MAX_RESETS_PER_WINDOW = 3
     RESET_WINDOW_S = 600.0
 
+    def _reset_engine(self) -> None:
+        """Rebuild device state after a loop death (decode worker).  In
+        continuous mode the scheduler's host rows/queue are dropped too —
+        the supervisor already collected their requests as survivors."""
+        self.generator.reset()
+        if self._sched is not None:
+            self._sched.reset()
+
     async def _try_recover(self) -> None:
         """One bounded attempt to revive a dead serve loop.
 
@@ -1396,7 +1424,7 @@ class ServingEngine:
             )
             loop = asyncio.get_running_loop()
             try:
-                await loop.run_in_executor(self._executor, self.generator.reset)
+                await loop.run_in_executor(self._executor, self._reset_engine)
             except Exception as exc:  # noqa: BLE001 - rebuild failed: stay dead
                 log.exception("engine reset failed; staying down")
                 self._error = exc
@@ -1594,7 +1622,7 @@ class ServingEngine:
             return
         self._reset_times.append(now)
         try:
-            await loop.run_in_executor(self._executor, self.generator.reset)
+            await loop.run_in_executor(self._executor, self._reset_engine)
         except Exception as exc:  # noqa: BLE001 - rebuild failed: stay down
             log.exception("supervised engine reset failed; staying down")
             self._error = exc
@@ -1657,9 +1685,19 @@ class ServingEngine:
         ``GET /healthz`` (serving/httpserver.py) next to the replica id."""
         from ..router.health import ReplicaLoad
 
+        if self._sched is not None:
+            # _pending holds EVERY handed-off request (admitted rows AND
+            # scheduler-queued ones), so counting _pending next to
+            # sched.queue_depth would tally queued requests twice and
+            # make this replica look ~2x as loaded as a wave-mode twin
+            queue_depth = self._queue.qsize() + self._sched.queue_depth
+            inflight = len(self._inflight) + self._sched.num_active
+        else:
+            queue_depth = self._queue.qsize()
+            inflight = len(self._inflight) + len(self._pending)
         return ReplicaLoad(
-            queue_depth=self._queue.qsize(),
-            inflight=len(self._inflight) + len(self._pending),
+            queue_depth=queue_depth,
+            inflight=inflight,
             decode_token_s=self.generator.decode_token_estimate_s(),
             gave_up=self._gave_up,
         )
@@ -1725,11 +1763,27 @@ class ServingEngine:
                 request.future.set_exception(exc)
 
     async def precompile(self, level: str = "serving") -> dict:
-        """Run the generator's program-grid precompile on the decode
-        worker thread (single-threaded executor: serialised with every
-        other generator op).  Call before serving traffic — readiness
-        should gate on it (operator/app.py warmup)."""
+        """Run the warmup compile on the decode worker thread
+        (single-threaded executor: serialised with every other generator
+        op).  Call before serving traffic — readiness should gate on it
+        (operator/app.py warmup).  In continuous-scheduler mode there is
+        no program grid: exactly ONE mixed program compiles, whatever
+        the workload (docs/SERVING.md)."""
         loop = asyncio.get_running_loop()
+        if self._sched is not None:
+            sched = self._sched
+
+            def _warm() -> dict:
+                if level == "off":
+                    return {"level": level, "programs": 0, "seconds": 0.0}
+                started = time.perf_counter()
+                sched.precompile()
+                return {
+                    "level": level, "programs": 1,
+                    "seconds": round(time.perf_counter() - started, 2),
+                }
+
+            return await loop.run_in_executor(self._executor, _warm)
         return await loop.run_in_executor(
             self._executor, lambda: self.generator.precompile_grid(level)
         )
@@ -1840,6 +1894,17 @@ class ServingEngine:
         if params is not None and params.guided_choice is not None \
                 and params.guided_regex is not None:
             raise ValueError("guided_choice and guided_regex are mutually exclusive")
+        if self._sched is not None and params is not None and (
+            params.guided_choice is not None
+            or params.guided_regex is not None
+            or params.adapter is not None
+        ):
+            # the mixed-phase program has no guided/LoRA path yet: refuse
+            # at SUBMIT (to this caller) rather than inside the serve loop
+            raise ValueError(
+                "guided decoding and LoRA adapters are not supported in "
+                "continuous scheduler mode (sched_mode=continuous)"
+            )
         if params is not None and params.deadline is not None:
             # fail-fast at submit: a budget that cannot fit ONE decoded
             # token must not consume a queue slot, a prefill, or KV pages.
@@ -1916,7 +1981,133 @@ class ServingEngine:
             else:
                 self._fail_outstanding(exc)
 
+    def _sweep_batch(self, batch: "list[_Request]") -> None:
+        """Drop requests whose callers vanished while QUEUED — no point
+        tokenizing, granting pages, and prefilling a dead request ahead
+        of live ones.  Deadline-carrying entries that EXPIRED while
+        queued are failed here for the same reason: their budget is gone
+        before any chip time was spent.  In-place (batch aliases
+        ``_inflight``)."""
+        now = self.generator._clock()
+        live = []
+        for request in batch:
+            future = request.future
+            if future.done():
+                self._partial_by_future.pop(future, None)
+                continue
+            deadline = request.params.deadline
+            if deadline is not None and deadline <= now:
+                self._partial_by_future.pop(future, None)
+                self.generator.metrics.incr("admission_deadline_rejected")
+                future.set_exception(DeadlineExceeded(
+                    "deadline expired while queued for admission"
+                ))
+                continue
+            live.append(request)
+        batch[:] = live
+
+    async def _serve_sched(self) -> None:
+        """The continuous-batching serve loop (serving/sched/): every
+        popped request is handed to the scheduler immediately — admission
+        is token-level inside :meth:`Scheduler.step`, so there is no
+        admission window, no wave formation, and no backpressure retry
+        machinery here; ``_pending`` is keyed by scheduler req id."""
+        loop = asyncio.get_running_loop()
+        sched = self._sched
+        assert sched is not None
+        # the scheduler's host queue is unbounded: cap the handoff so
+        # overflow stays in THIS bounded priority queue (max_queue via
+        # the low lane keeps gating external callers, and a late
+        # high-priority arrival can still jump the un-drained tail)
+        handoff = max(2 * self.generator.max_slots, 16)
+        while not self._closed:
+            batch = self._inflight
+            if not batch and sched.total_work == 0 and self._queue.empty():
+                # fully idle: block until a request arrives
+                batch.append(self._unwrap(await self._queue.get()))
+            while (
+                not self._queue.empty()
+                and sched.queue_depth + len(batch) < handoff
+            ):
+                batch.append(self._unwrap(self._queue.get_nowait()))
+            if batch:
+                self._sweep_batch(batch)
+            if batch:
+                requests = list(batch)
+
+                def _enqueue_all(requests=requests):
+                    out = []
+                    for request in requests:
+                        try:
+                            out.append((request, sched.enqueue(
+                                request.prompt, request.params
+                            ), None))
+                        except Exception as exc:  # noqa: BLE001 - per-request verdict
+                            out.append((request, None, exc))
+                    return out
+                enqueued = await loop.run_in_executor(
+                    self._executor, _enqueue_all
+                )
+                batch.clear()
+                for request, req_id, exc in enqueued:
+                    if exc is not None:
+                        self._partial_by_future.pop(request.future, None)
+                        if not request.future.done():
+                            request.future.set_exception(exc)
+                        continue
+                    self._pending[req_id] = request
+                    callback = self._partial_by_future.pop(
+                        request.future, None
+                    )
+                    if callback is not None:
+                        self._partial_cbs[req_id] = (callback, request.future)
+            if sched.total_work:
+                # reclaim rows whose callers are gone (disconnects):
+                # per-token recycling frees their slot + pages THIS step
+                cancelled = [
+                    req_id for req_id, request in self._pending.items()
+                    if request.future.cancelled()
+                ]
+                if cancelled:
+                    await loop.run_in_executor(
+                        self._executor,
+                        lambda: [sched.cancel(r) for r in cancelled],
+                    )
+                    for req_id in cancelled:
+                        self._pending.pop(req_id, None)
+                        self._partial_cbs.pop(req_id, None)
+            if sched.total_work:
+                step_call = loop.run_in_executor(self._executor, sched.step)
+                if self._supervisor is not None:
+                    # same stall watchdog as the wave loop: one mixed
+                    # dispatch making no progress within the budget means
+                    # the device is wedged, not merely slow
+                    try:
+                        outcomes = await asyncio.wait_for(
+                            step_call, self._supervisor.stall_timeout_s
+                        )
+                    except asyncio.TimeoutError:
+                        self._stalled = True
+                        raise EngineStalled(
+                            f"mixed dispatch made no progress in "
+                            f"{self._supervisor.stall_timeout_s:.1f}s"
+                        ) from None
+                else:
+                    outcomes = await step_call
+                for outcome in outcomes:
+                    self._partial_cbs.pop(outcome.req_id, None)
+                    request = self._pending.pop(outcome.req_id, None)
+                    if request is None or request.future.done():
+                        continue
+                    if outcome.error is not None:
+                        request.future.set_exception(outcome.error)
+                    else:
+                        request.future.set_result(outcome.result)
+            await asyncio.sleep(0)
+
     async def _serve(self) -> None:
+        if self._sched is not None:
+            return await self._serve_sched()
         loop = asyncio.get_running_loop()
         while not self._closed:
             # requests live in self._inflight between queue pop and slot
@@ -1943,29 +2134,7 @@ class ServingEngine:
                 while len(batch) < total_free and not self._queue.empty():
                     batch.append(self._unwrap(self._queue.get_nowait()))
             if batch:
-                # drop requests whose callers vanished while QUEUED — no
-                # point tokenizing, granting pages, and prefilling a dead
-                # request ahead of live ones (in-place: batch IS _inflight).
-                # Deadline-carrying entries that EXPIRED while queued are
-                # failed here for the same reason: their budget is gone
-                # before any chip time was spent.
-                now = self.generator._clock()
-                live = []
-                for request in batch:
-                    future = request.future
-                    if future.done():
-                        self._partial_by_future.pop(future, None)
-                        continue
-                    deadline = request.params.deadline
-                    if deadline is not None and deadline <= now:
-                        self._partial_by_future.pop(future, None)
-                        self.generator.metrics.incr("admission_deadline_rejected")
-                        future.set_exception(DeadlineExceeded(
-                            "deadline expired while queued for admission"
-                        ))
-                        continue
-                    live.append(request)
-                batch[:] = live
+                self._sweep_batch(batch)
             if batch and not stalled:
                 admitted = await self._admit(batch)
                 # paged backpressure: requests beyond the KV free list stay

@@ -369,12 +369,42 @@ def build_serving_engine(
         lora_alpha=config.lora_alpha,
         prefill_chunk=prefill_chunk,
     )
-    if config.prefix_cache and generator.paged:
+    # continuous-batching scheduler (serving/sched/, docs/SERVING.md):
+    # opt-in via SCHED_MODE=continuous; falls back to the wave engine
+    # with a loud warning when the engine shape can't serve it (the mixed
+    # program has no mesh/LoRA path yet).  Decided BEFORE prefix priming:
+    # the scheduler prefills every prompt in full, so priming would only
+    # hold KV pages hostage for the process lifetime.
+    scheduler = None
+    if config.sched_mode == "continuous":
+        if not generator.paged or mesh is not None or lora_adapters:
+            log.warning(
+                "sched_mode=continuous requires paged KV, no mesh and no "
+                "LoRA adapters (paged=%s mesh=%s lora=%s); falling back "
+                "to the wave engine",
+                generator.paged, mesh is not None, bool(lora_adapters),
+            )
+        else:
+            from .sched import Scheduler
+
+            scheduler = Scheduler(
+                generator,
+                chunk=config.sched_chunk,
+                token_budget=config.sched_token_budget,
+            )
+    elif config.sched_mode != "wave":
+        raise ValueError(
+            f"unknown sched_mode {config.sched_mode!r}: expected "
+            "'wave' or 'continuous'"
+        )
+    if config.prefix_cache and generator.paged and scheduler is None:
         # the default template's static preamble is shared by every
         # explanation request: cache its KV once so each admission
         # prefills only its variable remainder.  CRs with a custom
         # promptTemplate simply fall back to full prefill (the engine
         # compares TOKENS per wave; a non-matching wave costs nothing).
+        # Skipped in continuous mode: the mixed program has no prefix
+        # path, and the primed pages would shrink the pool for nothing.
         from .prompts import DEFAULT_TEMPLATE, template_preamble
 
         static_preamble = template_preamble(DEFAULT_TEMPLATE)
@@ -394,7 +424,10 @@ def build_serving_engine(
             stall_timeout_s=config.supervisor_stall_s,
             join_grace_s=config.supervisor_join_grace_s,
         )
-    return ServingEngine(generator, supervisor=supervisor), model_id
+    return (
+        ServingEngine(generator, supervisor=supervisor, scheduler=scheduler),
+        model_id,
+    )
 
 
 def build_tpu_native_provider(

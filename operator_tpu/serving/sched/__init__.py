@@ -1,0 +1,13 @@
+"""Continuous-batching scheduler: ragged mixed prefill+decode waves.
+
+The explicit **schedule → dispatch → commit** serving loop (PAPERS.md:
+*xLLM*, arxiv 2510.14686) over the ragged mixed-phase program
+(``ops/ragged_attention.py``; PAPERS.md: *Ragged Paged Attention*,
+arxiv 2604.15464).  See :mod:`.scheduler` for the loop and
+``docs/SERVING.md`` for the design.
+"""
+
+from .scheduler import Scheduler
+from .types import SchedConfig, StepPlan
+
+__all__ = ["Scheduler", "SchedConfig", "StepPlan"]

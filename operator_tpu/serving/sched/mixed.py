@@ -1,0 +1,162 @@
+"""The mixed-phase dispatch program: ONE compiled step for the whole
+ragged wave.
+
+The scheduler packs every row's work for a step — one token per decode
+row, up to ``chunk`` prompt tokens per prefill row — into a FLAT token
+axis of static length ``t_budget`` (right-padded with trash tokens), so
+the transformer trunk (projections, MLP, norms: all per-token) runs at
+exactly the wave's token count regardless of how it splits between
+phases.  Attention is the only op that needs row structure: the flat
+q tokens are re-packed per row into ``[B, chunk]`` and handed to the
+ragged paged-attention kernel (``ops/ragged_attention.py``), whose
+causal mask makes a decode row the ``q_count == 1`` special case of a
+prefill chunk.  KV for the step is scattered into the paged cache
+BEFORE attention, so the kernel is a pure page read.
+
+Exactly ONE program compiles per engine (static ``t_budget`` / ``chunk``
+/ ``max_slots``): there is no bucket grid to warm, no per-shape compile
+to hit mid-run — the property the warmup-grid machinery exists to
+approximate for the wave engine, the mixed program has by construction.
+
+Unsupported here (the wave engine keeps them): guided decoding and LoRA
+adapters are refused at submit (serving/engine.py + Scheduler.enqueue);
+mesh sharding makes build_serving_engine fall back to wave mode; and
+shared-prefix KV reuse simply does not apply — every prompt prefills in
+full, so provider.py skips prefix priming in continuous mode rather
+than holding pages the program would never read.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ...models.llama import (
+    _PROJ_BIAS,
+    apply_rope,
+    rms_norm,
+    rope_frequencies,
+)
+from ...models.quant import mm
+
+__all__ = ["make_mixed_fn"]
+
+
+def make_mixed_fn(generator: Any, t_budget: int, chunk: int):
+    """Compile the mixed-step program for ``generator`` (paged, no mesh).
+
+    Signature of the returned jitted function::
+
+        fn(params, paged, ids, rows, pos, valid, in_row,
+           q_start, q_count, kv_len, rng, temp, top_p)
+        -> (new_paged, next_tokens [B], rng)
+
+    Flat inputs (length ``t_budget``): ``ids`` token ids, ``rows`` the
+    owning slot per token, ``pos`` absolute positions, ``valid`` live
+    mask (padding tokens write to the trash page), ``in_row`` each
+    token's index within its row's chunk.  Per-slot inputs (length
+    ``max_slots``): ``q_start`` the flat offset of the slot's first
+    token, ``q_count`` its token count this step (0 = not scheduled),
+    ``kv_len`` the pages' valid length AFTER this step's writes (rows
+    not scheduled keep their current length).  ``next_tokens[b]``
+    samples the slot's last valid logit — meaningful only for decode
+    rows and prompt-completing prefill rows; the scheduler's commit
+    phase ignores the rest.
+    """
+    jax, jnp = generator._jax, generator._jnp
+    config = generator.config
+    b_slots = generator.max_slots
+    inv_freq = rope_frequencies(config)
+    lax = jax.lax
+
+    def mixed_fn(params, paged, ids, rows, pos, valid, in_row,
+                 q_start, q_count, kv_len, rng, temp, top_p):
+        from ...ops.paged_attention import PagedKVCache
+        from ...ops.ragged_attention import ragged_paged_attention
+
+        page_size = paged.page_size
+        x = jnp.take(params["embed"], ids, axis=0)[None]  # [1, T, H]
+        positions = pos[None]  # [1, T]
+        # flat -> per-row packing indices for the attention re-pack
+        pack_idx = jnp.clip(
+            q_start[:, None] + jnp.arange(chunk, dtype=jnp.int32)[None, :],
+            0, t_budget - 1,
+        )  # [B, chunk]
+        # per-token page/slot targets (invalid tokens -> trash page 0)
+        page_ids = jnp.where(
+            valid, paged.page_table[rows, pos // page_size], 0
+        )
+        page_slots = jnp.where(valid, pos % page_size, 0)
+
+        def layer_step(carry, scanned):
+            x = carry
+            weights = scanned["w"]
+            attn_in = rms_norm(x, weights["ln_attn"], config.rms_norm_eps)
+
+            def proj(h_in, name):
+                y = mm(h_in, weights[name])
+                bias = _PROJ_BIAS.get(name)
+                if bias is not None and bias in weights:
+                    y = y + weights[bias].astype(y.dtype)
+                return y
+
+            q = proj(attn_in, "wq").reshape(
+                1, t_budget, config.num_heads, config.head_dim
+            )
+            k = proj(attn_in, "wk").reshape(
+                1, t_budget, config.num_kv_heads, config.head_dim
+            )
+            v = proj(attn_in, "wv").reshape(
+                1, t_budget, config.num_kv_heads, config.head_dim
+            )
+            q = apply_rope(q, positions, inv_freq)
+            k = apply_rope(k, positions, inv_freq)
+            # scatter this step's K/V into the pages FIRST — the ragged
+            # kernel then reads a cache that already holds every token a
+            # causal query may attend to (its own included)
+            k_pages = scanned["k"].at[page_ids, page_slots].set(
+                k[0].astype(scanned["k"].dtype)
+            )
+            v_pages = scanned["v"].at[page_ids, page_slots].set(
+                v[0].astype(scanned["v"].dtype)
+            )
+            q_pack = q[0][pack_idx]  # [B, chunk, QH, D]
+            attn_pack = ragged_paged_attention(
+                q_pack.astype(k_pages.dtype), k_pages, v_pages,
+                paged.page_table, kv_len, q_count,
+                sliding_window=config.sliding_window,
+            )
+            attn = attn_pack[rows, in_row]  # back to flat [T, QH, D]
+            x = x + proj(attn.astype(x.dtype).reshape(1, t_budget, -1), "wo")
+            mlp_in = rms_norm(x, weights["ln_mlp"], config.rms_norm_eps)
+            gate = jax.nn.silu(proj(mlp_in, "w_gate"))
+            up = proj(mlp_in, "w_up")
+            x = x + proj(gate * up, "w_down")
+            return x, {"k": k_pages, "v": v_pages}
+
+        scanned_in = {
+            "w": params["layers"], "k": paged.k_pages, "v": paged.v_pages,
+        }
+        x, pages_out = lax.scan(layer_step, x, scanned_in)
+
+        x = rms_norm(x, params["ln_final"], config.rms_norm_eps)
+        # only each slot's LAST valid token needs a logit row: gather it
+        # before the head matmul so the [vocab] projection runs at [B],
+        # not [T]
+        last_flat = jnp.clip(q_start + jnp.maximum(q_count, 1) - 1,
+                             0, t_budget - 1)
+        x_last = x[0][last_flat]  # [B, H]
+        head = (
+            params["embed"].T if config.tie_embeddings else params["lm_head"]
+        )
+        logits = jnp.einsum(
+            "bh,hv->bv", x_last, head, preferred_element_type=jnp.float32
+        )
+        next_tokens, rng = generator._sample(logits, rng, temp, top_p)
+        new_paged = PagedKVCache(
+            k_pages=pages_out["k"], v_pages=pages_out["v"],
+            page_table=paged.page_table, lengths=kv_len,
+        )
+        return new_paged, next_tokens, rng
+
+    assert b_slots <= t_budget, (b_slots, t_budget)
+    return jax.jit(mixed_fn, donate_argnums=(1,))

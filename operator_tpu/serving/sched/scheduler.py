@@ -1,0 +1,543 @@
+"""The continuous-batching scheduler: schedule → dispatch → commit.
+
+Replaces the wave engine's implicit phase machinery (batched prefill
+dispatches + fixed decode blocks, serving/engine.py) with an explicit
+per-step loop over ONE ragged mixed-phase program:
+
+- **schedule** (:meth:`Scheduler._schedule`) — form this step's ragged
+  wave: every decode row contributes its one next token, every prefill
+  row contributes its next chunk (Sarathi-style: at most ``chunk``
+  tokens, so a prompt storm stalls in-flight decodes for at most one
+  chunk's compute per step), and queued requests are admitted into the
+  RUNNING wave the moment a slot + pages free up — token-level
+  admission, no block boundary, no admission window;
+- **dispatch** (:meth:`Scheduler._dispatch`) — pack the wave onto the
+  flat token axis and run the one compiled mixed program
+  (``sched/mixed.py`` + ``ops/ragged_attention.py``);
+- **commit** (:meth:`Scheduler._commit`) — fetch the step's sampled
+  tokens (the ONE host sync), advance rows, and recycle a finished
+  row's slot and KV pages THIS step — not ``decode_block - 1`` junk
+  tokens later — so the next step's admission can reuse them.
+
+The scheduler is synchronous and single-threaded by design (the
+``BatchedGenerator`` discipline: the ServingEngine serialises calls on
+its decode worker); it owns the host-side row state and drives the
+generator's page allocator, slot table and paged cache.  Deadline
+policy, prompt truncation and the chaos seam are the generator's own
+(``AdmissionMixin`` / ``fault_plan``) so wave and continuous modes can
+never diverge on admission semantics.
+
+Counters (docs/METRICS.md): ``podmortem_sched_admitted_midwave_total``,
+``podmortem_sched_chunked_prefill_total``,
+``podmortem_sched_recycled_slot_total``,
+``podmortem_sched_stall_free_step_total``,
+``podmortem_sched_stall_step_total``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import time
+from collections import deque
+from typing import Any, Optional
+
+import numpy as np
+
+from ..types import (
+    DeadlineExceeded,
+    GenerationResult,
+    OversizedRequest,
+    SamplingParams,
+    _Slot,
+    pages_needed,
+    prompt_budget,
+)
+from .types import RowWork, StepOutcome, StepPlan, _Row
+
+log = logging.getLogger(__name__)
+
+__all__ = ["Scheduler"]
+
+
+class Scheduler:
+    """Continuous-batching scheduler over a paged :class:`BatchedGenerator`.
+
+    Requires paged KV and no mesh (the mixed program has no SPMD rule
+    yet); guided decoding and LoRA requests are refused at submit — the
+    ServingEngine routes them to the wave path or fails them loudly.
+    """
+
+    def __init__(
+        self,
+        generator: Any,
+        *,
+        chunk: int = 64,
+        token_budget: int = 0,
+    ) -> None:
+        if not getattr(generator, "paged", False):
+            raise ValueError("the continuous scheduler requires paged KV")
+        if getattr(generator, "mesh", None) is not None:
+            raise ValueError(
+                "the continuous scheduler does not support mesh sharding yet"
+            )
+        self.generator = generator
+        self.chunk = max(1, min(chunk, generator.max_seq))
+        self.t_budget = token_budget or max(self.chunk, generator.max_slots)
+        if self.t_budget < generator.max_slots:
+            # a full decode batch must always fit one step, or decode
+            # rows would be starved by construction
+            raise ValueError(
+                f"sched token_budget={self.t_budget} < max_slots="
+                f"{generator.max_slots}: a full decode batch would not fit"
+            )
+        if self.chunk > self.t_budget:
+            raise ValueError(
+                f"sched chunk={self.chunk} > token_budget={self.t_budget}"
+            )
+        self.metrics = generator.metrics
+        #: ``hook(req_id, token_ids_so_far)`` after each step for rows
+        #: still generating — the streaming feed (ServingEngine marshals
+        #: it onto the event loop).  Called from the decode worker.
+        self.partial_hook: Optional[Any] = None
+        self._queue: deque = deque()  # (req_id, tokens, params, submitted)
+        self._rows: dict[int, _Row] = {}  # req_id -> row, insertion order
+        self._next_req = itertools.count(1)
+        self._kv_shadow = np.zeros((generator.max_slots,), np.int32)
+        self._staged_tables: list[tuple[int, np.ndarray]] = []
+        self._fn = None
+        # host-side stats the bench reads (stats())
+        self.steps = 0
+        self.occupancy_sum = 0.0
+        self.stall_steps = 0
+        #: set to a list to record every step's ``StepPlan.trace()`` —
+        #: the determinism test replays a fixed arrival trace and
+        #: asserts the schedule is byte-identical
+        self.plan_log: Optional[list] = None
+
+    # ------------------------------------------------------------------
+    # submit side
+    # ------------------------------------------------------------------
+
+    def enqueue(self, prompt: str, params: Optional[SamplingParams] = None) -> int:
+        """Tokenise + queue one request; returns its req id.  Raises
+        :class:`OversizedRequest` when the request can never fit the KV
+        pool, ``ValueError`` for features the mixed program does not
+        serve (guided decoding, LoRA)."""
+        g = self.generator
+        params = params or SamplingParams()
+        if params.guided_choice is not None or params.guided_regex is not None:
+            raise ValueError(
+                "guided decoding is not supported by the continuous "
+                "scheduler (sched_mode=continuous); use the wave engine"
+            )
+        if params.adapter is not None:
+            raise ValueError(
+                "LoRA adapters are not supported by the continuous "
+                "scheduler (sched_mode=continuous); use the wave engine"
+            )
+        ids = g.tokenizer.encode(prompt)
+        # same truncation budget + middle-drop as the wave path's admit()
+        tokens = g._truncate_prompt(
+            ids, prompt_budget(g.max_seq, params.max_tokens)
+        )
+        pool = g.allocator.num_pages - 1 - g.prefix_held_pages
+        if self._pages_needed(tokens, params) > pool:
+            raise OversizedRequest(
+                f"request needs {self._pages_needed(tokens, params)} KV "
+                f"pages, cache holds {pool}"
+            )
+        req_id = next(self._next_req)
+        self._queue.append((req_id, tokens, params, time.perf_counter()))
+        return req_id
+
+    def cancel(self, req_id: int) -> bool:
+        """Drop a queued request or reclaim a live row's slot/pages now."""
+        for i, entry in enumerate(self._queue):
+            if entry[0] == req_id:
+                del self._queue[i]
+                return True
+        row = self._rows.get(req_id)
+        if row is None:
+            return False
+        self._release_row(row)
+        return True
+
+    @property
+    def num_active(self) -> int:
+        return len(self._rows)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def total_work(self) -> int:
+        return len(self._rows) + len(self._queue)
+
+    def stats(self) -> dict:
+        """Step-level occupancy/stall stats (bench.py reporting)."""
+        return {
+            "steps": self.steps,
+            "batch_occupancy_avg": round(
+                self.occupancy_sum / self.steps, 4
+            ) if self.steps else None,
+            "decode_stall_steps": self.stall_steps,
+            "admitted_midwave": self.metrics.counter("sched_admitted_midwave"),
+            "chunked_prefills": self.metrics.counter("sched_chunked_prefill"),
+            "recycled_slots": self.metrics.counter("sched_recycled_slot"),
+        }
+
+    def reset(self) -> None:
+        """Drop every row and queued request (the supervised-restart /
+        recovery path: the generator rebuilds device state separately
+        and the engine has already collected the in-flight futures)."""
+        self._queue.clear()
+        self._rows.clear()
+        self._kv_shadow[:] = 0
+        self._staged_tables.clear()
+
+    def precompile(self) -> None:
+        """Compile the one mixed program before serving (an empty wave
+        drives the full trace: the program's shapes are workload-
+        independent by construction)."""
+        self._dispatch(StepPlan())
+
+    # ------------------------------------------------------------------
+    # the loop
+    # ------------------------------------------------------------------
+
+    def step(self) -> list[StepOutcome]:
+        """One schedule → dispatch → commit round; returns every request
+        that reached a terminal state (result or admission error)."""
+        g = self.generator
+        if g.fault_plan is not None:
+            # chaos seam, same site as the wave engine's step so stall /
+            # device-error scenarios drive both loops identically
+            g.fault_plan.apply("engine.step", active=self.num_active)
+        outcomes: list[StepOutcome] = []
+        plan = self._schedule(outcomes)
+        held_rows = len(self._rows)  # snapshot BEFORE commit recycles
+        if self.plan_log is not None:
+            self.plan_log.append(plan.trace())
+        if not plan.work:
+            return outcomes
+        started = time.perf_counter()
+        with g._annotation(
+            "podmortem.sched_step",
+            [row.params for row in self._rows.values()],
+        ):
+            toks = self._dispatch(plan)
+        elapsed_ms = (time.perf_counter() - started) * 1e3
+        outcomes.extend(self._commit(plan, toks, elapsed_ms))
+        # step accounting: occupancy is HELD slots over capacity (rows at
+        # any phase — the same "slots occupied" definition the wave
+        # engine's batch_occupancy stage uses, so bench.py compares like
+        # with like); a stall step is one where a decode-ready row got
+        # NO token — the schedule never defers decodes while
+        # token_budget >= max_slots, so the counter is the proof of the
+        # property, not a mechanism
+        self.steps += 1
+        occupancy = held_rows / g.max_slots
+        self.occupancy_sum += occupancy
+        self.metrics.record("sched_occupancy", occupancy * 100.0)
+        if plan.decode_rows and not plan.prefill_rows:
+            # wall time per one-token decode round, PURE decode steps
+            # only: the admission roofline reads p50(decode_step) as
+            # seconds-per-token (decode_token_estimate_s), and a mixed
+            # step's wall includes up to `chunk` prefill tokens' compute
+            # — folding that in would inflate the estimate ~chunk-fold
+            # and make deadline clamping over-truncate every admission
+            self.metrics.record("decode_step", elapsed_ms)
+        if plan.deferred_decode:
+            self.stall_steps += 1
+            self.metrics.incr("sched_stall_step")
+        else:
+            self.metrics.incr("sched_stall_free_step")
+        return outcomes
+
+    # -- schedule ------------------------------------------------------
+
+    def _pages_needed(self, tokens: list, params: SamplingParams) -> int:
+        g = self.generator
+        return pages_needed(
+            len(tokens), params.max_tokens, g.max_seq, g.page_size
+        )
+
+    def _sweep_expired(self, outcomes: list[StepOutcome]) -> None:
+        """Fail EVERY queued request whose deadline already expired —
+        the whole queue, every step, regardless of capacity.  Checking
+        only at admission would leave an expired caller hanging until a
+        slot (and the head's pages) freed, where the wave path's sweep
+        fails it on every loop round."""
+        if not self._queue:
+            return
+        now = self.generator._clock()
+        live = deque()
+        for entry in self._queue:
+            params = entry[2]
+            if params.deadline is not None and params.deadline <= now:
+                self.metrics.incr("admission_deadline_rejected")
+                outcomes.append(StepOutcome(entry[0], error=DeadlineExceeded(
+                    "deadline expired while queued for admission"
+                )))
+            else:
+                live.append(entry)
+        self._queue = live
+
+    def _admit_queued(self, outcomes: list[StepOutcome]) -> list[int]:
+        """Token-level admission: pull queued requests into free slots
+        while pages last.  Runs at the top of EVERY step, so an arrival
+        joins the running wave at the next step boundary — never waits
+        for a decode block or an admission window."""
+        g = self.generator
+        self._sweep_expired(outcomes)
+        admitted: list[int] = []
+        while self._queue:
+            free = g.free_slots()
+            if not free:
+                break
+            req_id, tokens, params, submitted = self._queue[0]
+            clamped, outcome = g.deadline_policy(params)
+            if outcome == "rejected":
+                # expired between the check above and the policy's clock
+                # read: minimal one-token clamp, same as the wave path's
+                # _deadline_clamp_wave
+                clamped = dataclasses.replace(
+                    params, max_tokens=1, deadline_clamped=True
+                )
+                outcome = "truncated"
+            if outcome == "truncated":
+                self.metrics.incr("admission_deadline_truncated")
+            need = self._pages_needed(tokens, clamped)
+            if need > g.allocator.available:
+                break  # backpressure: decode frees pages, retry next step
+            self._queue.popleft()
+            grant = g.allocator.allocate(need)
+            slot = free[0]
+            row = _Row(
+                req_id=req_id, slot=slot, tokens=tokens, params=clamped,
+                pages=grant, submitted=submitted,
+            )
+            self._rows[req_id] = row
+            # admission queue-wait visibility (the engine span's
+            # queue_wait is wall minus compute; this is the sched-queue
+            # share specifically)
+            self.metrics.record(
+                "sched_queue_wait", (time.perf_counter() - submitted) * 1e3
+            )
+            # mirror into the generator's slot table so free_slots /
+            # num_active / the supervisor's leak audit see one truth
+            slot_obj = _Slot()
+            slot_obj.active = True
+            slot_obj.prompt_len = len(tokens)
+            slot_obj.params = clamped
+            slot_obj.pages = grant
+            g.slots[slot] = slot_obj
+            # stage the row's page table for the next dispatch
+            row_table = np.zeros((g.pages_per_seq,), np.int32)
+            row_table[: len(grant)] = grant
+            self._staged_tables.append((slot, row_table))
+            admitted.append(req_id)
+            if len(self._rows) > 1:
+                self.metrics.incr("sched_admitted_midwave")
+        return admitted
+
+    def _schedule(self, outcomes: list[StepOutcome]) -> StepPlan:
+        plan = StepPlan()
+        plan.admitted = self._admit_queued(outcomes)
+        budget = self.t_budget
+        cursor = 0
+        # decode rows first — one token each, NEVER deferred (the whole
+        # point: a prefill storm cannot starve an in-flight decode)
+        for req_id, row in self._rows.items():
+            if not row.decoding:
+                continue
+            if cursor >= budget:  # unreachable while budget >= max_slots
+                plan.deferred_decode += 1
+                continue
+            plan.work.append(RowWork(row.slot, req_id, cursor, 1, "decode"))
+            cursor += 1
+            plan.decode_rows += 1
+        # prefill chunks fill the remaining budget, FIFO by admission
+        for req_id, row in self._rows.items():
+            if row.decoding:
+                continue
+            remaining = budget - cursor
+            count = min(self.chunk, row.prompt_len - row.pos, remaining)
+            if count <= 0:
+                continue
+            kind = (
+                "finish" if row.pos + count >= row.prompt_len else "prefill"
+            )
+            plan.work.append(RowWork(row.slot, req_id, cursor, count, kind))
+            cursor += count
+            plan.prefill_rows += 1
+        plan.tokens_planned = cursor
+        return plan
+
+    # -- dispatch ------------------------------------------------------
+
+    def _get_fn(self):
+        if self._fn is None:
+            from .mixed import make_mixed_fn
+
+            log.info(
+                "compiling mixed-step program t_budget=%d chunk=%d slots=%d",
+                self.t_budget, self.chunk, self.generator.max_slots,
+            )
+            self._fn = make_mixed_fn(self.generator, self.t_budget, self.chunk)
+        return self._fn
+
+    def _dispatch(self, plan: StepPlan) -> np.ndarray:
+        """Pack the plan onto the flat token axis and run the one mixed
+        program; commits the returned cache/rng and returns the sampled
+        tokens ([B] host array — the step's ONE device sync)."""
+        g = self.generator
+        jnp = g._jnp
+        t, b = self.t_budget, g.max_slots
+        ids = np.zeros((t,), np.int32)
+        rows = np.zeros((t,), np.int32)
+        pos = np.zeros((t,), np.int32)
+        valid = np.zeros((t,), bool)
+        in_row = np.zeros((t,), np.int32)
+        q_start = np.zeros((b,), np.int32)
+        q_count = np.zeros((b,), np.int32)
+        temp = np.zeros((b,), np.float32)
+        top_p = np.ones((b,), np.float32)
+        kv_len = self._kv_shadow.copy()
+        for work in plan.work:
+            row = self._rows[work.req_id]
+            span = slice(work.start, work.start + work.count)
+            if row.decoding:
+                ids[work.start] = row.generated[-1]
+                pos[work.start] = row.kv_len
+            else:
+                ids[span] = row.tokens[row.pos : row.pos + work.count]
+                pos[span] = np.arange(
+                    row.pos, row.pos + work.count, dtype=np.int32
+                )
+            rows[span] = work.slot
+            valid[span] = True
+            in_row[span] = np.arange(work.count, dtype=np.int32)
+            q_start[work.slot] = work.start
+            q_count[work.slot] = work.count
+            kv_len[work.slot] = int(pos[work.start + work.count - 1]) + 1
+            temp[work.slot] = row.params.temperature
+            top_p[work.slot] = row.params.top_p
+        paged = g.paged_cache
+        if self._staged_tables:
+            from ...ops.paged_attention import PagedKVCache
+
+            idx = jnp.asarray(
+                [slot for slot, _ in self._staged_tables], jnp.int32
+            )
+            tables = jnp.asarray(
+                np.stack([tab for _, tab in self._staged_tables]), jnp.int32
+            )
+            paged = PagedKVCache(
+                k_pages=paged.k_pages, v_pages=paged.v_pages,
+                page_table=paged.page_table.at[idx].set(tables),
+                lengths=paged.lengths,
+            )
+            self._staged_tables.clear()
+        new_paged, next_tokens, rng = self._get_fn()(
+            g.params, paged,
+            jnp.asarray(ids), jnp.asarray(rows), jnp.asarray(pos),
+            jnp.asarray(valid), jnp.asarray(in_row),
+            jnp.asarray(q_start), jnp.asarray(q_count), jnp.asarray(kv_len),
+            g._rng, jnp.asarray(temp), jnp.asarray(top_p),
+        )
+        g.paged_cache = new_paged
+        g._rng = rng
+        self._kv_shadow = kv_len
+        return np.asarray(next_tokens)
+
+    # -- commit --------------------------------------------------------
+
+    def _release_row(self, row: _Row) -> None:
+        """Recycle the row's slot + pages NOW.  The freed pages may be
+        granted to a new row this very step: the dead row's stale page
+        table entries are never read again (its shadow kv length is 0,
+        so the ragged kernel walks zero pages) and are overwritten by
+        staging at the slot's next admission — no trash-page indirection
+        needed, unlike the wave engine's always-dispatch-all-slots
+        decode block."""
+        g = self.generator
+        g.allocator.release(row.pages)
+        g.slots[row.slot] = _Slot()
+        self._kv_shadow[row.slot] = 0
+        self._rows.pop(row.req_id, None)
+        self.metrics.incr("sched_recycled_slot")
+
+    def _finish(self, row: _Row, reason: str) -> GenerationResult:
+        eos = self.generator.tokenizer.eos_id
+        ids = [t for t in row.generated if t != eos]
+        if reason == "length" and row.params.deadline_clamped:
+            reason = "deadline"
+        now = time.perf_counter()
+        result = GenerationResult(
+            text=self.generator.tokenizer.decode(ids),
+            token_ids=ids,
+            prompt_tokens=row.prompt_len,
+            completion_tokens=len(ids),
+            finish_reason=reason,
+            prefill_ms=row.prefill_ms,
+            decode_ms=(now - row.started) * 1e3 if row.started else 0.0,
+        )
+        self._release_row(row)
+        return result
+
+    def _commit(
+        self, plan: StepPlan, toks: np.ndarray, elapsed_ms: float
+    ) -> list[StepOutcome]:
+        outcomes: list[StepOutcome] = []
+        g = self.generator
+        eos = g.tokenizer.eos_id
+        # the step's compute is attributed to its rows by token share —
+        # good enough for the prefill/decode split the spans surface
+        share = elapsed_ms / max(1, plan.tokens_planned)
+        for work in plan.work:
+            row = self._rows.get(work.req_id)
+            if row is None:
+                continue  # cancelled between dispatch and commit
+            token = int(toks[work.slot])
+            if not row.decoding:
+                row.pos += work.count
+                row.prefill_ms += share * work.count
+                if not row.decoding:
+                    # mid-prompt chunk: more prefill next step
+                    if not row.chunked:
+                        row.chunked = True
+                        self.metrics.incr("sched_chunked_prefill")
+                    continue
+                # prompt completed THIS step: the sampled token is the
+                # row's first generated token (wave-engine semantics:
+                # the prefill-sampled token counts toward max_tokens)
+                row.started = time.perf_counter()
+                row.generated = [token]
+                self.metrics.record("prefill", row.prefill_ms)
+            else:
+                row.generated.append(token)
+            finished = None
+            if row.params.stop_on_eos and eos is not None and token == eos:
+                finished = "stop"
+            elif len(row.generated) >= row.params.max_tokens:
+                finished = "length"
+            elif row.kv_len + 1 >= g.max_seq:
+                # the NEXT decode token would write past the sequence
+                # cap; synchronous stepping needs a one-token margin only
+                finished = "length"
+            if finished is not None:
+                outcomes.append(
+                    StepOutcome(work.req_id, result=self._finish(row, finished))
+                )
+            elif (
+                self.partial_hook is not None
+                and row.decoding
+                and row.generated
+            ):
+                # list COPY: the hook crosses into the event-loop thread
+                self.partial_hook(row.req_id, list(row.generated))
+        return outcomes

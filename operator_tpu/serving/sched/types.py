@@ -1,0 +1,105 @@
+"""Scheduler data types: row state and the per-step ragged wave plan.
+
+Split from :mod:`.scheduler` so tests (and the determinism assertion:
+a fixed arrival trace must produce a byte-identical plan sequence) can
+inspect plans without importing the dispatch machinery.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..types import SamplingParams
+
+
+@dataclass(frozen=True)
+class SchedConfig:
+    """Continuous-scheduler knobs (OperatorConfig ``sched_*``).
+
+    ``chunk`` bounds the prefill tokens ONE row may contribute to a step
+    (Sarathi-style chunking: a storm of long prompts can stall in-flight
+    decodes for at most one chunk's compute per step).  ``token_budget``
+    is the flat token axis of the mixed program — decode rows take one
+    token each off the top, prefill chunks fill the remainder; it must
+    be >= ``max_slots`` so a full decode batch can never be starved
+    (enforced at construction)."""
+
+    chunk: int = 64
+    token_budget: int = 0  # 0 = auto: max(chunk, max_slots)
+
+
+@dataclass
+class _Row:
+    """One live row of the running wave: a request at an arbitrary
+    prefill-chunk or decode position."""
+
+    req_id: int
+    slot: int
+    tokens: list[int]  # full (truncated) prompt token ids
+    params: SamplingParams
+    pages: list[int]
+    pos: int = 0  # prompt tokens already written to the KV pages
+    generated: list[int] = field(default_factory=list)
+    submitted: float = 0.0  # perf_counter at admission
+    started: float = 0.0  # perf_counter when the prompt completed
+    prefill_ms: float = 0.0  # accumulated chunk compute share
+    chunked: bool = False  # took more than one step of prefill
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.tokens)
+
+    @property
+    def decoding(self) -> bool:
+        return self.pos >= self.prompt_len
+
+    @property
+    def kv_len(self) -> int:
+        """Tokens currently valid in this row's pages."""
+        if not self.decoding:
+            return self.pos
+        # the freshest sampled token has not been written yet; every
+        # earlier one has (prompt + generated[:-1])
+        return self.prompt_len + max(0, len(self.generated) - 1)
+
+
+@dataclass
+class RowWork:
+    """One row's share of a step: ``count`` tokens starting at flat
+    offset ``start`` (``kind`` is forensics only — the program does not
+    distinguish phases)."""
+
+    slot: int
+    req_id: int
+    start: int  # flat offset of the row's first token this step
+    count: int
+    kind: str  # "prefill" | "finish" | "decode"
+
+
+@dataclass
+class StepPlan:
+    """The ragged wave one dispatch serves; ``trace()`` is the stable
+    serialisation the determinism test replays."""
+
+    work: list[RowWork] = field(default_factory=list)
+    tokens_planned: int = 0
+    decode_rows: int = 0
+    prefill_rows: int = 0
+    deferred_decode: int = 0  # decode-ready rows left out (stall signal)
+    admitted: list[int] = field(default_factory=list)  # req ids admitted NOW
+
+    def trace(self) -> tuple:
+        return tuple(
+            (w.slot, w.req_id, w.start, w.count, w.kind) for w in self.work
+        )
+
+
+@dataclass
+class StepOutcome:
+    """One finished request: the result (or the admission-time error)
+    the engine resolves its future with."""
+
+    req_id: int
+    result: Optional[Any] = None  # GenerationResult
+    error: Optional[BaseException] = None

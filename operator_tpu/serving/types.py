@@ -114,6 +114,26 @@ def _bucket(n: int, floor: int, cap: int) -> int:
     return min(size, cap)
 
 
+def prompt_budget(max_seq: int, max_tokens: int) -> int:
+    """Prompt-token budget for truncation: leave room for at least one
+    generated token, and never let the generation reservation eat more
+    than half the sequence.  The ONE formula both admission paths use
+    (AdmissionMixin.admit and the continuous Scheduler.enqueue) — a
+    drift here would make the two modes truncate the same prompt
+    differently."""
+    return max_seq - max(1, min(max_tokens, max_seq // 2))
+
+
+def pages_needed(
+    prompt_tokens: int, max_tokens: int, max_seq: int, page_size: int
+) -> int:
+    """Worst-case KV pages a request needs (prompt + full generation,
+    clamped to the sequence cap) — the grant both admission paths make
+    up front so the page table stays static for the row's lifetime."""
+    total = min(prompt_tokens + max_tokens, max_seq)
+    return -(-total // page_size)
+
+
 class PageAllocator:
     """Host-side free list for the paged KV cache (ops/paged_attention.py).
 

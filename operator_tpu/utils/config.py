@@ -76,6 +76,12 @@ class OperatorConfig:
     # and its half-open probe re-admits it quickly once healthy
     router_replica_failure_threshold: int = 3
     router_replica_reset_s: float = 10.0
+    # background /healthz polling (operator/app.py): the operator probes
+    # every routed serving replica at this cadence and feeds the router's
+    # HealthBoard, so load-fed shedding works even when no request
+    # traffic is producing load reports; each probe is bounded by
+    # kube_call_timeout_s.  0 = off (passive breaker-only gating).
+    router_health_poll_s: float = 15.0
     # this serving replica's identity on GET /healthz ("" = POD_NAME, then
     # hostname) — what the router's probes and AIResponse.replica_id carry
     serving_replica_id: str = ""
@@ -203,6 +209,19 @@ class OperatorConfig:
     # tokens per engine round so long prefills don't stall in-flight
     # decodes; 0 = one-shot prefill (power of two when set)
     prefill_chunk: int = 0
+    # continuous-batching scheduler (serving/sched/, docs/SERVING.md):
+    # "continuous" replaces the wave machinery with the explicit
+    # schedule→dispatch→commit loop over ONE ragged mixed prefill+decode
+    # program — token-level admission into the running wave, per-token
+    # slot/page recycling.  Requires paged KV, no mesh, no guided/LoRA
+    # traffic.  "wave" (default until the mixed kernel is TPU-validated,
+    # the flash-prefill discipline) keeps the phase-separated engine.
+    sched_mode: str = "wave"  # "wave" | "continuous"
+    # max prefill tokens ONE row contributes to a step (Sarathi chunk)
+    sched_chunk: int = 64
+    # flat token axis of the mixed program (>= max_batch_size so a full
+    # decode batch always fits); 0 = max(sched_chunk, max_batch_size)
+    sched_token_budget: int = 0
     # shared-prefix KV caching (engine.set_shared_prefix): the default
     # prompt template's static preamble is prefilled once and admissions
     # forward only their suffix; paged mode only, exact (causal) reuse

@@ -651,3 +651,108 @@ def test_replica_kill_breaker_drains_follow_up_traffic():
     assert counters.get("router_excluded") == 1
     assert counters.get("router_failover") == 2
     assert router.health.breakers.for_key("a").state == "open"
+
+
+class TestBackgroundHealthPoll:
+    """Background /healthz polling (ISSUE 7 satellite): the operator's
+    poll loop feeds probe verdicts AND load reports into the HealthBoard
+    without any request traffic, bounded by a timeout at the call."""
+
+    def _healthz_opener(self, payloads: dict):
+        """GET transport: serves per-netloc /healthz payloads; a netloc
+        mapped to an Exception raises it (dead replica)."""
+        import io
+        import urllib.parse
+
+        seen = []
+
+        class _Resp(io.BytesIO):
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+        def opener(req, timeout=None):
+            url = req.full_url if hasattr(req, "full_url") else str(req)
+            seen.append((url, timeout))
+            netloc = urllib.parse.urlsplit(url).netloc
+            payload = payloads[netloc]
+            if isinstance(payload, Exception):
+                raise payload
+            return _Resp(json.dumps(payload).encode())
+
+        opener.seen = seen
+        return opener
+
+    def test_poll_feeds_probe_and_load_without_traffic(self):
+        metrics = MetricsRegistry()
+        opener = self._healthz_opener({
+            "r1:8000": {"status": "ok", "replica": "r1",
+                        "load": {"queueDepth": 7, "inflight": 2,
+                                 "decodeTokenS": 0.01, "gaveUp": False}},
+            "r2:8000": {"status": "degraded", "replica": "r2",
+                        "load": {"queueDepth": 0, "inflight": 0,
+                                 "decodeTokenS": 0.0, "gaveUp": True}},
+            "r3:8000": urllib.error.URLError("connection refused"),
+        })
+        provider = OpenAICompatProvider(opener, metrics=metrics)
+        replicas = [Replica(id=f"http://r{i}:8000/v1",
+                            url=f"http://r{i}:8000/v1") for i in (1, 2, 3)]
+        router = provider.router_for(replicas)
+
+        polled = run(provider.poll_replica_health(timeout_s=3.0))
+        assert polled == 2  # r3 is dead
+        # every probe carried a timeout AT the call (GL003 discipline)
+        assert opener.seen and all(t == 3.0 for _, t in opener.seen)
+        # probes hit /healthz at the replica ROOT, not under /v1
+        assert all(u.endswith("/healthz") for u, _ in opener.seen)
+        health = router.health
+        assert health.can_route("http://r1:8000/v1")
+        assert not health.can_route("http://r2:8000/v1")  # degraded probe
+        assert not health.can_route("http://r3:8000/v1")  # failed probe
+        # the load REPORT landed too: r1's queue depth is visible to shed
+        assert health.for_replica("http://r1:8000/v1").load.queue_depth == 7
+        assert health.for_replica("http://r1:8000/v1").load.pressure() == 9
+        counters = metrics.snapshot()["counters"]
+        assert counters.get("router_health_poll") == 2
+        assert counters.get("router_health_poll_failed") == 1
+
+    def test_recovered_replica_readmitted_on_next_sweep(self):
+        metrics = MetricsRegistry()
+        payloads = {
+            "r1:8000": urllib.error.URLError("down"),
+        }
+        opener = self._healthz_opener(payloads)
+        provider = OpenAICompatProvider(opener, metrics=metrics)
+        router = provider.router_for(
+            [Replica(id="http://r1:8000", url="http://r1:8000")]
+        )
+        run(provider.poll_replica_health(timeout_s=1.0))
+        assert not router.health.can_route("http://r1:8000")
+        payloads["r1:8000"] = {"status": "ok", "load": {"queueDepth": 0}}
+        run(provider.poll_replica_health(timeout_s=1.0))
+        assert router.health.can_route("http://r1:8000")
+
+    def test_foreign_healthz_body_fails_the_probe(self):
+        """A load balancer answering /healthz with its own shape (no
+        'status' string, or a bare JSON scalar) must NOT readmit the
+        replica — and must not abort the sweep for its siblings."""
+        metrics = MetricsRegistry()
+        opener = self._healthz_opener({
+            "r1:8000": {"healthy": True},      # object, foreign shape
+            "r2:8000": "ok",                   # valid JSON, not an object
+            "r3:8000": {"status": "ok"},       # the real engine shape
+        })
+        provider = OpenAICompatProvider(opener, metrics=metrics)
+        router = provider.router_for([
+            Replica(id=f"http://r{i}:8000", url=f"http://r{i}:8000")
+            for i in (1, 2, 3)
+        ])
+        polled = run(provider.poll_replica_health(timeout_s=1.0))
+        assert polled == 1  # only the real engine counts
+        assert not router.health.can_route("http://r1:8000")
+        assert not router.health.can_route("http://r2:8000")
+        assert router.health.can_route("http://r3:8000")
+        counters = metrics.snapshot()["counters"]
+        assert counters.get("router_health_poll_failed") == 2

@@ -1,0 +1,505 @@
+"""Continuous-batching scheduler (serving/sched/) + the ragged
+mixed-phase kernel (ops/ragged_attention.py).
+
+Covers the ISSUE 7 acceptance surface: ragged-kernel parity against the
+dense reference (prefill-only / decode-only / mixed rows, interpret
+mode), greedy parity of the mixed program against the wave engine,
+token-level admission into a RUNNING wave, per-token slot+page recycling
+with a leak audit, the seeded engine-stall chaos scenario under the new
+loop (supervisor requeue, replayed byte-identically twice), and schedule
+determinism for a fixed arrival trace.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from operator_tpu.models import TINY_TEST, init_params  # noqa: E402
+from operator_tpu.models.tokenizer import ByteTokenizer  # noqa: E402
+from operator_tpu.ops.ragged_attention import (  # noqa: E402
+    _ragged_attention_pallas,
+    ragged_attention_reference,
+)
+from operator_tpu.serving.engine import (  # noqa: E402
+    BatchedGenerator,
+    OversizedRequest,
+    SamplingParams,
+    ServingEngine,
+    SupervisorPolicy,
+)
+from operator_tpu.serving.sched import Scheduler  # noqa: E402
+from operator_tpu.utils.timing import MetricsRegistry  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def make_generator(params, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_seq", 128)
+    kw.setdefault("page_size", 16)
+    return BatchedGenerator(
+        params, TINY_TEST, ByteTokenizer(), paged=True,
+        cache_dtype=jnp.float32, metrics=MetricsRegistry(), **kw,
+    )
+
+
+def drain(sched, want, limit=300):
+    """Step until ``want`` requests finished; returns {req_id: outcome}."""
+    done = {}
+    for _ in range(limit):
+        for outcome in sched.step():
+            done[outcome.req_id] = outcome
+        if len(done) >= want:
+            return done
+    raise AssertionError(f"only {len(done)}/{want} finished in {limit} steps")
+
+
+def assert_no_leaks(generator):
+    assert len(generator.free_slots()) == generator.max_slots
+    assert generator.allocator.available == generator.allocator.num_pages - 1
+
+
+# ---------------------------------------------------------------------------
+# ragged kernel parity (interpret mode vs dense reference)
+# ---------------------------------------------------------------------------
+
+
+class TestRaggedKernel:
+    def _setup(self, rng, b=4, c=8, qh=4, kh=2, d=16, ps=8, pps=6):
+        num_pages = b * pps + 1
+        k_pages = jnp.asarray(
+            rng.normal(size=(num_pages, ps, kh, d)), jnp.float32
+        )
+        v_pages = jnp.asarray(
+            rng.normal(size=(num_pages, ps, kh, d)), jnp.float32
+        )
+        table = np.zeros((b, pps), np.int32)
+        free = list(range(1, num_pages))
+        for row in range(b):
+            for j in range(pps):
+                table[row, j] = free.pop(0)
+        q = jnp.asarray(rng.normal(size=(b, c, qh, d)), jnp.float32)
+        return q, k_pages, v_pages, jnp.asarray(table)
+
+    def _check(self, q, k_pages, v_pages, table, kv_len, q_count, window=None):
+        ref = ragged_attention_reference(
+            q, k_pages, v_pages, table, kv_len, q_count, sliding_window=window
+        )
+        got = _ragged_attention_pallas(
+            q, k_pages, v_pages, table, kv_len, q_count,
+            interpret=True, sliding_window=window,
+        )
+        for row in range(q.shape[0]):
+            n = int(q_count[row])
+            if n == 0:
+                continue  # padding rows are garbage in both by contract
+            np.testing.assert_allclose(
+                np.asarray(got[row, :n]), np.asarray(ref[row, :n]),
+                rtol=2e-5, atol=2e-5,
+            )
+
+    def test_prefill_only_rows(self):
+        rng = np.random.default_rng(0)
+        q, k, v, table = self._setup(rng)
+        # whole-prompt prefill: kv_len == q_count (q positions 0..n-1)
+        kv_len = jnp.asarray([8, 5, 8, 3], jnp.int32)
+        q_count = kv_len
+        self._check(q, k, v, table, kv_len, q_count)
+
+    def test_decode_only_rows(self):
+        rng = np.random.default_rng(1)
+        q, k, v, table = self._setup(rng)
+        kv_len = jnp.asarray([17, 30, 9, 1], jnp.int32)
+        q_count = jnp.asarray([1, 1, 1, 1], jnp.int32)
+        self._check(q, k, v, table, kv_len, q_count)
+
+    def test_mixed_rows(self):
+        """One wave: a decode row, a mid-prompt chunk, a whole-prompt
+        prefill, and an inactive row — the shape the scheduler
+        dispatches every step."""
+        rng = np.random.default_rng(2)
+        q, k, v, table = self._setup(rng)
+        kv_len = jnp.asarray([17, 20, 8, 0], jnp.int32)
+        q_count = jnp.asarray([1, 6, 8, 0], jnp.int32)
+        self._check(q, k, v, table, kv_len, q_count)
+
+    def test_mixed_rows_sliding_window(self):
+        rng = np.random.default_rng(3)
+        q, k, v, table = self._setup(rng)
+        kv_len = jnp.asarray([33, 20, 8, 12], jnp.int32)
+        q_count = jnp.asarray([1, 6, 8, 1], jnp.int32)
+        self._check(q, k, v, table, kv_len, q_count, window=7)
+
+    def test_decode_matches_paged_attention_kernel_semantics(self):
+        """A q_count==1 ragged row must equal the dedicated decode
+        kernel's oracle for the same cache — decode really is the
+        special case of the one program."""
+        from operator_tpu.ops.paged_attention import paged_attention_reference
+
+        rng = np.random.default_rng(4)
+        q, k, v, table = self._setup(rng)
+        kv_len = jnp.asarray([17, 30, 9, 2], jnp.int32)
+        q_count = jnp.asarray([1, 1, 1, 1], jnp.int32)
+        ragged = ragged_attention_reference(q, k, v, table, kv_len, q_count)
+        decode = paged_attention_reference(q[:, 0], k, v, table, kv_len)
+        np.testing.assert_allclose(
+            np.asarray(ragged[:, 0]), np.asarray(decode), rtol=2e-5, atol=2e-5
+        )
+
+
+# ---------------------------------------------------------------------------
+# scheduler: parity, admission, recycling
+# ---------------------------------------------------------------------------
+
+
+class TestSchedulerParity:
+    def test_greedy_matches_wave_engine(self, params):
+        prompt = "pod crashed with exit code 137"
+        sampling = SamplingParams(max_tokens=8, temperature=0.0)
+        wave = make_generator(params).generate(prompt, sampling)
+
+        generator = make_generator(params)
+        sched = Scheduler(generator, chunk=16, token_budget=32)
+        req_id = sched.enqueue(prompt, sampling)
+        outcome = drain(sched, 1)[req_id]
+        assert outcome.error is None
+        assert outcome.result.token_ids == wave.token_ids
+        assert outcome.result.prompt_tokens == wave.prompt_tokens
+        assert_no_leaks(generator)
+
+    def test_cobatched_mixed_wave_matches_solo(self, params):
+        """Rows co-batched at DIFFERENT phases (one decoding, one
+        chunk-prefilling) must each produce their solo greedy tokens —
+        the ragged program's cross-row isolation proof."""
+        prompts = [
+            "pod crashed with exit code 137",
+            "a much longer prompt " * 8,  # chunked over several steps
+            "OOMKilled",
+        ]
+        sampling = SamplingParams(max_tokens=6, temperature=0.0)
+        solo = {}
+        for prompt in prompts:
+            solo[prompt] = make_generator(params).generate(
+                prompt, sampling
+            ).token_ids
+
+        generator = make_generator(params)
+        sched = Scheduler(generator, chunk=16, token_budget=32)
+        ids = {sched.enqueue(p, sampling): p for p in prompts}
+        done = drain(sched, len(prompts))
+        for req_id, prompt in ids.items():
+            assert done[req_id].result.token_ids == solo[prompt], prompt
+        assert_no_leaks(generator)
+
+
+class TestTokenLevelAdmission:
+    def test_admitted_into_running_wave(self, params):
+        """A request queued while another row is mid-generation joins at
+        the NEXT step — no block boundary, no wave drain."""
+        generator = make_generator(params)
+        sched = Scheduler(generator, chunk=16, token_budget=32)
+        sampling = SamplingParams(max_tokens=12, temperature=0.0,
+                                  stop_on_eos=False)
+        first = sched.enqueue("long running request " * 4, sampling)
+        for _ in range(4):
+            sched.step()
+        assert sched.num_active == 1  # first is mid-generation
+        mid = sched.enqueue("late arrival", sampling)
+        sched.step()
+        assert sched.num_active == 2  # joined the RUNNING wave
+        assert generator.metrics.counter("sched_admitted_midwave") == 1
+        done = drain(sched, 2)
+        assert done[first].error is None and done[mid].error is None
+        assert_no_leaks(generator)
+
+    def test_chunked_prefill_never_starves_decodes(self, params):
+        """While a long prompt chunk-prefills, decoding rows get a token
+        EVERY step (zero stall steps) — the Sarathi property, asserted
+        end to end."""
+        generator = make_generator(params, max_seq=256)
+        sched = Scheduler(generator, chunk=16, token_budget=32)
+        short = sched.enqueue(
+            "short", SamplingParams(max_tokens=20, temperature=0.0,
+                                    stop_on_eos=False),
+        )
+        sched.step()  # short is decoding now
+        long_prompt = "a very long prompt that needs many chunks " * 4
+        long = sched.enqueue(
+            long_prompt, SamplingParams(max_tokens=4, temperature=0.0,
+                                        stop_on_eos=False),
+        )
+        done = drain(sched, 2)
+        assert sched.stall_steps == 0
+        assert generator.metrics.counter("sched_stall_step") == 0
+        assert generator.metrics.counter("sched_chunked_prefill") >= 1
+        assert generator.metrics.counter("sched_stall_free_step") == sched.steps
+        assert done[short].result.completion_tokens == 20
+        assert done[long].result.completion_tokens == 4
+        assert_no_leaks(generator)
+
+
+class TestPerTokenRecycling:
+    def test_finished_row_recycles_slot_and_pages_immediately(self, params):
+        """When a row hits its token budget, its slot AND pages are free
+        for the very next step's admission — not decode_block-1 junk
+        tokens later."""
+        generator = make_generator(params)
+        sched = Scheduler(generator, chunk=16, token_budget=32)
+        available_before = generator.allocator.available
+        sampling = SamplingParams(max_tokens=2, temperature=0.0,
+                                  stop_on_eos=False)
+        first = sched.enqueue("finishes fast", sampling)
+        done = drain(sched, 1)
+        assert done[first].result.completion_tokens == 2
+        # the moment the outcome is returned, everything is back
+        assert generator.allocator.available == available_before
+        assert len(generator.free_slots()) == generator.max_slots
+        assert generator.metrics.counter("sched_recycled_slot") == 1
+
+    def test_freed_capacity_admits_backpressured_request_next_step(self, params):
+        """Queue more work than the pool can hold: the backpressured
+        request must be admitted on the first step after a finishing row
+        releases its pages (per-token recycling feeds admission)."""
+        # page pool sized so only ONE request fits at a time
+        generator = make_generator(
+            params, max_slots=2, kv_pages=6, page_size=16, max_seq=96
+        )
+        sched = Scheduler(generator, chunk=16, token_budget=32)
+        sampling = SamplingParams(max_tokens=3, temperature=0.0,
+                                  stop_on_eos=False)
+        hog = sched.enqueue("a prompt that hogs the kv pool " * 2, sampling)
+        sched.step()
+        waiter = sched.enqueue("waits for pages", sampling)
+        assert sched.queue_depth == 1  # backpressured, not dropped
+        done = drain(sched, 2)
+        assert done[hog].error is None and done[waiter].error is None
+        assert_no_leaks(generator)
+
+    def test_cancel_live_row_reclaims_now(self, params):
+        generator = make_generator(params)
+        sched = Scheduler(generator, chunk=16, token_budget=32)
+        req = sched.enqueue(
+            "cancelled mid-flight",
+            SamplingParams(max_tokens=50, temperature=0.0, stop_on_eos=False),
+        )
+        sched.step()
+        sched.step()
+        assert sched.num_active == 1
+        assert sched.cancel(req) is True
+        assert sched.num_active == 0
+        assert_no_leaks(generator)
+
+    def test_oversized_request_refused_at_enqueue(self, params):
+        generator = make_generator(
+            params, max_slots=2, kv_pages=3, page_size=16, max_seq=96
+        )
+        sched = Scheduler(generator, chunk=16, token_budget=32)
+        with pytest.raises(OversizedRequest):
+            sched.enqueue(
+                "x" * 300,
+                SamplingParams(max_tokens=64, temperature=0.0),
+            )
+
+
+class TestDeterminism:
+    def test_fixed_arrival_trace_yields_identical_schedule(self, params):
+        """Same arrival script, two fresh schedulers: the per-step plan
+        sequence (slots, offsets, counts, kinds) and every result must
+        be byte-identical — the property the chaos replay harness
+        builds on."""
+
+        def run_once():
+            generator = make_generator(params)
+            sched = Scheduler(generator, chunk=16, token_budget=32)
+            sched.plan_log = []
+            sampling = SamplingParams(max_tokens=6, temperature=0.0,
+                                      stop_on_eos=False)
+            arrivals = {
+                0: [("pod crashed with exit code 137", sampling)],
+                2: [("a longer second prompt " * 3, sampling),
+                    ("third", sampling)],
+                5: [("fourth arrival", sampling)],
+            }
+            results = {}
+            for step_i in range(60):
+                for prompt, params_ in arrivals.get(step_i, ()):
+                    sched.enqueue(prompt, params_)
+                for outcome in sched.step():
+                    results[outcome.req_id] = outcome.result.token_ids
+                if len(results) == 4:
+                    break
+            return sched.plan_log, results
+
+        plans_a, results_a = run_once()
+        plans_b, results_b = run_once()
+        assert plans_a == plans_b
+        assert results_a == results_b
+
+
+# ---------------------------------------------------------------------------
+# engine integration: deadlines, streaming, supervisor chaos
+# ---------------------------------------------------------------------------
+
+
+def _sched_engine(params, *, supervisor=None, **gen_kw):
+    generator = make_generator(params, **gen_kw)
+    sched = Scheduler(generator, chunk=16, token_budget=32)
+    engine = ServingEngine(generator, scheduler=sched, supervisor=supervisor)
+    return engine, generator, sched
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestEngineIntegration:
+    def test_concurrent_generate_and_streaming(self, params):
+        engine, generator, _sched = _sched_engine(params)
+
+        async def scenario():
+            await engine.start()
+            sampling = SamplingParams(max_tokens=5, temperature=0.0)
+            parts = []
+            results = await asyncio.gather(
+                engine.generate("one", sampling),
+                engine.generate("two", sampling,
+                                on_partial=lambda ids: parts.append(len(ids))),
+                engine.generate("three", sampling, priority=10),
+            )
+            await asyncio.sleep(0.05)
+            assert all(r.completion_tokens > 0 for r in results)
+            assert parts and parts == sorted(parts)
+            await engine.close()
+
+        run(scenario())
+        assert_no_leaks(generator)
+
+    def test_guided_and_lora_refused_at_submit(self, params):
+        engine, generator, _sched = _sched_engine(params)
+
+        async def scenario():
+            await engine.start()
+            with pytest.raises(ValueError, match="continuous"):
+                await engine.generate(
+                    "x", SamplingParams(guided_choice=("a", "b"))
+                )
+            with pytest.raises(ValueError, match="continuous|adapter"):
+                await engine.generate(
+                    "x", SamplingParams(adapter="nope")
+                )
+            await engine.close()
+
+        run(scenario())
+
+    def test_expired_deadline_fails_in_scheduler_queue(self, params):
+        engine, generator, sched = _sched_engine(params)
+        from operator_tpu.serving.engine import DeadlineExceeded
+
+        async def scenario():
+            await engine.start()
+            # warm the roofline estimate so submit passes, then expire
+            await engine.generate(
+                "warm", SamplingParams(max_tokens=2, temperature=0.0,
+                                       stop_on_eos=False),
+            )
+            clock = generator._clock
+            expired = SamplingParams(
+                max_tokens=4, temperature=0.0, deadline=clock() + 0.0005
+            )
+            with pytest.raises(DeadlineExceeded):
+                await engine.generate("too late" * 40, expired)
+            await engine.close()
+
+        run(scenario())
+        assert_no_leaks(generator)
+
+
+class TestSupervisorChaos:
+    def _stall_scenario(self, params, seed):
+        """Seeded engine-stall chaos under the continuous loop: warm,
+        wedge the second step past the watchdog budget, assert the
+        supervisor requeues and the request completes.  Returns the
+        replay-identity record."""
+        from operator_tpu.utils.faultinject import OK, FaultPlan, sleep_
+
+        generator = make_generator(params)
+        sched = Scheduler(generator, chunk=16, token_budget=32)
+        policy = SupervisorPolicy(stall_timeout_s=120.0, join_grace_s=2.0)
+        engine = ServingEngine(generator, scheduler=sched, supervisor=policy)
+
+        async def scenario():
+            await engine.start()
+            await engine.generate(
+                "warm", SamplingParams(max_tokens=2, temperature=0.0,
+                                       stop_on_eos=False),
+            )
+            policy.stall_timeout_s = 0.4
+            plan = FaultPlan(seed=seed)
+            plan.rule("engine.step", [OK, sleep_(1.5)])
+            generator.fault_plan = plan
+            result = await asyncio.wait_for(
+                engine.generate(
+                    "stalled mid-decode then requeued",
+                    SamplingParams(max_tokens=12, temperature=0.0,
+                                   stop_on_eos=False),
+                ),
+                30,
+            )
+            generator.fault_plan = None
+            assert plan.pending() == {}, plan.pending()
+            await engine.close()
+            return result
+
+        result = run(scenario())
+        assert_no_leaks(generator)
+        counters = generator.metrics.snapshot()["counters"]
+        assert counters.get("supervisor_restart") == 1
+        assert counters.get("supervisor_requeue") == 1
+        assert not counters.get("supervisor_gaveup")
+        assert not counters.get("supervisor_leak")
+        return {
+            "token_ids": result.token_ids,
+            "finish_reason": result.finish_reason,
+            "completion_tokens": result.completion_tokens,
+            "restarts": counters.get("supervisor_restart"),
+            "requeues": counters.get("supervisor_requeue"),
+        }
+
+    def test_engine_stall_requeues_and_replays_byte_identically(self, params):
+        first = self._stall_scenario(params, seed=11)
+        second = self._stall_scenario(params, seed=11)
+        assert first == second
+
+
+def test_expired_queued_request_fails_even_with_all_slots_busy(params):
+    """The expiry sweep covers the WHOLE scheduler queue every step,
+    regardless of capacity — an expired caller must not hang until a
+    slot frees (the wave path's sweep fires on every loop round)."""
+    from operator_tpu.serving.engine import DeadlineExceeded
+
+    generator = make_generator(params, max_slots=1)
+    sched = Scheduler(generator, chunk=16, token_budget=32)
+    busy = sched.enqueue(
+        "holds the only slot",
+        SamplingParams(max_tokens=30, temperature=0.0, stop_on_eos=False),
+    )
+    sched.step()  # the only slot is now occupied
+    fake_now = [generator._clock()]
+    generator._clock = lambda: fake_now[0]
+    doomed = sched.enqueue(
+        "expires while queued",
+        SamplingParams(max_tokens=4, temperature=0.0,
+                       deadline=fake_now[0] + 0.5),
+    )
+    fake_now[0] += 1.0  # deadline passes with zero free slots
+    outcomes = {o.req_id: o for o in sched.step()}
+    assert doomed in outcomes, "expired entry not swept without capacity"
+    assert isinstance(outcomes[doomed].error, DeadlineExceeded)
+    assert sched.cancel(busy)
