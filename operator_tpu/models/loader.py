@@ -25,6 +25,8 @@ import json
 import logging
 import os
 import re
+import threading
+import time
 from typing import Any, Callable, Iterable, Mapping, Optional
 
 import jax
@@ -339,3 +341,64 @@ def load_params(
         return place(value, sharding)
 
     return convert_hf_state_dict(state, config, dtype, put=put)
+
+
+class _AsyncLoad:
+    """Handle for an in-flight streamed weight load (``load_params_async``).
+
+    The load streams safetensors groups onto device from a daemon thread:
+    HBM transfers overlap host-side work — in the serving provider that is
+    the AOT-cache preload + any live compiles, which need only SHAPES, not
+    weight values (serving/provider.py bring-up overlap).  ``result()``
+    joins and re-raises any load failure on the caller."""
+
+    def __init__(self, target, args, kwargs) -> None:
+        self._result: Any = None
+        self._error: Optional[BaseException] = None
+        self._started = time.perf_counter()
+        self.seconds: Optional[float] = None
+
+        def _run() -> None:
+            try:
+                self._result = target(*args, **kwargs)
+            except BaseException as exc:  # noqa: BLE001 - re-raised in result()
+                self._error = exc
+            finally:
+                self.seconds = time.perf_counter() - self._started
+
+        self._thread = threading.Thread(
+            target=_run, name="weight-stream", daemon=True
+        )
+        self._thread.start()
+
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+    def result(self, timeout: Optional[float] = None) -> Params:
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("weight stream still loading")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+def load_params_async(
+    checkpoint_dir: str,
+    config: ModelConfig,
+    dtype: jnp.dtype = jnp.bfloat16,
+    *,
+    shardings: Optional[Mapping[str, Any]] = None,
+    quantize: bool = False,
+) -> _AsyncLoad:
+    """Start ``load_params`` on a background thread and return a handle.
+
+    Safe to overlap with tracing/lowering/AOT-cache deserialization: jax
+    device_put and the quantize jit are thread-safe, and the consumer only
+    touches params after ``result()``.  The GIL releases during the actual
+    HBM transfers and safetensors reads, so the overlap is real, not
+    cooperative."""
+    return _AsyncLoad(
+        load_params, (checkpoint_dir, config, dtype),
+        {"shardings": shardings, "quantize": quantize},
+    )

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Optional
 
 from ..obs import build_tracer
@@ -232,6 +233,7 @@ class Operator:
         engine = None
         server = None
         self.engine_warmth = ENGINE_LOADING
+        bringup_t0 = time.monotonic()
         try:
             from ..serving.engine import OversizedRequest, SamplingParams
             from ..serving.httpserver import CompletionServer
@@ -358,6 +360,15 @@ class Operator:
             # until the grid is warm.
             grid = await engine.precompile(self.config.warmup_grid)
             log.info("engine warmup grid: %s", grid)
+            # cold-start observability (docs/SERVING.md "Bring-up"): weight
+            # load through grid warm; with AOT_CACHE_PATH set the grid
+            # entry carries hit/miss/live_compile counts — a warm boot
+            # shows live_compiles=0 here
+            log.info(
+                "engine bring-up ready in %.1fs (aot=%s)",
+                time.monotonic() - bringup_t0,
+                (grid or {}).get("aot", "off"),
+            )
         except asyncio.CancelledError:
             # operator stop() mid-load: not a failure, just no engine
             self.engine_warmth = ENGINE_DISABLED

@@ -338,6 +338,10 @@ class AdmissionMixin:
             "skipped_waves": skipped,
             "seconds": round(time.perf_counter() - t0, 2),
         }
+        if self._aot is not None:
+            # warm boots restore executables instead of compiling:
+            # hits > 0 and live_compiles == 0 is the warm-start signature
+            result["aot"] = self._aot.stats()
         log.info("precompile grid: %s", result)
         return result
 
@@ -531,8 +535,11 @@ class AdmissionMixin:
                     "compiling prefixed prefill bucket n=%d t_sfx=%d shared=%d "
                     "(guided=%s)", n_pad, t_pad, prefix_shared, guided,
                 )
-                self._prefix_fns[pkey] = self._make_prefill_paged_prefixed(
-                    n_pad, t_pad, prefix_shared, guided
+                self._prefix_fns[pkey] = self._aot_wrap(
+                    f"prefix_n{n_pad}_t{t_pad}_s{prefix_shared}_g{int(guided)}",
+                    self._make_prefill_paged_prefixed(
+                        n_pad, t_pad, prefix_shared, guided
+                    ),
                 )
             staged, row_tables = self._stage_page_tables(
                 n, n_pad, slot_ids, page_grants, lengths,
@@ -565,10 +572,11 @@ class AdmissionMixin:
         if key not in self._prefill_fns:
             log.info("compiling prefill bucket n=%d t=%d (paged=%s guided=%s)",
                      n_pad, t_pad, self.paged, guided)
-            self._prefill_fns[key] = (
+            self._prefill_fns[key] = self._aot_wrap(
+                f"prefill_n{n_pad}_t{t_pad}_g{int(guided)}",
                 self._make_prefill_paged(n_pad, t_pad, guided)
                 if self.paged
-                else self._make_prefill(n_pad, t_pad, guided)
+                else self._make_prefill(n_pad, t_pad, guided),
             )
 
         if self.paged:

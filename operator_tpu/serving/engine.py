@@ -78,6 +78,23 @@ from .types import (  # noqa: F401
 log = logging.getLogger(__name__)
 
 
+def _params_dtype_name(params: Any) -> str:
+    """Dtype label for the AOT-cache fingerprint: int8-quantized param
+    trees carry scale leaves, so detect via models.quant, else report the
+    first leaf's dtype."""
+    from ..models.quant import is_quantized
+
+    if is_quantized(params):
+        return "int8"
+    try:
+        import jax
+
+        leaf = jax.tree_util.tree_leaves(params)[0]
+        return str(leaf.dtype)
+    except Exception:  # noqa: BLE001 - fingerprint label only
+        return "?"
+
+
 class EngineStalled(RuntimeError):
     """The decode loop made no step progress within the supervisor's stall
     budget — the device (or its runtime) is wedged, not merely slow."""
@@ -153,6 +170,7 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
         lora_alpha: float = 16.0,
         prefill_chunk: Optional[int] = None,
         roofline_token_s: Optional[float] = None,
+        aot_cache: Any = None,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -275,6 +293,47 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
 
         self.paged = paged
         self.page_size = page_size
+
+        # ---- persisted AOT executables (serving/aotcache.py): every
+        # serving-program construction site below routes through _aot_wrap,
+        # so a warm boot (or a supervised restart) deserializes executables
+        # instead of recompiling.  ``aot_cache`` is a directory path (the
+        # generator builds + fingerprints its own cache), a prebuilt
+        # AotCache (provider overlap path), or None = off.
+        self._aot = None
+        if aot_cache is not None:
+            from .aotcache import AotCache, generator_fingerprint
+
+            if isinstance(aot_cache, AotCache):
+                self._aot = aot_cache
+                self._aot.metrics = self.metrics
+            else:
+                try:
+                    payload = generator_fingerprint(
+                        config=config,
+                        weight_dtype=_params_dtype_name(params),
+                        max_slots=max_slots,
+                        max_seq=max_seq,
+                        cache_dtype=cache_dtype,
+                        paged=paged,
+                        page_size=page_size,
+                        kv_pages=kv_pages,
+                        mesh=mesh,
+                        decode_block=decode_block,
+                        sample_top_k=sample_top_k,
+                        pipeline_depth=pipeline_depth,
+                        prefill_chunk=prefill_chunk,
+                        lora_names=[n for n in self._adapter_ids if n],
+                    )
+                    self._aot = AotCache(
+                        str(aot_cache), payload, metrics=self.metrics
+                    )
+                except Exception:  # noqa: BLE001 - cache is an optimisation only
+                    log.warning(
+                        "AOT executable cache disabled: fingerprint "
+                        "construction failed", exc_info=True,
+                    )
+
         # ---- shared-prefix KV cache (add_shared_prefix): each registered
         # prompt prefix is prefilled ONCE into generator-owned pages;
         # admitted prompts that start with one reference those pages
@@ -301,7 +360,7 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
                 block_tokens = NamedSharding(mesh, P(None, ("dp", "fsdp")))
-                self._decode_fn = jax.jit(
+                self._decode_fn = self._aot_wrap("decode", jax.jit(
                     self._decode_block_paged,
                     in_shardings=(
                         self._param_shardings, s["paged"], s["tokens"],
@@ -310,9 +369,12 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
                     ),
                     out_shardings=(s["paged"], block_tokens, s["tokens"], s["repl"]),
                     donate_argnums=(1,),  # page pool: update in place, no copy
-                )
+                ))
             else:
-                self._decode_fn = jax.jit(self._decode_block_paged, donate_argnums=(1,))
+                self._decode_fn = self._aot_wrap(
+                    "decode",
+                    jax.jit(self._decode_block_paged, donate_argnums=(1,)),
+                )
         else:
             self._alloc_decode_state()
             if mesh is not None:
@@ -320,7 +382,7 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
                 block_tokens = NamedSharding(mesh, P(None, ("dp", "fsdp")))
-                self._decode_fn = jax.jit(
+                self._decode_fn = self._aot_wrap("decode", jax.jit(
                     self._decode_block,
                     in_shardings=(
                         self._param_shardings, s["cache"], s["tokens"],
@@ -331,9 +393,12 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
                         s["cache"], block_tokens, s["tokens"], s["batch"], s["repl"]
                     ),
                     donate_argnums=(1,),  # KV cache: update in place, no copy
-                )
+                ))
             else:
-                self._decode_fn = jax.jit(self._decode_block, donate_argnums=(1,))
+                self._decode_fn = self._aot_wrap(
+                    "decode",
+                    jax.jit(self._decode_block, donate_argnums=(1,)),
+                )
         self.slots: list[_Slot] = [_Slot() for _ in range(max_slots)]
         # per-slot generation counter: an in-flight decode block carries the
         # epoch it was dispatched under, so tokens from a block dispatched
@@ -349,6 +414,18 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
         self._sampling_cache: Optional[tuple] = None
 
         self._prefill_fns: dict[tuple, Any] = {}  # (n_pad, t_pad, guided)
+
+    def _aot_wrap(self, name: str, fn: Any) -> Any:
+        """Route one serving program through the AOT executable cache.
+
+        Identity when the cache is off — every construction site stays a
+        plain ``jax.jit`` callable then, so the wrapping is zero-cost in
+        the default configuration."""
+        if self._aot is None:
+            return fn
+        from .aotcache import CachedProgram
+
+        return CachedProgram(self._aot, name, fn)
 
     def _init_shardings(self, mesh: Any, *, quantized: bool = False) -> None:
         """Validate the mesh against the model and build the sharding table."""
@@ -939,8 +1016,9 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
             if fn_key not in self._chunk_fns:
                 log.info("compiling prefill chunk n=%d t=%d chunk=%d",
                          n_pad, t_pad, step_chunk)
-                self._chunk_fns[fn_key] = self._make_chunk_fn(
-                    n_pad, t_pad, step_chunk
+                self._chunk_fns[fn_key] = self._aot_wrap(
+                    f"chunk_n{n_pad}_t{t_pad}_c{step_chunk}",
+                    self._make_chunk_fn(n_pad, t_pad, step_chunk),
                 )
             ids_chunk = self._jax.lax.dynamic_slice_in_dim(
                 job.ids, job.written, step_chunk, axis=1
@@ -979,7 +1057,10 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
         )
         fn_key2 = (n_pad, t_pad, guided)
         if fn_key2 not in self._finish_fns:
-            self._finish_fns[fn_key2] = self._make_finish_fn(n_pad, t_pad, guided)
+            self._finish_fns[fn_key2] = self._aot_wrap(
+                f"finish_n{n_pad}_t{t_pad}_g{int(guided)}",
+                self._make_finish_fn(n_pad, t_pad, guided),
+            )
         if self.paged:
             staged, row_tables = self._stage_page_tables(
                 len(job.taken), n_pad, job.slot_ids_np, job.page_grants,
@@ -1570,6 +1651,7 @@ class ServingEngine:
         policy = self._supervisor
         assert policy is not None
         loop = asyncio.get_running_loop()
+        restart_t0 = time.monotonic()
         stalled = self._stalled
         reason = "engine-stall" if stalled else "engine-error"
         cause = str(self._error)
@@ -1652,16 +1734,27 @@ class ServingEngine:
                 (-max(request.priority, 1), next(self._seq), request)
             )
         self.generator.metrics.incr("supervisor_restart")
+        # restart-to-ready: device reset through loop restart + requeue.
+        # With the AOT cache the reset's program rebuilds deserialize
+        # instead of recompiling, which is what keeps this in seconds
+        ready_s = time.monotonic() - restart_t0
+        self.generator.metrics.set_gauge(
+            "supervisor_restart_ready_seconds", round(ready_s, 3)
+        )
+        aot = getattr(self.generator, "_aot", None)
         self._dump_blackbox(reason, {
             "cause": cause,
             "requeued": len(retry),
             "gaveup": gaveup,
             "leaks": leaks,
             "resets_in_window": len(self._reset_times),
+            "restart_ready_s": round(ready_s, 3),
+            "aot_cache": aot.stats() if aot is not None else "off",
         })
         log.warning(
-            "supervised engine restart (%s): %d requeued, %d failed, leaks=%s",
-            reason, len(retry), gaveup, leaks or "none",
+            "supervised engine restart (%s) ready in %.2fs: %d requeued, "
+            "%d failed, leaks=%s",
+            reason, ready_s, len(retry), gaveup, leaks or "none",
         )
 
     def _on_partial_from_worker(self, slot_id: int, token_ids: list) -> None:
@@ -1778,10 +1871,14 @@ class ServingEngine:
                     return {"level": level, "programs": 0, "seconds": 0.0}
                 started = time.perf_counter()
                 sched.precompile()
-                return {
+                out = {
                     "level": level, "programs": 1,
                     "seconds": round(time.perf_counter() - started, 2),
                 }
+                aot = getattr(self.generator, "_aot", None)
+                if aot is not None:
+                    out["aot"] = aot.stats()
+                return out
 
             return await loop.run_in_executor(self._executor, _warm)
         return await loop.run_in_executor(

@@ -265,6 +265,9 @@ class ProgramBuilderMixin:
                         ),
                         donate_argnums=(1,),
                     )
+            self._decode_fn_guided = self._aot_wrap(
+                "decode_guided", self._decode_fn_guided
+            )
         return self._decode_fn_guided
 
     def _sample(self, logits, rng, temp, top_p):

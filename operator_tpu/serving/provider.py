@@ -237,7 +237,7 @@ def build_serving_engine(
     import jax.numpy as jnp
 
     from ..models import get_config, init_params
-    from ..models.loader import load_params
+    from ..models.loader import load_params_async
     from ..utils.platform import enable_persistent_compilation_cache
 
     cache_dir = enable_persistent_compilation_cache()
@@ -251,44 +251,19 @@ def build_serving_engine(
 
     checkpoint_dir = config.checkpoint_dir
     tokenizer = load_tokenizer(checkpoint_dir)
-    quantize = config.weight_dtype == "int8"
+    # legacy WEIGHT_DTYPE (when set) wins over the serving_dtype default —
+    # int8 since PR 10, behind the tests/test_quant_parity.py gate
+    serving_dtype = (config.weight_dtype or config.serving_dtype or "bf16").lower()
+    quantize = serving_dtype == "int8"
     if quantize:
         log.info("int8 weight-only serving (per-output-channel)")
-    elif config.weight_dtype not in ("", "bf16", "bfloat16"):
-        raise ValueError(f"unknown weight_dtype {config.weight_dtype!r}")
-    if checkpoint_dir and os.path.isdir(checkpoint_dir):
-        log.info("loading %s weights from %s", model_id, checkpoint_dir)
-        # quantize-at-load: each layer group quantizes as it is placed, so
-        # an 8B int8 load peaks at int8 tree + one bf16 group, never the
-        # full float tree (models/loader.py)
-        params = load_params(
-            checkpoint_dir, model_config, dtype=jnp.bfloat16, quantize=quantize
-        )
-    elif config.allow_random_weights:
-        log.warning(
-            "no checkpoint for %s (checkpoint_dir=%r); using random init — "
-            "explanations will be non-linguistic (allow_random_weights set)",
-            model_id, checkpoint_dir,
-        )
-        if quantize:
-            from ..models.quant import init_params_quantized
+    elif serving_dtype not in ("bf16", "bfloat16"):
+        raise ValueError(f"unknown serving dtype {serving_dtype!r}")
 
-            params = init_params_quantized(
-                model_config, jax.random.PRNGKey(0), dtype=jnp.bfloat16
-            )
-        else:
-            params = init_params(model_config, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
-    else:
-        # refusing keeps random-weight noise out of pod annotations: the
-        # pipeline catches the ProviderError and stores the pattern-only
-        # result + degradation event instead (reference behaviour for a
-        # missing AI backend, PodFailureWatcher.java:385-420)
-        raise MissingCheckpoint(
-            f"providerId tpu-native needs weights for {model_id!r} but "
-            f"checkpoint_dir={checkpoint_dir!r} does not exist; mount a "
-            f"checkpoint or set ALLOW_RANDOM_WEIGHTS=true (testing only)"
-        )
-
+    # AOT executable cache: fingerprint from the SAME knobs the generator
+    # construction below uses, built BEFORE the weight load finishes —
+    # executable deserialization needs disk + host only, so it overlaps
+    # the HBM weight transfer (the whole point of the warm-start path)
     mesh = None
     if config.serving_mesh:
         from ..parallel.mesh import make_mesh, mesh_summary
@@ -299,7 +274,9 @@ def build_serving_engine(
         log.info("sharded serving: %s", mesh_summary(mesh))
 
     # multi-LoRA registry: every `<name>.safetensors` under lora_dir becomes
-    # a selectable adapter; a bad file disables ONLY that adapter
+    # a selectable adapter; a bad file disables ONLY that adapter.  Loaded
+    # before the AOT cache so the adapter names fold into its fingerprint
+    # (the stacked-adapter axis changes every serving program's shape)
     lora_adapters = None
     if config.lora_dir and os.path.isdir(config.lora_dir):
         from ..parallel.lora import load_lora
@@ -351,13 +328,86 @@ def build_serving_engine(
         )
 
     prefill_chunk = config.prefill_chunk or None
+    max_slots = config.max_batch_size
+    max_seq = min(model_config.max_seq_len, 2048)
+    aot = None
+    if config.aot_cache_path:
+        from .aotcache import AotCache, generator_fingerprint
 
+        try:
+            aot = AotCache(config.aot_cache_path, generator_fingerprint(
+                config=model_config,
+                weight_dtype="int8" if quantize else "bfloat16",
+                max_slots=max_slots,
+                max_seq=max_seq,
+                paged=config.kv_cache_mode == "paged",
+                page_size=config.kv_page_size,
+                kv_pages=config.kv_pages or None,
+                mesh=mesh,
+                decode_block=config.decode_block,
+                sample_top_k=config.sample_top_k,
+                pipeline_depth=config.pipeline_depth,
+                prefill_chunk=prefill_chunk,
+                lora_names=sorted(lora_adapters) if lora_adapters else (),
+            ))
+        except Exception:  # noqa: BLE001 - cache is an optimisation only
+            log.warning("AOT executable cache disabled", exc_info=True)
+
+    if checkpoint_dir and os.path.isdir(checkpoint_dir):
+        log.info("loading %s weights from %s", model_id, checkpoint_dir)
+        # quantize-at-load: each layer group quantizes as it is placed, so
+        # an 8B int8 load peaks at int8 tree + one bf16 group, never the
+        # full float tree (models/loader.py).  The load STREAMS on a
+        # background thread while the AOT cache deserializes executables —
+        # compile/restore needs shapes, not values, so the two bring-up
+        # legs run concurrently instead of serially
+        handle = load_params_async(
+            checkpoint_dir, model_config, dtype=jnp.bfloat16, quantize=quantize
+        )
+        if aot is not None:
+            preloaded = aot.preload()
+            if preloaded:
+                log.info(
+                    "AOT cache: %d executables restored while weights "
+                    "streamed", preloaded,
+                )
+        params = handle.result()
+        log.info("weight stream finished in %.1fs", handle.seconds or 0.0)
+    elif config.allow_random_weights:
+        log.warning(
+            "no checkpoint for %s (checkpoint_dir=%r); using random init — "
+            "explanations will be non-linguistic (allow_random_weights set)",
+            model_id, checkpoint_dir,
+        )
+        if quantize:
+            from ..models.quant import init_params_quantized
+
+            params = init_params_quantized(
+                model_config, jax.random.PRNGKey(0), dtype=jnp.bfloat16
+            )
+        else:
+            params = init_params(model_config, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    else:
+        # refusing keeps random-weight noise out of pod annotations: the
+        # pipeline catches the ProviderError and stores the pattern-only
+        # result + degradation event instead (reference behaviour for a
+        # missing AI backend, PodFailureWatcher.java:385-420)
+        raise MissingCheckpoint(
+            f"providerId tpu-native needs weights for {model_id!r} but "
+            f"checkpoint_dir={checkpoint_dir!r} does not exist; mount a "
+            f"checkpoint or set ALLOW_RANDOM_WEIGHTS=true (testing only)"
+        )
+
+    if aot is not None:
+        # idempotent: the checkpoint branch already preloaded during the
+        # weight stream; the random-init branches reach it only here
+        aot.preload()
     generator = BatchedGenerator(
         params,
         model_config,
         tokenizer,
-        max_slots=config.max_batch_size,
-        max_seq=min(model_config.max_seq_len, 2048),
+        max_slots=max_slots,
+        max_seq=max_seq,
         paged=config.kv_cache_mode == "paged",
         page_size=config.kv_page_size,
         kv_pages=config.kv_pages or None,
@@ -368,6 +418,7 @@ def build_serving_engine(
         lora_adapters=lora_adapters,
         lora_alpha=config.lora_alpha,
         prefill_chunk=prefill_chunk,
+        aot_cache=aot,
     )
     # continuous-batching scheduler (serving/sched/, docs/SERVING.md):
     # opt-in via SCHED_MODE=continuous; falls back to the wave engine

@@ -387,7 +387,10 @@ class Scheduler:
                 "compiling mixed-step program t_budget=%d chunk=%d slots=%d",
                 self.t_budget, self.chunk, self.generator.max_slots,
             )
-            self._fn = make_mixed_fn(self.generator, self.t_budget, self.chunk)
+            self._fn = self.generator._aot_wrap(
+                f"mixed_t{self.t_budget}_c{self.chunk}",
+                make_mixed_fn(self.generator, self.t_budget, self.chunk),
+            )
         return self._fn
 
     def _dispatch(self, plan: StepPlan) -> np.ndarray:

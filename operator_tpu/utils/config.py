@@ -235,10 +235,20 @@ class OperatorConfig:
     # nucleus-sampling candidate set (engine SAMPLE_TOP_K): top-p filtering
     # runs inside the top-k — raise for high-temperature diversity
     sample_top_k: int = 64
-    # "bf16" or "int8" (weight-only per-channel quant, models/quant.py):
-    # int8 halves HBM weight traffic — decode at serving batch sizes is
-    # bandwidth-bound, and it fits Mistral-7B per chip on v5e (config 5)
-    weight_dtype: str = "bf16"
+    # serving dtype: "int8" (weight-only per-channel quant, models/quant.py)
+    # or "bf16".  int8 is the DEFAULT behind the parity gate (token-identical
+    # greedy on the tiny models, tests/test_quant_parity.py): it halves HBM
+    # weight traffic — decode at serving batch sizes is bandwidth-bound —
+    # and fits Mistral-7B per chip on v5e (config 5)
+    serving_dtype: str = "int8"
+    # legacy override (pre-PR-10 name): when non-empty it wins over
+    # serving_dtype, so existing WEIGHT_DTYPE deployments keep their pin
+    weight_dtype: str = ""
+    # persisted AOT executable cache (serving/aotcache.py): a directory
+    # (PVC-backed in deploy/) where compiled serving programs are stored
+    # and restored on boot — warm bring-up skips the warmup compile
+    # entirely.  None/"" = off
+    aot_cache_path: Optional[str] = None
     # multi-chip serving (BASELINE configs 3/5): "" = single device,
     # "auto" = plan_for(all local devices), or explicit "dp=2,tp=4[,fsdp=1]"
     serving_mesh: str = ""

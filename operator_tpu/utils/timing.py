@@ -62,6 +62,9 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._stages: dict[str, StageStats] = {}
         self._counters: dict[str, int] = {}
+        # last-value gauges (e.g. supervisor_restart_ready_seconds):
+        # point-in-time observations where only the latest value matters
+        self._gauges: dict[str, float] = {}
         # most recent exemplar per counter (a trace id, obs/record.py):
         # rendered OpenMetrics-style so an alert on a counter links
         # straight to the trace that last bumped it
@@ -101,6 +104,14 @@ class MetricsRegistry:
         with self._lock:
             return self._counters.get(name, 0)
 
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def gauge(self, name: str) -> Optional[float]:
+        with self._lock:
+            return self._gauges.get(name)
+
     def snapshot(self) -> dict:
         with self._lock:
             out = {
@@ -116,6 +127,8 @@ class MetricsRegistry:
                 },
                 "counters": dict(self._counters),
             }
+            if self._gauges:
+                out["gauges"] = {k: round(v, 6) for k, v in self._gauges.items()}
             if self._exemplars:
                 # trace-id exemplars ride the JSON surface unconditionally
                 # (no format constraints there, unlike the text exposition)
@@ -168,6 +181,10 @@ class MetricsRegistry:
                     )
                 else:
                     lines.append(f"{metric} {value}")
+            for name, value in sorted(self._gauges.items()):
+                metric = f"podmortem_{sane(name)}"
+                lines.append(f"# TYPE {metric} gauge")
+                lines.append(f"{metric} {value:.6g}")
             if openmetrics:
                 lines.append("# EOF")
         return "\n".join(lines) + "\n"

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Cold-vs-warm AOT-cache smoke (CI chaos job; CPU, tiny-test model).
+
+Boots a generator with an AOT cache directory, drives the warmup grid
+(cold boot: compiles + persists), tears the generator down, boots a fresh
+one against the same directory, and asserts the warm boot
+
+- performed ZERO serving-program compiles (CompileWatcher events filtered
+  through serving/aotcache.py SERVING_PROGRAM_MARKERS — the strict
+  in-process assertion is empty-event-list, since fresh jit closures would
+  otherwise recompile every serving program),
+- restored executables from the cache (hits > 0, live_compiles == 0), and
+- was strictly faster than the cold boot.
+
+Exit code 0 on success; prints a one-line JSON verdict either way.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from operator_tpu.models import TINY_TEST, init_params  # noqa: E402
+from operator_tpu.models.tokenizer import ByteTokenizer  # noqa: E402
+from operator_tpu.serving.aotcache import serving_compile_events  # noqa: E402
+from operator_tpu.serving.engine import BatchedGenerator  # noqa: E402
+from operator_tpu.utils.compilewatch import CompileWatcher  # noqa: E402
+
+
+def boot(params, cache_dir: str) -> tuple:
+    """One bring-up: generator + warmup grid; returns (seconds, aot stats)."""
+    started = time.perf_counter()
+    generator = BatchedGenerator(
+        params, TINY_TEST, ByteTokenizer(), max_slots=2, max_seq=128,
+        cache_dtype=jnp.float32, paged=True, page_size=16, decode_block=2,
+        aot_cache=cache_dir,
+    )
+    generator.precompile_grid("serving")
+    seconds = time.perf_counter() - started
+    stats = generator._aot.stats()
+    return seconds, stats
+
+
+def main() -> int:
+    params = init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+    watcher = CompileWatcher()
+    with tempfile.TemporaryDirectory(prefix="aot-smoke-") as cache_dir:
+        cold_s, cold = boot(params, cache_dir)
+        assert cold["stored"] > 0, f"cold boot persisted nothing: {cold}"
+
+        watcher.mark()
+        warm_s, warm = boot(params, cache_dir)
+        serving_events = serving_compile_events(watcher.events_since_mark())
+
+        verdict = {
+            "cold_s": round(cold_s, 2),
+            "warm_s": round(warm_s, 2),
+            "cold": cold,
+            "warm": warm,
+            "warm_serving_compiles": [e[1] for e in serving_events],
+        }
+        failures = []
+        if serving_events:
+            failures.append(
+                f"warm boot compiled serving programs: {[e[1] for e in serving_events]}"
+            )
+        if warm["live_compiles"] != 0:
+            failures.append(f"warm live_compiles={warm['live_compiles']} != 0")
+        if warm["hits"] == 0:
+            failures.append("warm boot restored nothing from the cache")
+        if warm_s >= cold_s:
+            failures.append(f"warm boot {warm_s:.2f}s not faster than cold {cold_s:.2f}s")
+        verdict["ok"] = not failures
+        verdict["failures"] = failures
+        print(json.dumps(verdict))
+        return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,296 @@
+"""Persisted AOT executable cache (serving/aotcache.py): fingerprint key
+discipline, serialize/deserialize round-trip, loud corrupt-entry fallback,
+the warm-boot zero-compile contract (compilewatch-asserted), and cache
+reuse across a supervised engine restart under an injected stall.
+
+All on the TINY_TEST model over the CPU backend — the cache is
+backend-agnostic (the fingerprint carries the platform), and the contract
+under test is "a warm boot never compiles a serving program", which the
+CompileWatcher makes observable on any backend.
+"""
+
+import asyncio
+import logging
+import os
+
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from operator_tpu.models import TINY_TEST, init_params  # noqa: E402
+from operator_tpu.models.tokenizer import ByteTokenizer  # noqa: E402
+from operator_tpu.serving.aotcache import (  # noqa: E402
+    AotCache,
+    CACHE_FORMAT,
+    fingerprint_digest,
+    generator_fingerprint,
+    serving_compile_events,
+)
+from operator_tpu.serving.engine import (  # noqa: E402
+    BatchedGenerator,
+    SamplingParams,
+    ServingEngine,
+    SupervisorPolicy,
+)
+from operator_tpu.utils.compilewatch import CompileWatcher  # noqa: E402
+from operator_tpu.utils.faultinject import FaultPlan, OK, sleep_  # noqa: E402
+from operator_tpu.utils.timing import MetricsRegistry  # noqa: E402
+
+GREEDY = SamplingParams(max_tokens=6, temperature=0.0, stop_on_eos=False)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def _generator(params, cache_dir, **kw):
+    defaults = dict(
+        max_slots=2, max_seq=128, cache_dtype=jnp.float32, paged=True,
+        page_size=16, decode_block=2,
+    )
+    defaults.update(kw)
+    return BatchedGenerator(
+        params, TINY_TEST, ByteTokenizer(), aot_cache=str(cache_dir),
+        **defaults,
+    )
+
+
+# ---------------------------------------------------------------- fingerprint
+class TestFingerprint:
+    BASE = dict(
+        config=TINY_TEST, weight_dtype="bfloat16", max_slots=2, max_seq=128,
+        cache_dtype=jnp.float32, paged=True, page_size=16, decode_block=2,
+    )
+
+    def test_digest_is_stable(self):
+        a = fingerprint_digest(generator_fingerprint(**self.BASE))
+        b = fingerprint_digest(generator_fingerprint(**self.BASE))
+        assert a == b
+
+    @pytest.mark.parametrize(
+        "change",
+        [
+            {"max_slots": 4},                 # shape grid
+            {"max_seq": 64},                  # shape grid
+            {"page_size": 32},                # paging geometry
+            {"paged": False},                 # cache layout
+            {"decode_block": 4},              # decode program shape
+            {"weight_dtype": "int8"},         # dtype
+            {"cache_dtype": jnp.bfloat16},    # dtype
+            {"lora_names": ("sre-triage",)},  # stacked-adapter axis
+        ],
+    )
+    def test_every_program_shaping_input_changes_the_key(self, change):
+        base = fingerprint_digest(generator_fingerprint(**self.BASE))
+        varied = fingerprint_digest(
+            generator_fingerprint(**{**self.BASE, **change})
+        )
+        assert varied != base, f"fingerprint ignored {change}"
+
+    def test_salt_forces_a_fresh_key(self, monkeypatch):
+        """AOT_CACHE_SALT is the operator's no-delete invalidation lever —
+        and the tests' stand-in for a jax/libtpu version bump."""
+        base = fingerprint_digest(generator_fingerprint(**self.BASE))
+        monkeypatch.setenv("AOT_CACHE_SALT", "fake-libtpu-2.0")
+        salted = fingerprint_digest(generator_fingerprint(**self.BASE))
+        assert salted != base
+
+
+# ---------------------------------------------------------------- round-trip
+class TestRoundTrip:
+    def _cache(self, tmp_path):
+        payload = generator_fingerprint(
+            config=TINY_TEST, weight_dtype="bfloat16", max_slots=2,
+        )
+        return AotCache(str(tmp_path), payload, metrics=MetricsRegistry())
+
+    def test_put_get_round_trip(self, tmp_path):
+        cache = self._cache(tmp_path)
+        fn = jax.jit(lambda x: x * 2 + 1)
+        x = jnp.arange(8, dtype=jnp.float32)
+        compiled = fn.lower(x).compile()
+        assert cache.put("double", compiled)
+        assert os.path.exists(os.path.join(cache.dir, "fingerprint.json"))
+
+        fresh = self._cache(tmp_path)
+        assert fresh.dir == cache.dir  # same payload -> same directory
+        loaded = fresh.get("double")
+        assert loaded is not None and fresh.hits == 1
+        assert jnp.array_equal(loaded(x), compiled(x))
+        snap = fresh.metrics.snapshot()["counters"]
+        assert snap.get("aot_cache_hit") == 1
+
+    def test_miss_is_counted_not_raised(self, tmp_path):
+        cache = self._cache(tmp_path)
+        assert cache.get("never-stored") is None
+        assert cache.misses == 1 and cache.errors == 0
+
+    def test_corrupt_entry_falls_back_loudly(self, tmp_path, caplog):
+        cache = self._cache(tmp_path)
+        fn = jax.jit(lambda x: x + 1)
+        x = jnp.zeros((4,), jnp.float32)
+        cache.put("prog", fn.lower(x).compile())
+        path = os.path.join(cache.dir, "prog.aotx")
+        with open(path, "wb") as f:
+            f.write(b"\x80garbage not a cache record")
+        fresh = self._cache(tmp_path)
+        with caplog.at_level(logging.WARNING, "operator_tpu.serving.aotcache"):
+            assert fresh.get("prog") is None
+        assert fresh.errors == 1
+        assert any("failed to deserialize" in r.message for r in caplog.records)
+        assert not os.path.exists(path), "corrupt entry must be discarded"
+
+    def test_format_bump_reads_as_corrupt(self, tmp_path):
+        import pickle
+
+        cache = self._cache(tmp_path)
+        os.makedirs(cache.dir, exist_ok=True)
+        with open(os.path.join(cache.dir, "old.aotx"), "wb") as f:
+            pickle.dump({"format": CACHE_FORMAT + 1, "payload": b""}, f)
+        assert cache.get("old") is None and cache.errors == 1
+
+
+# ---------------------------------------------------------------- warm boot
+class TestWarmBoot:
+    def test_warm_precompile_performs_zero_compiles(self, params, tmp_path):
+        """The acceptance gate: boot #2 against the same cache dir restores
+        every serving program (hits > 0, live_compiles == 0) and the
+        compile watcher sees NO serving-program compile events — fresh jit
+        closures would otherwise recompile the whole grid."""
+        cold = _generator(params, tmp_path)
+        cold.precompile_grid("serving")
+        cold_stats = cold._aot.stats()
+        assert cold_stats["stored"] > 0 and cold_stats["live_compiles"] > 0
+        cold_tokens = cold.generate("pod crashed exit 137", GREEDY).token_ids
+
+        watcher = CompileWatcher()
+        try:
+            watcher.mark()
+            warm = _generator(params, tmp_path)
+            warm.precompile_grid("serving")
+            events = serving_compile_events(watcher.events_since_mark())
+        finally:
+            watcher.close()
+        stats = warm._aot.stats()
+        assert events == [], f"warm boot compiled: {[e[1] for e in events]}"
+        assert stats["live_compiles"] == 0 and stats["hits"] > 0
+        assert stats["fingerprint"] == cold_stats["fingerprint"]
+        # restored executables serve the same greedy tokens
+        assert warm.generate("pod crashed exit 137", GREEDY).token_ids == cold_tokens
+
+    def test_changed_shape_grid_forces_recompile(self, params, tmp_path):
+        """A different decode_block is a different program: the warm dir
+        must read as a MISS (separate fingerprint directory), never a
+        wrong load."""
+        first = _generator(params, tmp_path)
+        first.generate("warm the cache", GREEDY)
+        assert first._aot.stats()["stored"] > 0
+
+        other = _generator(params, tmp_path, decode_block=4)
+        other.generate("warm the cache", GREEDY)
+        stats = other._aot.stats()
+        assert other._aot.dir != first._aot.dir
+        assert stats["hits"] == 0 and stats["live_compiles"] > 0
+
+    def test_salt_env_forces_recompile(self, params, tmp_path, monkeypatch):
+        """The fake-version lever: same shapes, different AOT_CACHE_SALT
+        (standing in for a jax/libtpu upgrade) must cold-boot."""
+        first = _generator(params, tmp_path)
+        first.generate("salted", GREEDY)
+        monkeypatch.setenv("AOT_CACHE_SALT", "simulated-upgrade")
+        upgraded = _generator(params, tmp_path)
+        upgraded.generate("salted", GREEDY)
+        stats = upgraded._aot.stats()
+        assert upgraded._aot.dir != first._aot.dir
+        assert stats["hits"] == 0 and stats["live_compiles"] > 0
+
+    def test_corrupt_generator_entry_recovers_and_restores(
+        self, params, tmp_path, caplog
+    ):
+        """One truncated .aotx (node crash mid-write survives only as a
+        temp file, but disks lie): the warm boot logs a warning, recompiles
+        THAT program live, re-persists it, and still serves correctly."""
+        cold = _generator(params, tmp_path)
+        want = cold.generate("probe timeout on node", GREEDY).token_ids
+        aot = cold._aot
+        stored = [f for f in os.listdir(aot.dir) if f.endswith(".aotx")]
+        assert stored
+        with open(os.path.join(aot.dir, stored[0]), "r+b") as f:
+            f.truncate(16)
+
+        with caplog.at_level(logging.WARNING, "operator_tpu.serving.aotcache"):
+            warm = _generator(params, tmp_path)
+            got = warm.generate("probe timeout on node", GREEDY).token_ids
+        stats = warm._aot.stats()
+        assert got == want
+        assert stats["errors"] >= 1
+        assert any("falling back" in r.message for r in caplog.records)
+        # the discarded entry was re-stored for the NEXT boot
+        assert os.path.exists(os.path.join(aot.dir, stored[0]))
+
+
+# ---------------------------------------------------------------- chaos
+def test_supervised_restart_reuses_aot_cache(params, tmp_path):
+    """The supervisor's restart path rides the cache: an injected decode
+    stall forces a supervised restart, the engine returns to service
+    WITHOUT a single additional live compile (the black-box dump records
+    the cache stats it restarted with), and a subsequent fresh boot — the
+    pod-restart case the cache exists for — restores everything."""
+    from operator_tpu.obs import FlightRecorder
+
+    metrics = MetricsRegistry()
+    generator = _generator(params, tmp_path, metrics=metrics)
+    policy = SupervisorPolicy(stall_timeout_s=60.0, join_grace_s=5.0)
+    engine = ServingEngine(generator, admission_wait_s=0.002, supervisor=policy)
+    engine.recorder = FlightRecorder(capacity=16, metrics=metrics)
+
+    async def scenario():
+        await engine.start()
+        await engine.generate(
+            "warm", SamplingParams(max_tokens=2, temperature=0.0, stop_on_eos=False)
+        )
+        compiles_before = generator._aot.stats()["live_compiles"]
+        policy.stall_timeout_s = 0.4
+        plan = FaultPlan(seed=5)
+        plan.rule("engine.step", [OK, sleep_(1.5)])  # 2nd step wedges >> 0.4s
+        generator.fault_plan = plan
+        result = await asyncio.wait_for(
+            engine.generate(
+                "stalled mid-decode then requeued",
+                SamplingParams(max_tokens=12, temperature=0.0, stop_on_eos=False),
+            ),
+            30,
+        )
+        generator.fault_plan = None
+        assert result.completion_tokens == 12
+        await engine.close()
+        return compiles_before
+
+    compiles_before = asyncio.run(scenario())
+    counters = metrics.snapshot()["counters"]
+    assert counters.get("supervisor_restart") == 1
+    # the engine came back WITHOUT recompiling: in-process programs persist
+    # across reset(), so the restart cost is requeue + cache, never XLA
+    stats = generator._aot.stats()
+    assert stats["live_compiles"] == compiles_before
+    assert stats["stored"] > 0
+
+    # the restart stamped its bring-up gauge and black-boxed the cache state
+    gauges = metrics.snapshot().get("gauges", {})
+    assert gauges.get("supervisor_restart_ready_seconds", -1.0) >= 0.0
+    dumps = [r for r in engine.recorder.traces() if r.blackbox]
+    assert len(dumps) == 1
+    extra = dumps[0].extra
+    aot_dump = extra.get("aot_cache")
+    assert isinstance(aot_dump, dict) and aot_dump["stored"] > 0
+    assert "restart_ready_s" in extra
+
+    # the pod-restart case: a FRESH boot on the same dir restores the
+    # programs the supervised engine persisted — zero compiles
+    fresh = _generator(params, tmp_path, metrics=MetricsRegistry())
+    fresh.generate("warm", SamplingParams(max_tokens=2, temperature=0.0,
+                                          stop_on_eos=False))
+    fresh_stats = fresh._aot.stats()
+    assert fresh_stats["hits"] > 0 and fresh_stats["live_compiles"] == 0

@@ -1,0 +1,86 @@
+"""The int8-by-default parity gate (models/quant.py ``parity_report``,
+docs/SERVING.md "Bring-up"): serving may default to int8 ONLY while greedy
+decode is token-identical to the float path on tiny models and the logit
+error stays bounded by the quantization step.
+
+Lives alongside test_quant.py: that file proves the quantized MATH is
+close; this one proves the serving-facing contract — same tokens out.
+Prompts are the stable subset probed on TINY_TEST's deterministic CPU
+greedy path (an argmax near-tie can legitimately flip a token on random
+weights; the gate report separates that from real numeric drift via the
+teacher-forced max_logit_diff).
+"""
+
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from operator_tpu.models import TINY_TEST, init_params  # noqa: E402
+from operator_tpu.models.quant import parity_report, quantize_params  # noqa: E402
+from operator_tpu.models.tokenizer import ByteTokenizer  # noqa: E402
+
+#: prompts with a comfortable argmax margin on TINY_TEST PRNGKey(0) weights
+#: (deterministic on CPU); max_logit_diff stays ~0.12 — an order of
+#: magnitude under the gate threshold below.  Equal byte length on purpose:
+#: the gate's cache-free forward compiles per sequence length, so equal
+#: lengths share every compiled shape between the two prompts
+PARITY_PROMPTS = (
+    "pod crashed exit 137",
+    "oom killed container",
+)
+
+#: absolute logit-error ceiling — the 1B-class gate (where a token flip on
+#: a long greedy run is expected while the error stays quantization-bounded)
+MAX_LOGIT_DIFF = 0.5
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def qparams(params):
+    return quantize_params(params, TINY_TEST)
+
+
+@pytest.fixture(scope="module")
+def report(params, qparams):
+    tok = ByteTokenizer()
+    return parity_report(
+        params, qparams, TINY_TEST,
+        [tok.encode(p) for p in PARITY_PROMPTS], max_new_tokens=10,
+    )
+
+
+def test_int8_greedy_is_token_identical(report):
+    """The tiny-model gate: int8 serving must produce the exact greedy
+    token stream of the float path — this is what licenses int8 as the
+    serving DEFAULT (utils/config.py ``serving_dtype``)."""
+    assert report["greedy_match"], report
+    assert report["mismatched_prompts"] == 0
+    assert report["prompts"] == len(PARITY_PROMPTS)
+
+
+def test_int8_logit_error_is_quantization_bounded(report):
+    """The 1B-class gate shape: teacher-forced max abs logit difference
+    under the threshold — meaningful even when an argmax near-tie flips a
+    token, because the comparison is step-aligned along the float
+    trajectory."""
+    assert 0.0 < report["max_logit_diff"] < MAX_LOGIT_DIFF, report
+
+
+def test_serving_dtype_defaults_to_int8():
+    """Config contract: ``serving_dtype`` defaults to int8; the legacy
+    ``weight_dtype`` env knob still wins when explicitly set."""
+    from operator_tpu.utils.config import OperatorConfig
+
+    assert OperatorConfig().serving_dtype == "int8"
+    assert OperatorConfig().weight_dtype == ""  # legacy knob unset
+
+    resolved = (
+        OperatorConfig(weight_dtype="bf16").weight_dtype
+        or OperatorConfig().serving_dtype
+    )
+    assert resolved == "bf16"  # explicit legacy override beats the default
