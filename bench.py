@@ -639,6 +639,13 @@ def main() -> None:
             stall_stage.mean_ms * stall_stage.count, 1
         ),
         "mixed": mixed,
+        # step-clock attribution (serving/perf.py): the MEASURED decode
+        # MFU decomposed per step — host-gap / device / sample-xfer
+        # fractions sum to 1.0 by construction; decode_mfu here counts
+        # only decode-bearing steps' attributed wall, so it upper-bounds
+        # the end-to-end number above and the GAP between them is the
+        # pipeline overhead the fractions attribute
+        "step_attribution": generator.step_clock.summary(),
         "params_b": round(n_params / 1e9, 3),
         "peak_tflops_assumed": peak_tflops,
         "model": model_name,

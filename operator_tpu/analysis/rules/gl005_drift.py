@@ -27,6 +27,12 @@ from ..core import AnalysisContext, Finding, Rule
 #: every string literal inside an .incr(...) argument list (conditional
 #: expressions like incr("a" if x else "b") emit BOTH names)
 INCR_CALL = re.compile(r"\.incr\(([^)]*)\)", re.DOTALL)
+#: histogram observations: an .observe(<name>, value) call whose name
+#: literal carries a unit suffix renders as the podmortem_<name> family —
+#: only unit-suffixed strings count, so the step clock's kind= literals
+#: ("decode", "mixed") never read as metrics
+OBSERVE_CALL = re.compile(r"\.observe\(([^)]*)\)", re.DOTALL)
+UNIT_SUFFIXES = ("_milliseconds", "_seconds", "_bytes")
 STRING = re.compile(r"[\"']([a-z0-9_]+)[\"']")
 #: fully-formed metric names in code (the stage-summary constant); a bare
 #: "podmortem_..." dict key without a metric suffix is not a metric
@@ -46,6 +52,10 @@ def emitted_metrics(root: pathlib.Path) -> set[str]:
         for args in INCR_CALL.findall(text):
             for name in STRING.findall(args):
                 metrics.add(f"podmortem_{name}_total")
+        for args in OBSERVE_CALL.findall(text):
+            for name in STRING.findall(args):
+                if name.endswith(UNIT_SUFFIXES):
+                    metrics.add(f"podmortem_{name}")
         for name in LITERAL.findall(text):
             metrics.add(name)
     return metrics

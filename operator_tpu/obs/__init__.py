@@ -18,6 +18,7 @@ import os
 from typing import Optional
 
 from .record import FlightRecorder, TraceRecord, render_tree
+from .steptrace import StepRecord, StepRing, attribution, render_steps
 from .span import (
     Span,
     Trace,
@@ -36,13 +37,17 @@ __all__ = [
     "FlightRecorder",
     "RECORDER",
     "Span",
+    "StepRecord",
+    "StepRing",
     "Trace",
     "TraceRecord",
     "Tracer",
     "TRACER",
     "annotate",
     "annotate_root",
+    "attribution",
     "build_tracer",
+    "render_steps",
     "current_span",
     "current_trace_id",
     "current_traceparent",

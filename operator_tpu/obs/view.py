@@ -4,10 +4,17 @@
     python -m operator_tpu.obs.view dump.jsonl <trace-id> # one full tree
     python -m operator_tpu.obs.view dump.jsonl --all      # every tree
     python -m operator_tpu.obs.view dump.jsonl --blackbox # black-box only
+    python -m operator_tpu.obs.view --steps dump.jsonl    # step timeline
 
 Reads the journal written by :class:`..record.FlightRecorder` (or a
 black-box dump) and renders each trace's span tree with offsets/widths
 scaled to the root span — the laptop-side twin of ``GET /traces/{id}``.
+
+``--steps`` instead renders the step-clock timeline (docs/OBSERVABILITY.md
+"Step clock") as a fixed-width table: the input is either a JSONL of raw
+step-record dicts, or a black-box dump whose records carry a last-N
+``steps`` tail in their ``extra`` context (the engine attaches one
+automatically) — both are recognised line by line.
 """
 
 from __future__ import annotations
@@ -18,6 +25,58 @@ import sys
 from typing import Optional
 
 from .record import FlightRecorder, TraceRecord, render_tree
+from .steptrace import StepRecord, attribution, render_steps
+
+
+def load_steps(path: str) -> list[StepRecord]:
+    """Step records from a JSONL file: raw step-record dicts (one per
+    line, as ``StepRecord.to_dict`` writes them) and/or black-box trace
+    records whose ``extra.steps`` carries the engine's last-N tail.
+    Unparseable lines are skipped — a step view over a crashed run's
+    half-written journal should show what IS there."""
+    steps: list[StepRecord] = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                data = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(data, dict):
+                continue
+            if "kind" in data and "device_ms" in data:
+                steps.append(StepRecord.from_dict(data))
+                continue
+            extra = data.get("extra")
+            if isinstance(extra, dict):
+                for item in extra.get("steps") or []:
+                    if isinstance(item, dict):
+                        steps.append(StepRecord.from_dict(item))
+    return steps
+
+
+def _print_steps(path: str) -> int:
+    try:
+        steps = load_steps(path)
+    except FileNotFoundError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    if not steps:
+        print(f"no step records in {path}")
+        return 0
+    print(render_steps(steps))
+    summary = attribution(steps)
+    fractions = summary["fractions"]
+    if fractions["host_gap"] is not None:
+        print(
+            f"\n{summary['steps']} steps  tokens={summary['tokens']}  "
+            f"host_gap={fractions['host_gap']:.1%}  "
+            f"device={fractions['device']:.1%}  "
+            f"sample_xfer={fractions['sample_xfer']:.1%}"
+        )
+    return 0
 
 
 def _print_record(record: TraceRecord, *, full: bool) -> None:
@@ -49,7 +108,13 @@ def main(argv: Optional[list[str]] = None) -> int:
                         help="render every trace as a full tree")
     parser.add_argument("--blackbox", action="store_true",
                         help="only black-box records")
+    parser.add_argument("--steps", action="store_true",
+                        help="render the step-clock timeline instead of "
+                             "span trees (raw step JSONL or black-box "
+                             "dumps with a steps tail)")
     args = parser.parse_args(argv)
+    if args.steps:
+        return _print_steps(args.path)
     try:
         records = FlightRecorder.load(args.path)
     except FileNotFoundError as exc:

@@ -127,6 +127,13 @@ class Operator:
                 recorder=self.recorder,
                 tracer=self.tracer,
                 incidents_token=self.config.incidents_api_token or None,
+                # late-bound: the backend's router set grows as replica
+                # sets are first routed, and the poll loop keeps feeding
+                # their health boards while the server runs
+                fleet=(
+                    (lambda: self._http_backend.fleet_view())
+                    if self._http_backend is not None else None
+                ),
                 host=self.config.health_host,
                 port=self.config.health_port,
             )
@@ -283,6 +290,9 @@ class Operator:
                     or self.config.pod_name
                     or None
                 ),
+                # POST /profile?seconds=N on-demand jax.profiler capture
+                profile_enabled=self.config.profile_enabled,
+                profile_dir=self.config.profile_dir,
             )
             await server.start()
             # warmup: one throwaway generation compiles the prefill + decode

@@ -20,6 +20,11 @@ asyncio HTTP server exposes:
   (``?limit=N&blackbox=1``; docs/OBSERVABILITY.md)
 - ``GET /traces/{id}``   — one trace: full span JSON plus the rendered
   flame-style text tree (the ``obs.view`` CLI's online twin)
+- ``GET /fleet``         — fleet-wide perf roll-up: every routed serving
+  replica's step-clock summary (decode MFU, host-gap fraction, slot
+  occupancy, queue depth) plus step-weighted fleet aggregates, as fed by
+  the background ``/healthz`` poll (docs/OBSERVABILITY.md "Step clock");
+  token-gated like /incidents and /traces
 
 Inbound W3C ``traceparent`` headers are honoured: the request handler
 runs under a trace joining the caller's trace id, recorded into the same
@@ -35,7 +40,7 @@ import asyncio
 import json
 import logging
 import urllib.parse
-from typing import TYPE_CHECKING, Optional
+from typing import TYPE_CHECKING, Callable, Optional
 
 from ..obs import FlightRecorder, Tracer, parse_traceparent, render_tree
 from ..utils.timing import METRICS, MetricsRegistry
@@ -66,6 +71,7 @@ class HealthServer:
         recorder: Optional[FlightRecorder] = None,
         tracer: Optional[Tracer] = None,
         incidents_token: Optional[str] = None,
+        fleet: Optional[Callable[[], dict]] = None,
         host: str = "0.0.0.0",
         port: int = 8080,
     ) -> None:
@@ -83,6 +89,10 @@ class HealthServer:
         #: trace attributes quote pod identities and evidence, which is
         #: more sensitive than latency numbers
         self.incidents_token = incidents_token or None
+        #: zero-arg callable returning the fleet perf roll-up
+        #: (OpenAICompatProvider.fleet_view) behind GET /fleet (None =
+        #: 404: no routed replica sets on this operator)
+        self.fleet = fleet
         self.host = host
         self.port = port
         self._server: Optional[asyncio.AbstractServer] = None
@@ -223,7 +233,9 @@ class HealthServer:
         if method not in ("GET", "HEAD"):
             return 405, {"error": "method not allowed"}
         if (
-            path.startswith("/incidents") or path.startswith("/traces")
+            path.startswith("/incidents")
+            or path.startswith("/traces")
+            or path.startswith("/fleet")
         ) and not self._authorized(authorization):
             return 401, {"error": "missing or invalid bearer token"}
         if path in ("/healthz/live", "/livez"):
@@ -246,6 +258,12 @@ class HealthServer:
             return 200, self.metrics.prometheus(openmetrics=openmetrics).encode()
         if path == "/metrics.json":
             return 200, self.metrics.snapshot()
+        if path == "/fleet":
+            if self.fleet is None:
+                return 404, {"error": "no routed replica sets"}
+            # the roll-up walks every router's health board; small, but
+            # keep it off the probe loop like the other forensic reads
+            return 200, await asyncio.to_thread(self.fleet)
         if path == "/incidents":
             if self.memory is None:
                 return 404, {"error": "incident memory disabled"}

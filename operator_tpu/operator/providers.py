@@ -345,6 +345,19 @@ class OpenAICompatProvider:
         router.fault_plan = self.fault_plan
         return router
 
+    def fleet_view(self) -> dict:
+        """Fleet perf roll-up across EVERY routed replica set — the body
+        the operator's token-gated ``GET /fleet`` serves.  Rows come from
+        each router's HealthBoard (fed by the health-poll sweep below);
+        a replica appearing in several sets keeps one row (same id, same
+        /healthz body — last board wins)."""
+        from ..router.health import fleet_rollup
+
+        replicas: dict = {}
+        for router in list(self._routers.values()):
+            replicas.update(router.health.fleet_view()["replicas"])
+        return {"replicas": replicas, "fleet": fleet_rollup(replicas)}
+
     async def poll_replica_health(self, *, timeout_s: float = 5.0) -> int:
         """Active ``GET /healthz`` sweep over every routed replica set,
         feeding each router's HealthBoard (probe verdict + load report).

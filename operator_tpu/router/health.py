@@ -34,7 +34,42 @@ __all__ = [
     "ReplicaHealth",
     "ReplicaLoad",
     "HealthBoard",
+    "fleet_rollup",
 ]
+
+
+def fleet_rollup(replicas: dict) -> dict:
+    """Aggregate per-replica fleet rows (``HealthBoard.fleet_view``
+    shape) into one fleet summary.  MFU / occupancy / host-gap means are
+    STEP-WEIGHTED over the replicas that reported them — a replica with
+    an empty step ring contributes nothing, not a zero; queue depth and
+    inflight are plain sums.  Module-level so the operator can merge
+    rows across several routed replica sets before rolling up."""
+    mfu_w = gap_w = occ_w = 0.0
+    mfu_steps = gap_steps = occ_steps = 0
+    queue_depth = inflight = 0
+    for row in replicas.values():
+        queue_depth += int(row.get("queueDepth") or 0)
+        inflight += int(row.get("inflight") or 0)
+        weight = max(1, int(row.get("steps") or 0))
+        if row.get("decodeMfu") is not None:
+            mfu_w += float(row["decodeMfu"]) * weight
+            mfu_steps += weight
+        if row.get("hostGapFrac") is not None:
+            gap_w += float(row["hostGapFrac"]) * weight
+            gap_steps += weight
+        if row.get("occupancy") is not None:
+            occ_w += float(row["occupancy"]) * weight
+            occ_steps += weight
+    return {
+        "replicaCount": len(replicas),
+        "readyCount": sum(1 for r in replicas.values() if r.get("ready")),
+        "queueDepth": queue_depth,
+        "inflight": inflight,
+        "decodeMfu": round(mfu_w / mfu_steps, 6) if mfu_steps else None,
+        "hostGapFrac": round(gap_w / gap_steps, 6) if gap_steps else None,
+        "occupancy": round(occ_w / occ_steps, 6) if occ_steps else None,
+    }
 
 
 class CircuitBreaker:
@@ -179,6 +214,15 @@ class ReplicaLoad:
     #: the engine's supervisor exhausted its reset budget (serving cold
     #: until the window drains) — treated as not-ready
     gave_up: bool = False
+    #: step-clock perf summary (serving/perf.py): measured attributed
+    #: decode MFU over the replica's step ring, the host-gap stall
+    #: fraction, mean slot occupancy, and how many step records back
+    #: them.  None/0 = replica predates the step clock or has not
+    #: decoded yet — the fleet view skips it, routing is unaffected.
+    decode_mfu: Optional[float] = None
+    host_gap_frac: Optional[float] = None
+    occupancy: Optional[float] = None
+    steps: int = 0
 
     def pressure(self) -> int:
         """Scalar queue pressure used for least-loaded comparison."""
@@ -199,15 +243,41 @@ class ReplicaLoad:
             "inflight": self.inflight,
             "decodeTokenS": round(self.decode_token_s, 6),
             "gaveUp": self.gave_up,
+            "decodeMfu": (
+                round(self.decode_mfu, 6) if self.decode_mfu is not None
+                else None
+            ),
+            "hostGapFrac": (
+                round(self.host_gap_frac, 6)
+                if self.host_gap_frac is not None else None
+            ),
+            "occupancy": (
+                round(self.occupancy, 6) if self.occupancy is not None
+                else None
+            ),
+            "steps": self.steps,
         }
 
     @classmethod
     def parse(cls, data: dict) -> "ReplicaLoad":
+        def _opt(key: str) -> Optional[float]:
+            value = data.get(key)
+            if value is None:
+                return None
+            try:
+                return float(value)
+            except (TypeError, ValueError):
+                return None
+
         return cls(
             queue_depth=int(data.get("queueDepth") or 0),
             inflight=int(data.get("inflight") or 0),
             decode_token_s=float(data.get("decodeTokenS") or 0.0),
             gave_up=bool(data.get("gaveUp")),
+            decode_mfu=_opt("decodeMfu"),
+            host_gap_frac=_opt("hostGapFrac"),
+            occupancy=_opt("occupancy"),
+            steps=int(data.get("steps") or 0),
         )
 
 
@@ -336,3 +406,23 @@ class HealthBoard:
             }
             for replica_id, health in sorted(self._health.items())
         }
+
+    def fleet_view(self) -> dict:
+        """Fleet perf roll-up for the operator's ``GET /fleet``: every
+        replica's step-clock summary (as last reported on ``/healthz``)
+        plus fleet aggregates (see :func:`fleet_rollup`)."""
+        replicas = {}
+        for replica_id, health in sorted(self._health.items()):
+            load = health.load
+            replicas[replica_id] = {
+                "ready": health.ready,
+                "breaker": self.breakers.for_key(replica_id).state,
+                "latencyMs": round(health.latency_ms, 3),
+                "queueDepth": load.queue_depth,
+                "inflight": load.inflight,
+                "decodeMfu": load.decode_mfu,
+                "hostGapFrac": load.host_gap_frac,
+                "occupancy": load.occupancy,
+                "steps": load.steps,
+            }
+        return {"replicas": replicas, "fleet": fleet_rollup(replicas)}

@@ -49,12 +49,13 @@ def main() -> None:
 
     from ..utils.config import OperatorConfig
 
+    cfg = OperatorConfig.from_env()
     engine, model_id = build_serving_engine()
     analysis_backend = TPUNativeProvider(
         engine, model_id=model_id,
         # same PREFIX_CACHE gate operator mode wires: a disabled cache
         # must not grow the registry through the analyze route
-        register_template_prefixes=OperatorConfig.from_env().prefix_cache,
+        register_template_prefixes=cfg.prefix_cache,
     )
 
     # /v1/embeddings: MiniLM when a checkpoint is mounted, lexical hashing
@@ -81,6 +82,9 @@ def main() -> None:
                     or os.environ.get("POD_NAME")
                     or None
                 ),
+                # POST /profile?seconds=N (PROFILE_ENABLED / PROFILE_DIR)
+                profile_enabled=cfg.profile_enabled,
+                profile_dir=cfg.profile_dir,
             )
         )
     except KeyboardInterrupt:

@@ -49,6 +49,24 @@ CACHE_FORMAT = 1
 #: filename suffix for one serialized executable
 _SUFFIX = ".aotx"
 
+
+def _fresh_compile_scope():
+    """Scope that bypasses XLA's persistent compilation cache for a compile
+    whose executable will be serialized.  An executable reconstructed from
+    a persistent-cache HIT serializes WITHOUT its jitted symbol definitions
+    — ``deserialize_and_load`` then fails with "Symbols not found" in the
+    next process, poisoning the stored ``.aotx``.  A fresh build serializes
+    completely; nothing is lost because this cache supersedes XLA's for
+    serving programs."""
+    try:
+        from jax._src import config as _jax_config
+
+        return _jax_config.enable_compilation_cache(False)
+    except Exception:  # noqa: BLE001 - private API moved: compile normally
+        import contextlib
+
+        return contextlib.nullcontext()
+
 #: jit-ed function names of the serving programs (programs.py inner defs,
 #: engine decode methods, sched/mixed.py) — what a compile-log event must
 #: contain to count as a SERVING-program compile.  Host glue (eager
@@ -396,7 +414,8 @@ class CachedProgram:
         exe = self._loaded if self._loaded is not None else self._compiled
         if exe is None:
             started = time.perf_counter()
-            self._compiled = self._fn.lower(*args).compile()
+            with _fresh_compile_scope():
+                self._compiled = self._fn.lower(*args).compile()
             self._cache.live_compiles += 1
             log.info(
                 "AOT cache: compiled %s live in %.2fs; persisting",

@@ -139,6 +139,10 @@ class _Request:
     future: asyncio.Future
     priority: int = 0
     requeues: int = 0
+    #: perf_counter at submit (ServingEngine.generate) — queue wait is
+    #: measured admission-minus-submit, not inferred from wall deltas
+    submitted: float = 0.0
+    queue_wait_ms: float = 0.0
 
 
 class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
@@ -171,6 +175,7 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
         prefill_chunk: Optional[int] = None,
         roofline_token_s: Optional[float] = None,
         aot_cache: Any = None,
+        step_ring_capacity: Optional[int] = None,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -182,6 +187,20 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
         self.max_slots = max_slots
         self.max_seq = min(max_seq or config.max_seq_len, config.max_seq_len)
         self.metrics = metrics or METRICS
+        # ---- step clock (obs/steptrace.py + serving/perf.py): a bounded
+        # ring of per-step host-gap/device/sample-xfer records with the
+        # analytic flops-per-token model for the serving dtype, so every
+        # decode step carries an attributed MFU (STEP_RING_CAPACITY)
+        from .perf import StepClock, flops_per_token, peak_tflops
+
+        _serving_dtype = _params_dtype_name(params)
+        self.step_clock = StepClock(
+            capacity=step_ring_capacity,
+            flops_per_token=flops_per_token(config, _serving_dtype),
+            peak_tflops=peak_tflops(_serving_dtype),
+            max_slots=max_slots,
+            metrics=self.metrics,
+        )
         # deadline budgets (admission.deadline_policy): per-token decode
         # estimate before any block has been measured; the clock is an
         # attribute so chaos tests can inject a fake one
@@ -906,6 +925,9 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
         self._inflight_blocks.clear()
         self._prefill_job = None
         self._reserved.clear()
+        # the step timeline died with the device state (black-box dumps
+        # captured the tail first — _dump_blackbox runs before reset)
+        self.step_clock.reset()
         self._guided_tables = None
         self._guided_index = {}
         self._guided_aut_np[:] = 0
@@ -963,6 +985,17 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
         jnp = self._jnp
         self.metrics.record("prefill", prefill_ms)
         self.metrics.record("prefill_batch", float(len(taken)))
+        # step clock: the wave's prefill is one phase-separated step; its
+        # compute is all "device" (the chunked path's accumulated chunk
+        # time), no per-component split is measurable post-hoc
+        self.step_clock.observe(
+            kind="prefill",
+            tokens=int(sum(int(n) for n in lengths)),
+            slots=len(taken),
+            host_gap_ms=0.0,
+            device_ms=float(prefill_ms),
+            sample_xfer_ms=0.0,
+        )
         if self.num_decoding:
             # wave-engine phase separation: this admission's prefill
             # compute ran while decode slots sat idle — the stall the
@@ -983,6 +1016,10 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
             slot.params = params_list[row]
             slot.started = time.perf_counter()
             slot.prefill_ms = prefill_ms
+            # decode time is derived from the step clock (not wall): the
+            # cumulative decode-bearing ms the clock accrues between here
+            # and _finish IS this slot's decode wall
+            slot.decode_cum0 = self.step_clock.decode_cum_ms
             slot.pages = page_grants[row] if self.paged else []
             last[slot_id, 0] = int(first_np[row])
             self._host_offsets[slot_id] = int(lengths[row])
@@ -1240,11 +1277,50 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
             if slot.active
         }
         self._host_offsets[active] += block
-        self._inflight_blocks.append((toks, snapshot))
+        # step-clock stamps: dispatch time + the host gap since the last
+        # processed block's commit travel WITH the block, because with
+        # pipeline_depth > 1 it is processed (and its record written) a
+        # later round than it was dispatched
+        t_dispatch = time.perf_counter()
+        self._inflight_blocks.append((
+            toks, snapshot,
+            (t_dispatch, self.step_clock.host_gap_ms(t_dispatch), len(snapshot)),
+        ))
 
-    def _process_block(self, toks, snapshot) -> list[tuple[int, GenerationResult]]:
+    def _process_block(
+        self, toks, snapshot, timing=None
+    ) -> list[tuple[int, GenerationResult]]:
         block = self.decode_block
+        if timing is not None:
+            # resolve dispatch->ready BEFORE the fetch: the asarray below
+            # would block on the same completion event anyway, so this adds
+            # no new host sync — it only splits the wait into device time
+            # vs the sampled-token device->host transfer (GL001: this
+            # method is host loop code, never reachable from a jitted
+            # entry point — same legality as the asarray it times)
+            try:
+                toks.block_until_ready()
+            except AttributeError:  # fake arrays in tests
+                pass
+            t_ready = time.perf_counter()
         toks_np = np.asarray(toks)  # [K, B] — the ONE host sync per block
+        if timing is not None:
+            t_fetch = time.perf_counter()
+            t_dispatch, host_gap_ms, live = timing
+            self.step_clock.observe(
+                kind="decode",
+                tokens=block * live,
+                slots=live,
+                host_gap_ms=host_gap_ms,
+                # device window is dispatch -> ready; waiting began at
+                # t_ready0, but the block may have been ready long before
+                # (pipelined depth>1), in which case the wait is ~0
+                device_ms=max(0.0, (t_ready - t_dispatch) * 1e3),
+                sample_xfer_ms=max(0.0, (t_fetch - t_ready) * 1e3),
+                # the token-processing loop below runs AFTER the commit
+                # stamp, so its wall lands in the NEXT record's host gap
+                commit_t=t_fetch,
+            )
         finished: list[tuple[int, GenerationResult]] = []
         eos = self.tokenizer.eos_id
         for i, (epoch, before) in snapshot.items():
@@ -1337,9 +1413,15 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
             completion_tokens=len(ids),
             finish_reason=reason,
             prefill_ms=slot.prefill_ms,
-            # slot.started is stamped AFTER prefill completes, so this span
-            # is pure decode time already
-            decode_ms=(time.perf_counter() - slot.started) * 1e3,
+            # decode wall DERIVED FROM THE STEP CLOCK: the decode-bearing
+            # ms the ring accrued while the slot was live (monotonic
+            # cumulative, so ring eviction cannot corrupt it).  The old
+            # coarse wall delta (now - slot.started) could disagree with
+            # the step records; this cannot.
+            decode_ms=max(
+                0.0, self.step_clock.decode_cum_ms - slot.decode_cum0
+            ),
+            queue_wait_ms=slot.queue_wait_ms,
         )
         self.slots[slot_id] = _Slot()
         return result
@@ -1563,6 +1645,15 @@ class ServingEngine:
             except Exception:  # noqa: BLE001 - forensics must never block recovery
                 return
         try:
+            # the stall's preceding timeline: the last step records BEFORE
+            # the reset wipes the clock (obs.view --steps renders them)
+            if "steps" not in extra:
+                steps = self.generator.step_clock.ring.records(last=32)
+                if steps:
+                    extra = {**extra, "steps": [r.to_dict() for r in steps]}
+        except Exception:  # noqa: BLE001 - forensics must never block recovery
+            pass
+        try:
             from ..obs import Tracer
 
             tracer = Tracer(recorder=recorder)
@@ -1655,6 +1746,15 @@ class ServingEngine:
         stalled = self._stalled
         reason = "engine-stall" if stalled else "engine-error"
         cause = str(self._error)
+        # the stall's preceding step timeline, captured BEFORE the device
+        # reset wipes the step clock with the rest of decode state
+        try:
+            step_tail = [
+                r.to_dict()
+                for r in self.generator.step_clock.ring.records(last=32)
+            ]
+        except Exception:  # noqa: BLE001 - forensics must never block recovery
+            step_tail = []
         retry, gaveup = self._collect_survivors()
         # parked here until requeued/failed: if close() interrupts this
         # restart, _fail_outstanding still reaches these futures
@@ -1750,6 +1850,7 @@ class ServingEngine:
             "resets_in_window": len(self._reset_times),
             "restart_ready_s": round(ready_s, 3),
             "aot_cache": aot.stats() if aot is not None else "off",
+            "steps": step_tail,
         })
         log.warning(
             "supervised engine restart (%s) ready in %.2fs: %d requeued, "
@@ -1788,11 +1889,20 @@ class ServingEngine:
         else:
             queue_depth = self._queue.qsize()
             inflight = len(self._inflight) + len(self._pending)
+        # step-timing summary (obs/steptrace.py): the measured decode MFU,
+        # host-gap fraction and occupancy the operator's /fleet view rolls
+        # up across replicas — None until steps have been recorded
+        summary = self.generator.step_clock.summary()
+        fractions = summary.get("fractions") or {}
         return ReplicaLoad(
             queue_depth=queue_depth,
             inflight=inflight,
             decode_token_s=self.generator.decode_token_estimate_s(),
             gave_up=self._gave_up,
+            decode_mfu=summary.get("decode_mfu"),
+            host_gap_frac=fractions.get("host_gap"),
+            occupancy=summary.get("occupancy_avg"),
+            steps=summary.get("steps") or 0,
         )
 
     async def start(self) -> None:
@@ -2035,7 +2145,10 @@ class ServingEngine:
                 await self._low_lane.acquire()  # released when the entry is popped
             await self._queue.put((
                 -priority, next(self._seq),
-                _Request(prompt, params or SamplingParams(), future, priority),
+                _Request(
+                    prompt, params or SamplingParams(), future, priority,
+                    submitted=submitted,
+                ),
             ))
             # the put may have landed after close()/loop-death drained the
             # queue; _closed/_error were set before the drain, so re-checking
@@ -2049,11 +2162,24 @@ class ServingEngine:
                 self._partial_by_future.pop(future, None)
                 future.set_exception(RuntimeError("serving engine is closed"))
             result = await future
-            wall_ms = (time.perf_counter() - submitted) * 1e3
+            # span timings are COPIED from the result, whose decode/queue
+            # numbers are derived from the step clock + measured admission
+            # wait — the span and the step records share one source of
+            # truth and cannot disagree (the old wall-minus-compute
+            # inference could).  The same values feed the latency
+            # histograms (docs/METRICS.md "Histograms").
+            metrics = self.generator.metrics
+            metrics.observe("queue_wait_milliseconds", result.queue_wait_ms)
+            metrics.observe(
+                "ttft_milliseconds", result.queue_wait_ms + result.prefill_ms
+            )
+            if result.completion_tokens > 0:
+                metrics.observe(
+                    "token_latency_milliseconds",
+                    result.decode_ms / result.completion_tokens,
+                )
             span_.set(
-                queue_wait_ms=round(
-                    max(0.0, wall_ms - result.prefill_ms - result.decode_ms), 3
-                ),
+                queue_wait_ms=round(result.queue_wait_ms, 3),
                 prefill_ms=round(result.prefill_ms, 3),
                 decode_ms=round(result.decode_ms, 3),
                 prompt_tokens=result.prompt_tokens,
@@ -2137,7 +2263,8 @@ class ServingEngine:
                     for request in requests:
                         try:
                             out.append((request, sched.enqueue(
-                                request.prompt, request.params
+                                request.prompt, request.params,
+                                submitted=request.submitted or None,
                             ), None))
                         except Exception as exc:  # noqa: BLE001 - per-request verdict
                             out.append((request, None, exc))
@@ -2294,6 +2421,7 @@ class ServingEngine:
                     self._partial_cbs.pop(slot_id, None)
                     request = self._pending.pop(slot_id, None)
                     if request is not None and not request.future.done():
+                        result.queue_wait_ms = request.queue_wait_ms
                         request.future.set_result(result)
             await asyncio.sleep(0)
 
@@ -2301,6 +2429,8 @@ class ServingEngine:
         """Admit as much of ``batch`` as fits; returns the admitted count."""
         prompts = [request.prompt for request in batch]
         params = [request.params for request in batch]
+        # queue wait ends when admission (prefill included) begins
+        admitted_t = time.perf_counter()
         try:
             admit_call = asyncio.get_running_loop().run_in_executor(
                 self._executor, lambda: self.generator.admit(prompts, params)
@@ -2345,6 +2475,10 @@ class ServingEngine:
                     request.future.set_exception(exc)
             raise
         for slot_id, request in zip(slot_ids, batch):
+            if request.submitted:
+                request.queue_wait_ms = max(
+                    0.0, (admitted_t - request.submitted) * 1e3
+                )
             self._pending[slot_id] = request
             callback = self._partial_by_future.pop(request.future, None)
             if callback is not None:

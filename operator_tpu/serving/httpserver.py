@@ -20,8 +20,13 @@ operator/httpserver.py):
   exposed OpenAI-style for log-similarity tooling
 - ``GET  /healthz``              — liveness for probes, plus this
   replica's identity and load report (queue depth, roofline decode
-  estimate, supervisor gave-up flag) for the failover router
-  (operator_tpu/router/)
+  estimate, supervisor gave-up flag, step-clock perf summary) for the
+  failover router (operator_tpu/router/)
+- ``POST /profile?seconds=N``    — on-demand TPU profiler capture
+  (``jax.profiler.start_trace``/``stop_trace``): N seconds of device
+  trace written under the profile dir, 404 unless enabled
+  (``PROFILE_ENABLED``), 409 while a capture is already running;
+  token-gated with everything else when ``api_token`` is set
 
 ``stream: true`` serves Server-Sent Events: one OpenAI-format chunk per
 decode BLOCK (the engine's host-sync granularity — per-token events
@@ -163,6 +168,8 @@ class CompletionServer:
         tracer: Optional[Any] = None,  # obs.Tracer for inbound traceparent
         drain_grace_s: float = 30.0,  # OperatorConfig.serving_drain_grace_s
         replica_id: Optional[str] = None,
+        profile_enabled: bool = False,
+        profile_dir: Optional[str] = None,
     ) -> None:
         self.engine = engine
         self.model_id = model_id
@@ -194,6 +201,12 @@ class CompletionServer:
         #: prefill/decode) land in the flight recorder.  None = header
         #: accepted but ignored.
         self.tracer = tracer
+        #: POST /profile gate (OperatorConfig.profile_enabled /
+        #: PROFILE_ENABLED): off by default — a capture costs device
+        #: attention and disk, and must be an explicit operator decision
+        self.profile_enabled = profile_enabled
+        self.profile_dir = profile_dir or "/tmp/operator-tpu-profile"
+        self._profiling = False
         self._server: Optional[asyncio.AbstractServer] = None
         self._started = time.time()
         # graceful drain (docs/ROBUSTNESS.md): stop() closes the listener
@@ -396,7 +409,10 @@ class CompletionServer:
 
     async def _route(self, method: str, path: str, body: bytes, writer, *,
                      accept: str = ""):
-        path = path.split("?", 1)[0]
+        import urllib.parse
+
+        path, _, raw_query = path.partition("?")
+        query = urllib.parse.parse_qs(raw_query)
         if method == "GET" and path == "/healthz":
             # identity + load report for the data-plane router
             # (operator_tpu/router/): one poll answers liveness, WHO this
@@ -447,6 +463,8 @@ class CompletionServer:
                     "owned_by": "operator-tpu",
                 })
             return 200, {"object": "list", "data": models}
+        if method == "POST" and path == "/profile":
+            return await self._profile(query)
         if method == "POST" and path == "/api/v1/analysis/analyze":
             return await self._analyze(self._parse_json(body))
         if method == "POST" and path == "/v1/embeddings":
@@ -707,6 +725,60 @@ class CompletionServer:
         }
 
 
+    # -- on-demand profiler capture ------------------------------------------
+
+    async def _profile(self, query: dict):
+        """Capture ``seconds`` of ``jax.profiler`` device trace into a
+        fresh directory under ``profile_dir`` and return its path.  The
+        serving loops keep running — the whole point is to catch the
+        LIVE workload's step timeline, not a synthetic one; the step
+        clock says WHERE a step's time goes, the xplane capture says
+        why.  One capture at a time (409): nested start_trace raises
+        deep inside jax, and two captures would interleave anyway."""
+        if not self.profile_enabled:
+            raise ApiError(
+                404, "profiling disabled (enable with PROFILE_ENABLED=1)"
+            )
+        try:
+            seconds = float(query.get("seconds", ["2"])[0])
+        except ValueError:
+            raise ApiError(400, "seconds must be a number") from None
+        # clamp: long captures produce multi-GB xplane dirs and hold the
+        # profiler hostage; 0 would stop before the first step lands
+        seconds = min(max(seconds, 0.1), 60.0)
+        if self._profiling:
+            raise ApiError(409, "a profile capture is already running")
+        profiler = getattr(
+            self.engine.generator._jax, "profiler", None
+        )
+        if profiler is None or not hasattr(profiler, "start_trace"):
+            raise ApiError(
+                501, "jax.profiler is unavailable in this runtime",
+                "server_error",
+            )
+        import os
+
+        out_dir = os.path.join(
+            self.profile_dir, f"profile-{int(time.time() * 1e3)}"
+        )
+        self._profiling = True
+        try:
+            # start/stop are host-side control calls but can block on
+            # device bookkeeping — keep them off the event loop
+            await asyncio.to_thread(profiler.start_trace, out_dir)
+            try:
+                await asyncio.sleep(seconds)
+            finally:
+                await asyncio.to_thread(profiler.stop_trace)
+        finally:
+            self._profiling = False
+        return 200, {
+            "object": "profile",
+            "artifact": out_dir,
+            "seconds": seconds,
+            "replica": self.replica_id,
+        }
+
     # -- reference ai-interface contract -------------------------------------
 
     async def _analyze(self, req: dict) -> dict:
@@ -941,12 +1013,15 @@ async def serve_forever(
     embedder: Optional[Any] = None,
     analysis_backend: Optional[Any] = None,
     replica_id: Optional[str] = None,
+    profile_enabled: bool = False,
+    profile_dir: Optional[str] = None,
 ) -> None:
     """Run the completion API until cancelled (SIGINT/SIGTERM via CLI)."""
     server = CompletionServer(
         engine, model_id=model_id, host=host, port=port, api_token=api_token,
         embedder=embedder, analysis_backend=analysis_backend,
-        replica_id=replica_id,
+        replica_id=replica_id, profile_enabled=profile_enabled,
+        profile_dir=profile_dir,
     )
     await server.start()
     try:

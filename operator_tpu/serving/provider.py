@@ -419,6 +419,7 @@ def build_serving_engine(
         lora_alpha=config.lora_alpha,
         prefill_chunk=prefill_chunk,
         aot_cache=aot,
+        step_ring_capacity=config.step_ring_capacity,
     )
     # continuous-batching scheduler (serving/sched/, docs/SERVING.md):
     # opt-in via SCHED_MODE=continuous; falls back to the wave engine

@@ -115,16 +115,30 @@ class Scheduler:
         #: the determinism test replays a fixed arrival trace and
         #: asserts the schedule is byte-identical
         self.plan_log: Optional[list] = None
+        # step-clock stamps _dispatch leaves for step() to observe
+        # (serving/perf.py): dispatch start, device/xfer split, fetch end
+        self._dispatch_t = 0.0
+        self._device_ms = 0.0
+        self._xfer_ms = 0.0
+        self._fetch_t = 0.0
 
     # ------------------------------------------------------------------
     # submit side
     # ------------------------------------------------------------------
 
-    def enqueue(self, prompt: str, params: Optional[SamplingParams] = None) -> int:
+    def enqueue(
+        self,
+        prompt: str,
+        params: Optional[SamplingParams] = None,
+        *,
+        submitted: Optional[float] = None,
+    ) -> int:
         """Tokenise + queue one request; returns its req id.  Raises
         :class:`OversizedRequest` when the request can never fit the KV
         pool, ``ValueError`` for features the mixed program does not
-        serve (guided decoding, LoRA)."""
+        serve (guided decoding, LoRA).  ``submitted`` carries the
+        caller's original perf_counter submit stamp (ServingEngine), so
+        queue wait covers the engine handoff too, not just this queue."""
         g = self.generator
         params = params or SamplingParams()
         if params.guided_choice is not None or params.guided_regex is not None:
@@ -149,7 +163,10 @@ class Scheduler:
                 f"pages, cache holds {pool}"
             )
         req_id = next(self._next_req)
-        self._queue.append((req_id, tokens, params, time.perf_counter()))
+        self._queue.append((
+            req_id, tokens, params,
+            submitted if submitted is not None else time.perf_counter(),
+        ))
         return req_id
 
     def cancel(self, req_id: int) -> bool:
@@ -230,6 +247,24 @@ class Scheduler:
         ):
             toks = self._dispatch(plan)
         elapsed_ms = (time.perf_counter() - started) * 1e3
+        # step-clock record BEFORE commit: a prompt completing this step
+        # then stamps decode_cum0 with this step already counted, so its
+        # decode window is exactly the steps it decoded in
+        if plan.decode_rows and plan.prefill_rows:
+            kind = "mixed"
+        elif plan.decode_rows:
+            kind = "decode"
+        else:
+            kind = "prefill"
+        g.step_clock.observe(
+            kind=kind,
+            tokens=plan.tokens_planned,
+            slots=held_rows,
+            host_gap_ms=g.step_clock.host_gap_ms(self._dispatch_t),
+            device_ms=self._device_ms,
+            sample_xfer_ms=self._xfer_ms,
+            commit_t=self._fetch_t,
+        )
         outcomes.extend(self._commit(plan, toks, elapsed_ms))
         # step accounting: occupancy is HELD slots over capacity (rows at
         # any phase — the same "slots occupied" definition the wave
@@ -321,12 +356,12 @@ class Scheduler:
                 pages=grant, submitted=submitted,
             )
             self._rows[req_id] = row
-            # admission queue-wait visibility (the engine span's
-            # queue_wait is wall minus compute; this is the sched-queue
-            # share specifically)
-            self.metrics.record(
-                "sched_queue_wait", (time.perf_counter() - submitted) * 1e3
+            # measured submit -> admission wall: the span's queue_wait_ms
+            # and the sched_queue_wait gauge read the SAME number
+            row.queue_wait_ms = max(
+                0.0, (time.perf_counter() - submitted) * 1e3
             )
+            self.metrics.record("sched_queue_wait", row.queue_wait_ms)
             # mirror into the generator's slot table so free_slots /
             # num_active / the supervisor's leak audit see one truth
             slot_obj = _Slot()
@@ -445,6 +480,7 @@ class Scheduler:
                 lengths=paged.lengths,
             )
             self._staged_tables.clear()
+        self._dispatch_t = time.perf_counter()
         new_paged, next_tokens, rng = self._get_fn()(
             g.params, paged,
             jnp.asarray(ids), jnp.asarray(rows), jnp.asarray(pos),
@@ -455,7 +491,20 @@ class Scheduler:
         g.paged_cache = new_paged
         g._rng = rng
         self._kv_shadow = kv_len
-        return np.asarray(next_tokens)
+        # the step's ONE host sync was always here (np.asarray); the
+        # block_until_ready in front only SPLITS it into device compute
+        # vs token-id transfer — no new sync point (GL001: host loop
+        # code, not jit-reachable)
+        try:
+            next_tokens.block_until_ready()
+        except AttributeError:
+            pass  # already a host array (fake-jax tests)
+        t_ready = time.perf_counter()
+        out = np.asarray(next_tokens)
+        self._fetch_t = time.perf_counter()
+        self._device_ms = max(0.0, (t_ready - self._dispatch_t) * 1e3)
+        self._xfer_ms = max(0.0, (self._fetch_t - t_ready) * 1e3)
+        return out
 
     # -- commit --------------------------------------------------------
 
@@ -475,19 +524,28 @@ class Scheduler:
         self.metrics.incr("sched_recycled_slot")
 
     def _finish(self, row: _Row, reason: str) -> GenerationResult:
-        eos = self.generator.tokenizer.eos_id
+        g = self.generator
+        eos = g.tokenizer.eos_id
         ids = [t for t in row.generated if t != eos]
         if reason == "length" and row.params.deadline_clamped:
             reason = "deadline"
-        now = time.perf_counter()
+        # decode wall from the step clock's monotonic cumulative, not a
+        # wall-clock delta: the SAME records /metrics and black-box dumps
+        # carry, so the span and the step timeline cannot disagree
+        decode_ms = 0.0
+        if row.started:
+            decode_ms = max(
+                0.0, g.step_clock.decode_cum_ms - row.decode_cum0
+            )
         result = GenerationResult(
-            text=self.generator.tokenizer.decode(ids),
+            text=g.tokenizer.decode(ids),
             token_ids=ids,
             prompt_tokens=row.prompt_len,
             completion_tokens=len(ids),
             finish_reason=reason,
             prefill_ms=row.prefill_ms,
-            decode_ms=(now - row.started) * 1e3 if row.started else 0.0,
+            decode_ms=decode_ms,
+            queue_wait_ms=row.queue_wait_ms,
         )
         self._release_row(row)
         return result
@@ -519,6 +577,7 @@ class Scheduler:
                 # row's first generated token (wave-engine semantics:
                 # the prefill-sampled token counts toward max_tokens)
                 row.started = time.perf_counter()
+                row.decode_cum0 = g.step_clock.decode_cum_ms
                 row.generated = [token]
                 self.metrics.record("prefill", row.prefill_ms)
             else:

@@ -45,6 +45,11 @@ class _Row:
     started: float = 0.0  # perf_counter when the prompt completed
     prefill_ms: float = 0.0  # accumulated chunk compute share
     chunked: bool = False  # took more than one step of prefill
+    queue_wait_ms: float = 0.0  # measured submit -> admission wall
+    #: step-clock decode cumulative (StepRing.decode_cum_ms) when the
+    #: prompt completed — _finish derives decode_ms as the delta, so the
+    #: span timing and the step records share one source of truth
+    decode_cum0: float = 0.0
 
     @property
     def prompt_len(self) -> int:

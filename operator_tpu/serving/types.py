@@ -54,7 +54,14 @@ class GenerationResult:
     completion_tokens: int
     finish_reason: str  # "stop" | "length" | "deadline" (budget-clamped length)
     prefill_ms: float = 0.0
+    #: decode wall DERIVED FROM THE STEP CLOCK (obs/steptrace.py): the
+    #: cumulative attributed wall of decode-bearing steps this request
+    #: lived through — the same records /metrics histograms and black-box
+    #: dumps carry, so span timings and step records cannot disagree
     decode_ms: float = 0.0
+    #: submit -> admission wall (measured, not inferred as wall minus
+    #: compute — the coarse delta the engine.generate span used to carry)
+    queue_wait_ms: float = 0.0
 
     @property
     def total_ms(self) -> float:
@@ -70,6 +77,10 @@ class _Slot:
     started: float = 0.0
     prefill_ms: float = 0.0
     pages: list[int] = field(default_factory=list)  # paged mode only
+    #: step-clock decode cumulative (StepRing.decode_cum_ms) when the slot
+    #: went live — _finish derives decode_ms as the delta, eviction-proof
+    decode_cum0: float = 0.0
+    queue_wait_ms: float = 0.0
 
 
 @dataclass

@@ -268,6 +268,14 @@ class OperatorConfig:
     completion_api_port: int = -1
     completion_api_host: str = "0.0.0.0"
     completion_api_token: str = ""  # "" = no auth required
+    # step clock (serving/perf.py, docs/OBSERVABILITY.md "Step clock"):
+    # bounded ring of per-step decode-attribution records behind
+    # /healthz, /fleet, black-box dumps and bench step_attribution
+    step_ring_capacity: int = 512
+    # POST /profile?seconds=N on-demand jax.profiler capture on the
+    # serving API (off by default: captures cost device attention+disk)
+    profile_enabled: bool = False
+    profile_dir: str = "/tmp/operator-tpu-profile"
 
     @classmethod
     def from_env(cls, env: Optional[dict[str, str]] = None) -> "OperatorConfig":

@@ -10,10 +10,23 @@ from __future__ import annotations
 
 import threading
 import time
-from bisect import insort
+from bisect import bisect_left, insort
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Iterator, Optional
+from typing import Iterator, Optional, Sequence
+
+#: default bucket upper bounds (ms) for latency histograms: sub-millisecond
+#: decode steps through multi-second queue waits under storm load
+DEFAULT_BUCKETS_MS: "tuple[float, ...]" = (
+    0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+    250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0,
+)
+
+
+def _le(bound: float) -> str:
+    """Prometheus ``le`` label rendering (``0.5``, ``1``, ``2.5`` — no
+    trailing zeros, so both exposition flavours parse it as a float)."""
+    return f"{bound:g}"
 
 
 @dataclass
@@ -55,6 +68,42 @@ class StageStats:
         return self.percentile(99)
 
 
+class HistogramStats:
+    """Fixed-bucket latency histogram (Prometheus semantics: each bucket
+    counts observations ``<= le``; exposition renders the cumulative
+    counts plus ``+Inf``/``_sum``/``_count``).  Per-bucket counts are
+    stored raw and cumulated at render so `observe` stays O(log buckets)
+    with constant memory — unlike StageStats there is no sample list to
+    cap, which is what makes histograms the right shape for per-step
+    and per-token observations at serving rates."""
+
+    __slots__ = ("name", "bounds", "counts", "sum", "count")
+
+    def __init__(
+        self, name: str, bounds: Sequence[float] = DEFAULT_BUCKETS_MS
+    ) -> None:
+        self.name = name
+        self.bounds: tuple[float, ...] = tuple(sorted(float(b) for b in bounds))
+        self.counts: list[int] = [0] * (len(self.bounds) + 1)  # [-1] = +Inf
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.sum += value
+        self.counts[bisect_left(self.bounds, value)] += 1
+
+    def cumulative(self) -> "list[tuple[str, int]]":
+        """``[(le_label, cumulative_count), ..., ("+Inf", count)]``."""
+        out: list[tuple[str, int]] = []
+        running = 0
+        for bound, bucket in zip(self.bounds, self.counts):
+            running += bucket
+            out.append((_le(bound), running))
+        out.append(("+Inf", self.count))
+        return out
+
+
 class MetricsRegistry:
     """Thread-safe registry of stage stats + counters."""
 
@@ -62,6 +111,9 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._stages: dict[str, StageStats] = {}
         self._counters: dict[str, int] = {}
+        # fixed-bucket histograms (step duration, host gap, queue wait,
+        # TTFT, per-token latency — docs/METRICS.md "Histograms")
+        self._histograms: dict[str, HistogramStats] = {}
         # last-value gauges (e.g. supervisor_restart_ready_seconds):
         # point-in-time observations where only the latest value matters
         self._gauges: dict[str, float] = {}
@@ -93,6 +145,27 @@ class MetricsRegistry:
             yield
         finally:
             self.record(name, (time.perf_counter() - started) * 1e3)
+
+    def observe(
+        self,
+        name: str,
+        value: float,
+        *,
+        buckets: Optional[Sequence[float]] = None,
+    ) -> None:
+        """One histogram observation; the first call fixes the bucket
+        bounds (later ``buckets=`` arguments are ignored — Prometheus
+        cannot re-bucket a live series)."""
+        with self._lock:
+            hist = self._histograms.get(name)
+            if hist is None:
+                hist = HistogramStats(name, buckets or DEFAULT_BUCKETS_MS)
+                self._histograms[name] = hist
+            hist.observe(value)
+
+    def histogram(self, name: str) -> Optional[HistogramStats]:
+        with self._lock:
+            return self._histograms.get(name)
 
     def incr(self, name: str, amount: int = 1, *, exemplar: Optional[str] = None) -> None:
         with self._lock:
@@ -127,6 +200,15 @@ class MetricsRegistry:
                 },
                 "counters": dict(self._counters),
             }
+            if self._histograms:
+                out["histograms"] = {
+                    name: {
+                        "buckets": dict(h.cumulative()),
+                        "sum": round(h.sum, 3),
+                        "count": h.count,
+                    }
+                    for name, h in self._histograms.items()
+                }
             if self._gauges:
                 out["gauges"] = {k: round(v, 6) for k, v in self._gauges.items()}
             if self._exemplars:
@@ -162,6 +244,15 @@ class MetricsRegistry:
                     lines.append(f'{metric}{{stage="{stage}",quantile="0.99"}} {s.p99_ms:.3f}')
                     lines.append(f'{metric}_sum{{stage="{stage}"}} {s.total_ms:.3f}')
                     lines.append(f'{metric}_count{{stage="{stage}"}} {s.count}')
+            for name, h in sorted(self._histograms.items()):
+                # histograms are legal (and identical) in BOTH flavours:
+                # cumulative le-buckets ending at +Inf, then _sum/_count
+                metric = f"podmortem_{sane(name)}"
+                lines.append(f"# TYPE {metric} histogram")
+                for le, cumulative in h.cumulative():
+                    lines.append(f'{metric}_bucket{{le="{le}"}} {cumulative}')
+                lines.append(f"{metric}_sum {h.sum:.3f}")
+                lines.append(f"{metric}_count {h.count}")
             for name, value in sorted(self._counters.items()):
                 family = f"podmortem_{sane(name)}"
                 metric = f"{family}_total"

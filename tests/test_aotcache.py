@@ -151,6 +151,19 @@ class TestRoundTrip:
             pickle.dump({"format": CACHE_FORMAT + 1, "payload": b""}, f)
         assert cache.get("old") is None and cache.errors == 1
 
+    def test_fresh_compile_scope_bypasses_xla_persistent_cache(self):
+        """An executable reconstructed from an XLA persistent-cache HIT
+        serializes without its jitted symbol definitions, so the compile
+        that feeds ``put`` must bypass that cache.  If the (private) jax
+        config hook this rides ever moves, the scope silently degrades to
+        a no-op — this assertion is what turns that into a loud failure."""
+        from operator_tpu.serving.aotcache import _fresh_compile_scope
+
+        assert jax.config.jax_enable_compilation_cache
+        with _fresh_compile_scope():
+            assert not jax.config.jax_enable_compilation_cache
+        assert jax.config.jax_enable_compilation_cache
+
 
 # ---------------------------------------------------------------- warm boot
 class TestWarmBoot:
