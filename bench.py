@@ -19,11 +19,17 @@ Two phases:
 
 1. **closed batch** — BENCH_REQUESTS submitted at t=0 and drained: peak
    batched throughput (the headline expl/min metric).
-2. **open loop** — Poisson arrivals at BENCH_RATE/min for
-   BENCH_OPEN_SECONDS: the honest p50/p99 arrival->completion latency under
-   sustained load (SURVEY.md §7 stage 6; the closed batch's p50 ~= wall
-   time is a queueing artifact, VERDICT r2 weak #2).  Set BENCH_OPEN=0 to
-   skip, BENCH_SWEEP="60,100,150" for a rate sweep.
+2. **open loop** — a seeded failure storm at BENCH_RATE/min for
+   BENCH_OPEN_SECONDS through the FULL operator->router->serving stack
+   (operator_tpu/loadgen/), with SLO accounting from the ledger
+   (obs/sloledger.py): offered vs achieved, per-class attainment,
+   goodput-under-SLO, shed/deadline-exceeded breakdown, and the
+   two-replay determinism gate (``replay_identical``).  The closed
+   batch's p50 ~= wall time is a queueing artifact (VERDICT r2 weak #2);
+   this phase is the honest number.  Set BENCH_OPEN=0 to skip,
+   BENCH_SWEEP="60,100,150" for a rate sweep.  On cpu-fallback the storm
+   runs compressed (BENCH_OPEN_TIME_SCALE) over synthetic replicas —
+   same operator stack, engine-less serving.
 
 Knobs (env): BENCH_MODEL (tinyllama-1.1b), BENCH_REQUESTS (32),
 BENCH_SLOTS (16), BENCH_MAX_TOKENS (96), BENCH_MAX_SEQ (1024),
@@ -35,7 +41,6 @@ from __future__ import annotations
 import asyncio
 import json
 import os
-import random
 import sys
 import time
 
@@ -67,48 +72,100 @@ def build_requests(n: int) -> list:
 
 
 async def run_open_loop(
-    serving,
-    prompts: list,
-    sampling,
+    replicas,
     *,
     rate_per_min: float,
     duration_s: float,
     seed: int = 0,
+    time_scale: float = 1.0,
+    drain_s: float = 60.0,
 ) -> dict:
-    """Poisson arrivals at ``rate_per_min`` for ``duration_s``; every
-    arrival is awaited to completion (arrivals stop, the queue drains).
-    Returns {rate_per_min, offered, completed, p50_s, p99_s, drain_s}."""
-    rng = random.Random(seed)
-    latencies: list[float] = []
-    tasks: list[asyncio.Task] = []
+    """One seeded open-loop failure storm through the FULL stack —
+    operator pipeline -> router -> serving replicas (operator_tpu/loadgen/)
+    — with SLO accounting from the ledger (obs/sloledger.py).
 
-    async def one(prompt: str) -> None:
-        started = time.perf_counter()
-        await serving.generate(prompt, sampling)
-        latencies.append(time.perf_counter() - started)
+    Arrivals are a seeded storm schedule materialised up front and fired
+    whether or not the system keeps up (arrivals never wait in line);
+    the record reports offered vs achieved, per-class latency
+    percentiles, attainment, goodput-under-SLO, and the shed /
+    deadline-exceeded breakdown.  The schedule is materialised TWICE
+    independently and the record carries ``replay_identical`` — the
+    two-replay determinism gate — plus a zero-torn-lines audit of the
+    ledger journal."""
+    import tempfile
 
-    start = time.perf_counter()
-    i = 0
-    next_at = 0.0
-    while next_at < duration_s:
-        delay = start + next_at - time.perf_counter()
-        if delay > 0:
-            await asyncio.sleep(delay)
-        tasks.append(asyncio.ensure_future(one(prompts[i % len(prompts)])))
-        i += 1
-        next_at += rng.expovariate(rate_per_min / 60.0)
-    arrivals_done = time.perf_counter()
-    await asyncio.gather(*tasks)
-    drain = time.perf_counter() - arrivals_done
-    latencies.sort()
-    n = len(latencies)
+    from operator_tpu.loadgen import ArrivalProcess, ArrivalSpec
+    from operator_tpu.loadgen.storm import build_storm_stack, run_storm
+
+    spec = ArrivalSpec(
+        name="storm", rate_per_min=rate_per_min, duration_s=duration_s,
+    )
+    process = ArrivalProcess(spec, seed=seed)
+    replay = ArrivalProcess(spec, seed=seed)
+    replay_identical = (
+        process.fingerprint() == replay.fingerprint()
+        and [e.to_dict() for e in process.materialize()]
+        == [e.to_dict() for e in replay.materialize()]
+    )
+    with tempfile.TemporaryDirectory(prefix="bench-slo-") as tmp:
+        ledger_path = os.path.join(tmp, "slo-ledger.jsonl")
+        stack = await build_storm_stack(
+            replicas=replicas, time_scale=time_scale,
+            ledger_path=ledger_path,
+        )
+        report = await run_storm(stack, process, drain_s=drain_s)
+        stack.close()
+        torn = 0
+        journaled = 0
+        with open(ledger_path) as fh:
+            for line in fh:
+                if not line.strip():
+                    continue
+                journaled += 1
+                try:
+                    json.loads(line)
+                except ValueError:
+                    torn += 1
+    total = report["slo"]["total"]
+    classes = {
+        cls: {
+            "target_s": row.get("target_s"),
+            "admitted": row["admitted"],
+            "attainment": row["attainment"],
+            "p50_s": row["p50_s"],
+            "p95_s": row["p95_s"],
+            "p99_s": row["p99_s"],
+            "goodput_analyses_per_min": row["goodput_analyses_per_min"],
+            "goodput_tokens_s": row["goodput_tokens_s"],
+        }
+        for cls, row in report["slo"]["classes"].items()
+    }
+    # the headline p50: the 2s-target interactive class when present
+    # (that is the class the >=100/min SLO gate judges), else the total
+    interactive = report["slo"]["classes"].get("interactive") or {}
     return {
         "rate_per_min": rate_per_min,
-        "offered": i,
-        "completed": n,
-        "p50_s": round(latencies[n // 2], 3) if n else None,
-        "p99_s": round(latencies[min(n - 1, int(n * 0.99))], 3) if n else None,
-        "drain_s": round(drain, 2),
+        "offered": report["arrivals"],
+        "offered_per_min": report["offered_per_min"],
+        "achieved_per_min": report["achieved_per_min"],
+        "completed": total["completed"],
+        "attainment": total["attainment"],
+        "shed": total["shed"],
+        "deadline_exceeded": total["deadline_exceeded"],
+        "failed": total["failed"],
+        "goodput_tokens_s": total["goodput_tokens_s"],
+        "goodput_analyses_per_min": total["goodput_analyses_per_min"],
+        "p50_s": (interactive.get("p50_s")
+                  if interactive.get("p50_s") is not None else total["p50_s"]),
+        "p99_s": (interactive.get("p99_s")
+                  if interactive.get("p99_s") is not None else total["p99_s"]),
+        "classes": classes,
+        "fleet": report["fleet"]["fleet"],
+        "seed": seed,
+        "fingerprint": report["fingerprint"],
+        "replay_identical": replay_identical,
+        "ledger_lines": journaled,
+        "ledger_torn_lines": torn,
     }
 
 
@@ -424,7 +481,10 @@ def main() -> None:
     prompts = [build_prompt(r) for r in build_requests(n_requests)]
     sampling = SamplingParams(max_tokens=max_tokens, temperature=0.3, stop_on_eos=False)
 
-    open_enabled = os.environ.get("BENCH_OPEN", "1") == "1" and platform != "cpu-fallback"
+    # the open-loop storm now runs on cpu-fallback too (synthetic
+    # replicas, compressed time scale) — the full-stack SLO record and
+    # the two-replay gate are platform-independent
+    open_enabled = os.environ.get("BENCH_OPEN", "1") == "1"
 
     # warmup: compile the decode step and every prefill bucket the timed run
     # can hit, so no XLA compile lands in the timed region.  Warm with the
@@ -482,7 +542,8 @@ def main() -> None:
             warm_sizes.add(n_requests % slots)
         for size in sorted(warm_sizes):
             warm_wave(generator, prompts[:size])
-        if open_enabled and os.environ.get("BENCH_GRID", "1") == "1":
+        if open_enabled and platform != "cpu-fallback" \
+                and os.environ.get("BENCH_GRID", "1") == "1":
             # open-loop phase: Poisson arrivals form waves of ANY size over
             # any prompt subset, so every (n_pad, bucket) combo — and the
             # per-size host glue — must be warm or it compiles inside a
@@ -525,7 +586,16 @@ def main() -> None:
 
     compile_watch = CompileWatcher()
     compile_watch.mark()
-    open_seconds = float(os.environ.get("BENCH_OPEN_SECONDS", "60"))
+    degraded_storm = platform == "cpu-fallback"
+    open_seconds = float(os.environ.get(
+        "BENCH_OPEN_SECONDS", "10" if degraded_storm else "60"
+    ))
+    # compresses BOTH arrivals and synthetic service times for the CPU
+    # smoke; 1.0 (real time) against a live engine
+    open_time_scale = float(os.environ.get(
+        "BENCH_OPEN_TIME_SCALE", "0.2" if degraded_storm else "1.0"
+    ))
+    loadgen_seed = int(os.environ.get("LOADGEN_SEED", "1"))
     rates = [
         float(r) for r in os.environ.get(
             "BENCH_SWEEP", os.environ.get("BENCH_RATE", "100")
@@ -550,14 +620,36 @@ def main() -> None:
 
         open_results: list[dict] = []
         if open_enabled:
+            from operator_tpu.loadgen.storm import (
+                EngineReplica, SyntheticReplica,
+            )
+
             for rate in rates:
-                log(f"open-loop: {rate:.0f} arrivals/min for {open_seconds:.0f}s")
+                log(f"open-loop storm: {rate:.0f} arrivals/min for "
+                    f"{open_seconds:.0f}s (time x{open_time_scale})")
+                if degraded_storm:
+                    storm_replicas = [
+                        SyntheticReplica(f"bench-replica-{i}",
+                                         time_scale=open_time_scale)
+                        for i in range(2)
+                    ]
+                else:
+                    storm_replicas = [
+                        EngineReplica("bench-engine", serving,
+                                      max_tokens=max_tokens),
+                    ]
                 result = await run_open_loop(
-                    serving, prompts, sampling,
-                    rate_per_min=rate, duration_s=open_seconds, seed=1,
+                    storm_replicas,
+                    rate_per_min=rate, duration_s=open_seconds,
+                    seed=loadgen_seed, time_scale=open_time_scale,
+                    drain_s=max(30.0, open_seconds),
                 )
-                log(f"open-loop @{rate:.0f}/min: p50={result['p50_s']}s "
-                    f"p99={result['p99_s']}s completed={result['completed']}")
+                log(f"open-loop @{rate:.0f}/min: "
+                    f"attainment={result['attainment']} "
+                    f"p50={result['p50_s']}s shed={result['shed']} "
+                    f"deadline_exceeded={result['deadline_exceeded']} "
+                    f"goodput={result['goodput_analyses_per_min']:.1f}/min "
+                    f"replay_identical={result['replay_identical']}")
                 open_results.append(result)
         await serving.close()
         return wall, latencies, open_results

@@ -1,0 +1,477 @@
+"""The storm stack: a full in-process operator→router→serving loop the
+open-loop driver can pound.
+
+``build_storm_stack`` assembles the SAME components production wires —
+FakeKubeApi, PatternEngine, AnalysisPipeline (with its SLO ledger), a
+ProviderRegistry whose ``storm`` backend dispatches through a real
+:class:`~..router.core.EngineRouter` over in-process replicas — so a
+storm exercises admission, affinity routing, load-feedback shedding,
+failover, deadline clamping, and the ledger's journaling together, not a
+mocked subset.  Replicas come in two flavours:
+
+- :class:`SyntheticReplica` — deterministic engine-less service times
+  with a bounded concurrency gate, so the CPU-only CI smoke shows REAL
+  queueing collapse under overload without JAX;
+- :class:`EngineReplica` — wraps a live ``ServingEngine`` (bench.py's
+  open-loop sweep), mapping SLO class to admission priority and the
+  residual budget to a ``SamplingParams.deadline``.
+
+Every storm submit is one ``pipeline.process_pod_failure`` call on a pod
+carrying a ``podmortem.io/slo-class`` annotation; the ledger admits at
+trace birth and settles in the pipeline's finally, so shed / deadline /
+failure outcomes are accounted exactly once per arrival.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from ..obs import SLOLedger, Tracer, annotate_root, parse_slo_classes
+from ..obs.sloledger import SLO_OUTCOME_ATTR
+from ..operator.kubeapi import FakeKubeApi
+from ..operator.pipeline import AnalysisPipeline
+from ..operator.providers import default_registry
+from ..patterns.engine import PatternEngine
+from ..router import EngineRouter, Replica, RouterError, request_key
+from ..router.health import ReplicaLoad
+from ..schema.analysis import AIResponse, AnalysisRequest
+from ..schema.crds import (
+    AIProvider,
+    AIProviderRef,
+    AIProviderSpec,
+    Podmortem,
+    PodmortemSpec,
+)
+from ..schema.kube import (
+    ContainerState,
+    ContainerStateTerminated,
+    ContainerStatus,
+    Pod,
+    PodStatus,
+)
+from ..schema.meta import ObjectMeta
+from ..utils.config import OperatorConfig
+from ..utils.deadline import Deadline
+from ..utils.timing import MetricsRegistry
+
+from .arrivals import ArrivalEvent, ArrivalProcess
+from .driver import run_open_loop
+
+__all__ = [
+    "EngineReplica",
+    "InProcessServingBackend",
+    "StormStack",
+    "SyntheticReplica",
+    "build_storm_stack",
+    "run_storm",
+]
+
+#: pod annotation the pipeline reads the SLO class from
+SLO_CLASS_ANNOTATION = "podmortem.io/slo-class"
+
+#: SLO class -> scheduler admission priority (EDF orders within a class)
+CLASS_PRIORITY = {"interactive": 10, "standard": 5, "batch": 0}
+
+#: recall-hot arrivals repeat these EXACT log bodies, so incident-memory
+#: fingerprints collide (recall hits) and router affinity keeps them on
+#: the replica whose cache is warm
+HOT_LOGS = {
+    "short": "java.lang.OutOfMemoryError: Java heap space\n"
+             "    at com.example.Worker.run(Worker.java:42)\n",
+    "long": "java.lang.OutOfMemoryError: Java heap space\n"
+            "    at com.example.Batch.process(Batch.java:7)\n"
+            + "INFO retrying shard merge\n" * 40,
+}
+
+
+def storm_log(event: ArrivalEvent) -> str:
+    """Deterministic log body for one arrival.  Hot events repeat a fixed
+    body (fingerprint hit); cold events embed a per-index token so every
+    cold failure is a fresh incident class."""
+    if event.recall_hot:
+        return HOT_LOGS[event.kind]
+    # the tag must SURVIVE fingerprint normalization (memory/fingerprint.py
+    # folds hex runs to <hex>), so cold events stay distinct incident
+    # classes: map the digest onto letters outside [0-9a-f]
+    digest = hashlib.sha256(f"cold-{event.index}".encode()).hexdigest()
+    tag = "".join(chr(ord("g") + int(c, 16) % 18) for c in digest[:10])
+    body = (
+        f"java.lang.OutOfMemoryError: Java heap space in stage-{tag}\n"
+        f"    at com.example.Cold{tag}.run(Cold.java:{13 + event.index % 80})\n"
+    )
+    if event.kind == "long":
+        body += f"INFO shard {tag} spilling to disk\n" * 40
+    return body
+
+
+def storm_pod(event: ArrivalEvent, *, namespace: str = "storm") -> Pod:
+    """A failed pod shaped like the watcher tests' ``failed_pod``, with
+    the SLO class riding the annotation the pipeline admits under."""
+    return Pod(
+        metadata=ObjectMeta(
+            name=f"storm-{event.index}",
+            namespace=namespace,
+            labels={"app": "storm"},
+            annotations={SLO_CLASS_ANNOTATION: event.slo_class},
+        ),
+        status=PodStatus(
+            phase="Running",
+            container_statuses=[ContainerStatus(
+                name="app",
+                restart_count=1,
+                state=ContainerState(terminated=ContainerStateTerminated(
+                    exit_code=137, reason="OOMKilled",
+                    finished_at="2026-08-05T00:00:00Z",
+                )),
+            )],
+        ),
+    )
+
+
+# --------------------------------------------------------------------------
+# replicas
+# --------------------------------------------------------------------------
+
+
+class SyntheticReplica:
+    """An engine-less replica with a REAL concurrency bottleneck.
+
+    Service time is a deterministic function of the request (log volume),
+    but at most ``concurrency`` requests are in service at once — excess
+    arrivals wait on the gate, so an open-loop storm past capacity shows
+    genuine queueing growth (and SLO misses) on a CPU-only box in
+    milliseconds, not minutes.  ``time_scale`` compresses service times
+    by the same factor the driver compresses arrivals."""
+
+    def __init__(
+        self,
+        replica_id: str,
+        *,
+        concurrency: int = 4,
+        base_ms: float = 5.0,
+        per_kb_ms: float = 4.0,
+        time_scale: float = 1.0,
+    ) -> None:
+        self.id = replica_id
+        self.concurrency = max(1, concurrency)
+        self.base_ms = base_ms
+        self.per_kb_ms = per_kb_ms
+        self.time_scale = time_scale
+        self._gate = asyncio.Semaphore(self.concurrency)
+        self.inflight = 0
+        self.waiting = 0
+        self.served = 0
+
+    def load(self) -> ReplicaLoad:
+        return ReplicaLoad(
+            queue_depth=self.waiting,
+            inflight=self.inflight,
+            occupancy=min(1.0, self.inflight / self.concurrency),
+        )
+
+    def service_ms(self, request: AnalysisRequest) -> float:
+        logs = ""
+        if request.failure_data is not None:
+            logs = request.failure_data.logs or ""
+        return self.base_ms + self.per_kb_ms * (len(logs) / 1024.0)
+
+    async def serve(
+        self, request: AnalysisRequest, budget_s: Optional[float]
+    ) -> AIResponse:
+        cost_s = self.service_ms(request) * self.time_scale / 1000.0
+        self.waiting += 1
+        try:
+            async with self._gate:
+                self.waiting -= 1
+                self.inflight += 1
+                try:
+                    await asyncio.sleep(cost_s)
+                finally:
+                    self.inflight -= 1
+        except BaseException:
+            # gate wait cancelled (drain) — waiting was already counted
+            if self.waiting > 0:
+                self.waiting -= 1
+            raise
+        self.served += 1
+        fingerprint = request.fingerprint or "cold"
+        return AIResponse(
+            explanation=(
+                f"Root Cause: synthetic analysis of class {fingerprint[:12]}.\n"
+                "Fix: inspect the storm harness."
+            ),
+            provider_id="storm",
+            model_id="synthetic",
+            completion_tokens=24,
+            deadline_outcome="completed" if budget_s is not None else None,
+        )
+
+
+class EngineReplica:
+    """A live ``ServingEngine`` behind the storm router (bench.py's
+    open-loop sweep uses one per engine).  Imports serving lazily so the
+    loadgen package stays importable on JAX-less boxes."""
+
+    def __init__(self, replica_id: str, engine: Any, *, max_tokens: int = 48) -> None:
+        self.id = replica_id
+        self.engine = engine
+        self.max_tokens = max_tokens
+
+    def load(self) -> ReplicaLoad:
+        return self.engine.load_report()
+
+    async def serve(
+        self, request: AnalysisRequest, budget_s: Optional[float]
+    ) -> AIResponse:
+        from ..serving.types import SamplingParams
+
+        logs = ""
+        slo_class = None
+        if request.failure_data is not None:
+            logs = request.failure_data.logs or ""
+            slo_class = getattr(request.failure_data, "slo_class", None)
+        prompt = f"Explain this pod failure:\n{logs[:2048]}\nRoot cause:"
+        deadline = (
+            self.engine.generator._clock() + budget_s
+            if budget_s is not None
+            else None
+        )
+        params = SamplingParams(
+            max_tokens=self.max_tokens,
+            temperature=0.0,
+            deadline=deadline,
+            slo_class=slo_class,
+        )
+        priority = CLASS_PRIORITY.get(slo_class or "", 5)
+        result = await self.engine.generate(prompt, params, priority=priority)
+        return AIResponse(
+            explanation=result.text,
+            provider_id="storm",
+            model_id="tpu-native",
+            completion_tokens=result.completion_tokens,
+            deadline_outcome=(
+                "deadline-exceeded" if result.finish_reason == "deadline"
+                and not result.completion_tokens else
+                "truncated" if result.finish_reason == "deadline"
+                else "completed" if budget_s is not None else None
+            ),
+        )
+
+
+# --------------------------------------------------------------------------
+# the routed backend
+# --------------------------------------------------------------------------
+
+
+class InProcessServingBackend:
+    """AIProviderBackend dispatching through a real EngineRouter over
+    in-process replicas — the storm's serving plane.
+
+    The dispatch mirrors ``OpenAICompatProvider.generate`` (affinity from
+    fingerprint/prefix, absolute deadline envelope, failover across the
+    set) but ``send`` is a direct coroutine call instead of HTTP, and
+    load feedback comes straight from the replicas' own reports before
+    every route, so shedding reacts to THIS storm's queue depths."""
+
+    def __init__(
+        self,
+        replicas: "list[SyntheticReplica | EngineReplica]",
+        *,
+        metrics: Optional[MetricsRegistry] = None,
+        shed_pressure: int = 8,
+        max_failover: int = 1,
+    ) -> None:
+        if not replicas:
+            raise ValueError("storm backend needs at least one replica")
+        self.replicas = {r.id: r for r in replicas}
+        self.metrics = metrics
+        self.router = EngineRouter(
+            [Replica(id=r.id, url=f"inproc://{r.id}") for r in replicas],
+            shed_pressure=shed_pressure,
+            max_failover=max_failover,
+            metrics=metrics,
+        )
+
+    def _feed_load(self) -> None:
+        for rid, replica in self.replicas.items():
+            try:
+                self.router.report_load(rid, replica.load())
+            except Exception:  # a torn load report must not kill dispatch
+                continue
+
+    async def generate(self, request: AnalysisRequest) -> AIResponse:
+        logs = ""
+        if request.failure_data is not None:
+            logs = request.failure_data.logs or ""
+        prompt_basis = logs[:512] or "empty"
+        budget = (
+            Deadline.start(request.deadline_s)
+            if request.deadline_s is not None
+            else None
+        )
+        self._feed_load()
+
+        async def send(
+            replica: Replica, attempt: int, budget_s: Optional[float]
+        ) -> AIResponse:
+            target = self.replicas[replica.id]
+            return await target.serve(request, budget_s)
+
+        try:
+            outcome = await self.router.dispatch(
+                send,
+                key=EngineRouter.affinity_key(
+                    prefix=prompt_basis, fingerprint=request.fingerprint
+                ),
+                request_id=request_key(prompt_basis),
+                deadline=budget,
+                attempts=1,
+            )
+        except RouterError as exc:
+            deadline_spent = budget is not None and budget.remaining() <= 0.0
+            if not deadline_spent:
+                # load-refused: the ledger settles this arrival as shed,
+                # not failed (the root-span override sloledger reads)
+                annotate_root(SLO_OUTCOME_ATTR, "shed", overwrite=False)
+            return AIResponse(
+                error=f"storm dispatch failed: {exc}",
+                provider_id="storm",
+                deadline_outcome="deadline-exceeded" if deadline_spent else None,
+                replica_id=exc.tried[-1] if exc.tried else None,
+            )
+        response: AIResponse = outcome.response
+        response.replica_id = outcome.replica_id
+        response.requeues = outcome.requeues
+        return response
+
+    def fleet_view(self) -> dict:
+        self._feed_load()
+        return self.router.health.fleet_view()
+
+
+# --------------------------------------------------------------------------
+# stack assembly + the storm loop
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class StormStack:
+    """Everything one storm drives, pre-wired.  ``submit`` is the
+    open-loop driver's callable: one arrival -> one full analysis."""
+
+    api: FakeKubeApi
+    config: OperatorConfig
+    metrics: MetricsRegistry
+    pipeline: AnalysisPipeline
+    ledger: SLOLedger
+    backend: InProcessServingBackend
+    podmortem: Podmortem
+    namespace: str = "storm"
+    deadline_factor: float = 4.0
+    time_scale: float = 1.0
+
+    async def submit(self, event: ArrivalEvent) -> None:
+        pod = storm_pod(event, namespace=self.namespace)
+        self.api.set_pod_log(self.namespace, pod.metadata.name,
+                             storm_log(event))
+        target_s = self.ledger.classes.get(
+            event.slo_class,
+            self.ledger.classes[self.ledger.default_class],
+        )
+        envelope_s = max(0.25, target_s * self.deadline_factor * self.time_scale)
+        await self.pipeline.process_pod_failure(
+            pod, self.podmortem,
+            failure_time=f"storm-t{event.index}",
+            deadline=Deadline.start(envelope_s),
+        )
+
+    def close(self) -> None:
+        self.ledger.close()
+
+
+async def build_storm_stack(
+    *,
+    replicas: "Optional[list[SyntheticReplica | EngineReplica]]" = None,
+    config: Optional[OperatorConfig] = None,
+    metrics: Optional[MetricsRegistry] = None,
+    ledger_path: Optional[str] = None,
+    time_scale: float = 1.0,
+    deadline_factor: float = 4.0,
+    namespace: str = "storm",
+    fault_plan: Any = None,
+) -> StormStack:
+    """Wire the full storm stack.  Defaults give the CI smoke shape: two
+    synthetic replicas, in-memory pattern cache, ledger journaled to
+    ``ledger_path`` when set."""
+    api = FakeKubeApi()
+    if fault_plan is not None:
+        api.fault_plan = fault_plan
+    config = config or OperatorConfig(
+        pattern_cache_directory="/nonexistent",
+        conflict_backoff_base_s=0.001,
+        memory_enabled=True,
+    )
+    metrics = metrics or MetricsRegistry()
+    ledger = SLOLedger(
+        parse_slo_classes(config.slo_classes),
+        path=ledger_path,
+        metrics=metrics,
+    )
+    if replicas is None:
+        replicas = [
+            SyntheticReplica(f"storm-replica-{i}", time_scale=time_scale)
+            for i in range(2)
+        ]
+    backend = InProcessServingBackend(replicas, metrics=metrics)
+    registry = default_registry()
+    registry.register("storm", backend)
+    pipeline = AnalysisPipeline(
+        api, PatternEngine(), config=config, metrics=metrics,
+        providers=registry, tracer=Tracer(recorder=None),
+        slo_ledger=ledger,
+    )
+    provider = AIProvider(
+        metadata=ObjectMeta(name="storm", namespace=namespace),
+        spec=AIProviderSpec(provider_id="storm", model_id="storm"),
+    )
+    await api.create("AIProvider", provider.to_dict())
+    podmortem = Podmortem(
+        metadata=ObjectMeta(name="storm", namespace=namespace),
+        spec=PodmortemSpec(
+            ai_provider_ref=AIProviderRef(name="storm", namespace=namespace),
+        ),
+    )
+    await api.create("Podmortem", podmortem.to_dict())
+    return StormStack(
+        api=api, config=config, metrics=metrics, pipeline=pipeline,
+        ledger=ledger, backend=backend, podmortem=podmortem,
+        namespace=namespace, deadline_factor=deadline_factor,
+        time_scale=time_scale,
+    )
+
+
+async def run_storm(
+    stack: StormStack,
+    process: ArrivalProcess,
+    *,
+    drain_s: float = 30.0,
+) -> dict:
+    """Drive one storm open-loop and fold the ledger's verdict into the
+    driver's offered/achieved accounting — the record bench.py publishes
+    as ``open_loop`` and the CI smoke asserts on."""
+    report = await run_open_loop(
+        stack.submit, process,
+        time_scale=stack.time_scale, drain_s=drain_s,
+    )
+    snapshot = stack.ledger.snapshot()
+    return {
+        "arrival_spec": process.spec.to_dict(),
+        "seed": process.seed,
+        "fingerprint": process.fingerprint(),
+        **report,
+        "slo": snapshot,
+        "fleet": stack.backend.fleet_view(),
+    }

@@ -18,6 +18,13 @@ import os
 from typing import Optional
 
 from .record import FlightRecorder, TraceRecord, render_tree
+from .sloledger import (
+    DEFAULT_SLO_CLASSES,
+    SLOBoard,
+    SLOLedger,
+    SLORecord,
+    parse_slo_classes,
+)
 from .steptrace import StepRecord, StepRing, attribution, render_steps
 from .span import (
     Span,
@@ -31,11 +38,16 @@ from .span import (
     format_traceparent,
     parse_traceparent,
     span,
+    stage_durations,
 )
 
 __all__ = [
+    "DEFAULT_SLO_CLASSES",
     "FlightRecorder",
     "RECORDER",
+    "SLOBoard",
+    "SLOLedger",
+    "SLORecord",
     "Span",
     "StepRecord",
     "StepRing",
@@ -47,6 +59,7 @@ __all__ = [
     "annotate_root",
     "attribution",
     "build_tracer",
+    "parse_slo_classes",
     "render_steps",
     "current_span",
     "current_trace_id",
@@ -55,6 +68,7 @@ __all__ = [
     "parse_traceparent",
     "render_tree",
     "span",
+    "stage_durations",
 ]
 
 def _env_capacity(default: int = 256) -> int:

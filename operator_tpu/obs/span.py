@@ -213,6 +213,23 @@ class Trace:
         )
 
 
+def stage_durations(root: "Span") -> dict:
+    """Finished DIRECT children of ``root`` as ``{name: duration_ms}`` —
+    the stage split the SLO ledger journals per request (one source of
+    truth: the same spans the flight recorder stores).  A repeated stage
+    name keeps its last finish; an out-of-trace root returns ``{}``."""
+    state = root._state
+    if state is None:
+        return {}
+    with state.lock:
+        spans = list(state.finished)
+    return {
+        s.name: round(s.duration_ms, 3)
+        for s in spans
+        if s.parent_id == root.span_id
+    }
+
+
 #: the ambient current span (None outside any trace).  One ContextVar for
 #: the whole process: traces are distinguished by the span's _state, not
 #: by the variable, so concurrent tasks each see their own chain.

@@ -134,6 +134,9 @@ class Operator:
                     (lambda: self._http_backend.fleet_view())
                     if self._http_backend is not None else None
                 ),
+                # per-class queue depth + attainment from the pipeline's
+                # SLO ledger on GET /healthz/ready (obs/sloledger.py)
+                slo=(lambda: self.pipeline.slo_ledger.snapshot()),
                 host=self.config.health_host,
                 port=self.config.health_port,
             )

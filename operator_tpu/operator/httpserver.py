@@ -72,6 +72,7 @@ class HealthServer:
         tracer: Optional[Tracer] = None,
         incidents_token: Optional[str] = None,
         fleet: Optional[Callable[[], dict]] = None,
+        slo: Optional[Callable[[], dict]] = None,
         host: str = "0.0.0.0",
         port: int = 8080,
     ) -> None:
@@ -93,6 +94,11 @@ class HealthServer:
         #: (OpenAICompatProvider.fleet_view) behind GET /fleet (None =
         #: 404: no routed replica sets on this operator)
         self.fleet = fleet
+        #: zero-arg callable returning the SLO ledger's current state
+        #: (per-class pending depth + attainment, obs/sloledger.py) —
+        #: folded into GET /healthz/ready so one probe answers both
+        #: "am I up" and "am I keeping my SLOs" (None = omitted)
+        self.slo = slo
         self.host = host
         self.port = port
         self._server: Optional[asyncio.AbstractServer] = None
@@ -246,10 +252,19 @@ class HealthServer:
             }
         if path in ("/healthz/ready", "/readyz"):
             status = await self.readiness.check()
-            return (200 if status.ready else 503), {
+            payload: dict = {
                 "status": "UP" if status.ready else "DOWN",
                 "reason": status.reason,
             }
+            if self.slo is not None:
+                # per-class admission queue depth + attainment from the
+                # SLO ledger — probes ignore extra keys, operators and
+                # the storm harness read them
+                try:
+                    payload["slo"] = self.slo()
+                except Exception:  # a ledger fault must not fail probes
+                    payload["slo"] = None
+            return (200 if status.ready else 503), payload
         if path == "/metrics":
             # OpenMetrics only on negotiation: exemplars (trace ids on the
             # podmortem_trace_* counters) are illegal in classic text 0.0.4

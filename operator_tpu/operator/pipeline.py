@@ -24,7 +24,8 @@ from ..memory import (
     RecallDecision,
     build_incident_memory,
 )
-from ..obs import Span, Tracer, annotate_root
+from ..obs import SLOLedger, Span, Tracer, annotate_root, parse_slo_classes, stage_durations
+from ..obs.sloledger import SLO_OUTCOME_ATTR
 from ..patterns.engine import PatternEngine
 from ..schema.analysis import (
     AIResponse,
@@ -73,6 +74,7 @@ class AnalysisPipeline:
         memory: Optional[IncidentMemory] = None,
         tracer: Optional[Tracer] = None,
         claims: Optional[ClaimLedger] = None,
+        slo_ledger: Optional[SLOLedger] = None,
     ) -> None:
         self.api = api
         self.engine = engine
@@ -112,6 +114,18 @@ class AnalysisPipeline:
         # deadline budgets + per-provider circuit breakers share one
         # injectable clock so chaos tests replay deterministically
         self._clock = clock or time.monotonic
+        # SLO ledger (obs/sloledger.py, docs/OBSERVABILITY.md "SLO
+        # ledger"): every analysis is admitted under a class + latency
+        # target at trace birth and settled in process_pod_failure's
+        # finally — completed / deadline-exceeded / shed / failed, exactly
+        # once per analysis.  Shares the pipeline clock so chaos replays
+        # produce identical ledgers.
+        self.slo_ledger = slo_ledger if slo_ledger is not None else SLOLedger(
+            parse_slo_classes(self.config.slo_classes),
+            path=self.config.slo_ledger_path or None,
+            metrics=self.metrics,
+            clock=self._clock,
+        )
         self.breakers = BreakerBoard(
             self.config.breaker_failure_threshold,
             self.config.breaker_reset_s,
@@ -336,6 +350,7 @@ class AnalysisPipeline:
         if deadline is None:
             deadline = self._deadline_for(podmortem)
         root: Optional[Span] = None
+        result: Optional[AnalysisResult] = None
         try:
             with self.tracer.trace(
                 "analysis",
@@ -346,6 +361,15 @@ class AnalysisPipeline:
                     "deadline_total_s": round(deadline.total_s, 3),
                 },
             ) as root:
+                # SLO admission at trace birth, keyed by the trace id so
+                # ledger records join span trees on one id; the class
+                # rides the pod's podmortem.io/slo-class annotation
+                self.slo_ledger.admit(
+                    root.trace_id,
+                    cls=(pod.metadata.annotations or {}).get(
+                        "podmortem.io/slo-class"
+                    ),
+                )
                 result = await self._analyze(
                     pod, podmortem, failure_time=failure_time, deadline=deadline,
                     trace_root=root,
@@ -361,6 +385,27 @@ class AnalysisPipeline:
                 reason = root.attributes.get("blackbox")
                 if reason:
                     self._dump_black_box(root, reason, deadline)
+                # settle the SLO record exactly once per analysis, in the
+                # finally so cancelled/raised runs are accounted too.
+                # Outcome precedence: an explicit backend override (the
+                # storm harness stamps "shed" when the router refused the
+                # dispatch) > the black-box deadline verdict > whether a
+                # result was stored at all.
+                outcome = root.attributes.get(SLO_OUTCOME_ATTR)
+                if outcome is None:
+                    if reason == "deadline-exceeded":
+                        outcome = "deadline-exceeded"
+                    elif result is not None:
+                        outcome = "completed"
+                    else:
+                        outcome = "failed"
+                self.slo_ledger.finish(
+                    root.trace_id,
+                    outcome=outcome,
+                    tokens=int(root.attributes.get("ai_tokens") or 0),
+                    replica=root.attributes.get("replica") or None,
+                    stages=stage_durations(root),
+                )
 
     def _dump_black_box(self, root: Span, reason: str, deadline: Deadline) -> None:
         """Dump the completed trace with its failure context: the deadline
@@ -587,6 +632,12 @@ class AnalysisPipeline:
                     if ai_response.error:
                         explain_span.status = "error"
                         explain_span.error = ai_response.error[:300]
+                    # the SLO ledger's goodput + per-replica attribution
+                    # read these off the root at settlement
+                    if ai_response.completion_tokens:
+                        trace_root.set(ai_tokens=ai_response.completion_tokens)
+                    if ai_response.replica_id:
+                        trace_root.set(replica=ai_response.replica_id)
             elif podmortem.spec.ai_analysis_enabled:
                 log.info("podmortem %s has no aiProviderRef; storing pattern-only result",
                          podmortem.qualified_name())

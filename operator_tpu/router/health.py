@@ -48,6 +48,13 @@ def fleet_rollup(replicas: dict) -> dict:
     mfu_w = gap_w = occ_w = 0.0
     mfu_steps = gap_steps = occ_steps = 0
     queue_depth = inflight = 0
+    # SLO attainment is weighted by each replica's settled-request count
+    # (a replica that served 10x the traffic moves the fleet number 10x
+    # as much); goodput is a plain sum — tokens/s add across replicas
+    slo_w = 0.0
+    slo_requests = 0
+    goodput = 0.0
+    goodput_seen = False
     for row in replicas.values():
         queue_depth += int(row.get("queueDepth") or 0)
         inflight += int(row.get("inflight") or 0)
@@ -61,6 +68,13 @@ def fleet_rollup(replicas: dict) -> dict:
         if row.get("occupancy") is not None:
             occ_w += float(row["occupancy"]) * weight
             occ_steps += weight
+        if row.get("sloAttainment") is not None:
+            slo_weight = max(1, int(row.get("sloCompleted") or 0))
+            slo_w += float(row["sloAttainment"]) * slo_weight
+            slo_requests += slo_weight
+        if row.get("goodput") is not None:
+            goodput += float(row["goodput"])
+            goodput_seen = True
     return {
         "replicaCount": len(replicas),
         "readyCount": sum(1 for r in replicas.values() if r.get("ready")),
@@ -69,6 +83,8 @@ def fleet_rollup(replicas: dict) -> dict:
         "decodeMfu": round(mfu_w / mfu_steps, 6) if mfu_steps else None,
         "hostGapFrac": round(gap_w / gap_steps, 6) if gap_steps else None,
         "occupancy": round(occ_w / occ_steps, 6) if occ_steps else None,
+        "sloAttainment": round(slo_w / slo_requests, 6) if slo_requests else None,
+        "goodput": round(goodput, 6) if goodput_seen else None,
     }
 
 
@@ -223,6 +239,15 @@ class ReplicaLoad:
     host_gap_frac: Optional[float] = None
     occupancy: Optional[float] = None
     steps: int = 0
+    #: per-class SLO aggregates (obs/sloledger.py SLOBoard via
+    #: ``ServingEngine.load_report()``): fraction of settled requests
+    #: that attained their SLO, goodput-under-SLO tokens/s, how many
+    #: settled requests back the fraction, and the per-class breakdown.
+    #: None = replica predates the board or has settled nothing.
+    slo_attainment: Optional[float] = None
+    goodput_tokens_s: Optional[float] = None
+    slo_completed: int = 0
+    slo_classes: Optional[dict] = None
 
     def pressure(self) -> int:
         """Scalar queue pressure used for least-loaded comparison."""
@@ -256,6 +281,16 @@ class ReplicaLoad:
                 else None
             ),
             "steps": self.steps,
+            "sloAttainment": (
+                round(self.slo_attainment, 6)
+                if self.slo_attainment is not None else None
+            ),
+            "goodput": (
+                round(self.goodput_tokens_s, 6)
+                if self.goodput_tokens_s is not None else None
+            ),
+            "sloCompleted": self.slo_completed,
+            "sloClasses": self.slo_classes,
         }
 
     @classmethod
@@ -278,6 +313,13 @@ class ReplicaLoad:
             host_gap_frac=_opt("hostGapFrac"),
             occupancy=_opt("occupancy"),
             steps=int(data.get("steps") or 0),
+            slo_attainment=_opt("sloAttainment"),
+            goodput_tokens_s=_opt("goodput"),
+            slo_completed=int(data.get("sloCompleted") or 0),
+            slo_classes=(
+                data.get("sloClasses")
+                if isinstance(data.get("sloClasses"), dict) else None
+            ),
         )
 
 
@@ -424,5 +466,9 @@ class HealthBoard:
                 "hostGapFrac": load.host_gap_frac,
                 "occupancy": load.occupancy,
                 "steps": load.steps,
+                "sloAttainment": load.slo_attainment,
+                "goodput": load.goodput_tokens_s,
+                "sloCompleted": load.slo_completed,
+                "sloClasses": load.slo_classes,
             }
         return {"replicas": replicas, "fleet": fleet_rollup(replicas)}

@@ -1522,6 +1522,14 @@ class ServingEngine:
         # bounded resets per window, so a persistent fault still surfaces
         self._reset_times: list[float] = []
         self._reset_lock = asyncio.Lock()
+        # per-class SLO aggregates (obs/sloledger.py SLOBoard): bounded
+        # O(classes) state carried on load_report()/healthz and rolled up
+        # fleet-wide by the router.  Metric-free — the operator-side
+        # ledger owns the podmortem_slo_* counters, so an in-process
+        # operator+serving pair never double-counts.
+        from ..obs.sloledger import SLOBoard
+
+        self._slo_board = SLOBoard()
 
     def _unwrap(self, item: tuple) -> "_Request":
         """Pop bookkeeping for a queue entry: low-lane slots free on pop.
@@ -1903,6 +1911,10 @@ class ServingEngine:
             host_gap_frac=fractions.get("host_gap"),
             occupancy=summary.get("occupancy_avg"),
             steps=summary.get("steps") or 0,
+            slo_attainment=self._slo_board.attainment(),
+            goodput_tokens_s=self._slo_board.goodput_tokens_s(),
+            slo_completed=self._slo_board.completed,
+            slo_classes=self._slo_board.per_class(),
         )
 
     async def start(self) -> None:
@@ -2140,53 +2152,75 @@ class ServingEngine:
         # — the result's prefill/decode times are chip-side, the rest of
         # the wall time was spent waiting for a slot/pages/the low lane
         submitted = time.perf_counter()
-        with obs_span("engine.generate", priority=priority) as span_:
-            if priority <= 0:
-                await self._low_lane.acquire()  # released when the entry is popped
-            await self._queue.put((
-                -priority, next(self._seq),
-                _Request(
-                    prompt, params or SamplingParams(), future, priority,
-                    submitted=submitted,
-                ),
-            ))
-            # the put may have landed after close()/loop-death drained the
-            # queue; _closed/_error were set before the drain, so re-checking
-            # here closes that window.  A supervised engine's queue SURVIVES
-            # a loop death (the supervisor requeues, new arrivals wait), so
-            # only _gave_up is terminal there.
-            dead = self._closed or self._gave_up or (
-                self._error is not None and self._supervisor is None
-            )
-            if dead and not future.done():
-                self._partial_by_future.pop(future, None)
-                future.set_exception(RuntimeError("serving engine is closed"))
-            result = await future
-            # span timings are COPIED from the result, whose decode/queue
-            # numbers are derived from the step clock + measured admission
-            # wait — the span and the step records share one source of
-            # truth and cannot disagree (the old wall-minus-compute
-            # inference could).  The same values feed the latency
-            # histograms (docs/METRICS.md "Histograms").
-            metrics = self.generator.metrics
-            metrics.observe("queue_wait_milliseconds", result.queue_wait_ms)
-            metrics.observe(
-                "ttft_milliseconds", result.queue_wait_ms + result.prefill_ms
-            )
-            if result.completion_tokens > 0:
-                metrics.observe(
-                    "token_latency_milliseconds",
-                    result.decode_ms / result.completion_tokens,
+        # per-class SLO accounting (obs/sloledger.py SLOBoard): every
+        # submit is counted, and the finally guarantees exactly one
+        # settle per submit — a cancelled/errored request is a miss, so
+        # /healthz attainment can never read better than reality
+        slo_cls = (params.slo_class if params is not None else None) or "default"
+        self._slo_board.submitted(slo_cls)
+        slo_settled = False
+        try:
+            with obs_span("engine.generate", priority=priority) as span_:
+                if priority <= 0:
+                    await self._low_lane.acquire()  # released when the entry is popped
+                await self._queue.put((
+                    -priority, next(self._seq),
+                    _Request(
+                        prompt, params or SamplingParams(), future, priority,
+                        submitted=submitted,
+                    ),
+                ))
+                # the put may have landed after close()/loop-death drained the
+                # queue; _closed/_error were set before the drain, so re-checking
+                # here closes that window.  A supervised engine's queue SURVIVES
+                # a loop death (the supervisor requeues, new arrivals wait), so
+                # only _gave_up is terminal there.
+                dead = self._closed or self._gave_up or (
+                    self._error is not None and self._supervisor is None
                 )
-            span_.set(
-                queue_wait_ms=round(result.queue_wait_ms, 3),
-                prefill_ms=round(result.prefill_ms, 3),
-                decode_ms=round(result.decode_ms, 3),
-                prompt_tokens=result.prompt_tokens,
-                completion_tokens=result.completion_tokens,
-                finish_reason=result.finish_reason,
-            )
-            return result
+                if dead and not future.done():
+                    self._partial_by_future.pop(future, None)
+                    future.set_exception(RuntimeError("serving engine is closed"))
+                result = await future
+                # span timings are COPIED from the result, whose decode/queue
+                # numbers are derived from the step clock + measured admission
+                # wait — the span and the step records share one source of
+                # truth and cannot disagree (the old wall-minus-compute
+                # inference could).  The same values feed the latency
+                # histograms (docs/METRICS.md "Histograms").
+                metrics = self.generator.metrics
+                metrics.observe("queue_wait_milliseconds", result.queue_wait_ms)
+                metrics.observe(
+                    "ttft_milliseconds", result.queue_wait_ms + result.prefill_ms
+                )
+                if result.completion_tokens > 0:
+                    metrics.observe(
+                        "token_latency_milliseconds",
+                        result.decode_ms / result.completion_tokens,
+                    )
+                # attained = finished with output inside its own deadline;
+                # deadline-free requests attain by completing at all
+                attained = result.finish_reason != "deadline" and (
+                    params is None or params.deadline is None
+                    or self.generator._clock() <= params.deadline
+                )
+                self._slo_board.finished(
+                    slo_cls, attained=attained,
+                    tokens=result.completion_tokens,
+                )
+                slo_settled = True
+                span_.set(
+                    queue_wait_ms=round(result.queue_wait_ms, 3),
+                    prefill_ms=round(result.prefill_ms, 3),
+                    decode_ms=round(result.decode_ms, 3),
+                    prompt_tokens=result.prompt_tokens,
+                    completion_tokens=result.completion_tokens,
+                    finish_reason=result.finish_reason,
+                )
+                return result
+        finally:
+            if not slo_settled:
+                self._slo_board.finished(slo_cls, attained=False, tokens=0)
 
     # ------------------------------------------------------------------
     async def _run(self) -> None:
@@ -2265,6 +2299,7 @@ class ServingEngine:
                             out.append((request, sched.enqueue(
                                 request.prompt, request.params,
                                 submitted=request.submitted or None,
+                                priority=request.priority,
                             ), None))
                         except Exception as exc:  # noqa: BLE001 - per-request verdict
                             out.append((request, None, exc))

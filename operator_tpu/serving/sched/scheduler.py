@@ -101,7 +101,10 @@ class Scheduler:
         #: still generating — the streaming feed (ServingEngine marshals
         #: it onto the event loop).  Called from the decode worker.
         self.partial_hook: Optional[Any] = None
-        self._queue: deque = deque()  # (req_id, tokens, params, submitted)
+        # (req_id, tokens, params, submitted, priority) — admission order
+        # is priority class first, then earliest deadline (EDF) within a
+        # class, then FIFO (_edf_head)
+        self._queue: deque = deque()
         self._rows: dict[int, _Row] = {}  # req_id -> row, insertion order
         self._next_req = itertools.count(1)
         self._kv_shadow = np.zeros((generator.max_slots,), np.int32)
@@ -132,13 +135,17 @@ class Scheduler:
         params: Optional[SamplingParams] = None,
         *,
         submitted: Optional[float] = None,
+        priority: int = 0,
     ) -> int:
         """Tokenise + queue one request; returns its req id.  Raises
         :class:`OversizedRequest` when the request can never fit the KV
         pool, ``ValueError`` for features the mixed program does not
         serve (guided decoding, LoRA).  ``submitted`` carries the
         caller's original perf_counter submit stamp (ServingEngine), so
-        queue wait covers the engine handoff too, not just this queue."""
+        queue wait covers the engine handoff too, not just this queue.
+        ``priority`` orders admission (higher class first); WITHIN a
+        class the queue is earliest-deadline-first, so an urgent late
+        arrival overtakes an earlier request with slack (_edf_head)."""
         g = self.generator
         params = params or SamplingParams()
         if params.guided_choice is not None or params.guided_regex is not None:
@@ -166,6 +173,7 @@ class Scheduler:
         self._queue.append((
             req_id, tokens, params,
             submitted if submitted is not None else time.perf_counter(),
+            priority,
         ))
         return req_id
 
@@ -321,6 +329,26 @@ class Scheduler:
                 live.append(entry)
         self._queue = live
 
+    def _edf_head(self) -> int:
+        """Index of the next request to admit: highest priority class
+        first, earliest deadline within the class (EDF), FIFO among
+        deadline-free peers.  Deadline-free requests sort AFTER any
+        deadline in their class but are never skipped past — admission
+        still stops (does not skip ahead) when the chosen head's pages
+        don't fit, so a starved large request keeps its turn."""
+        best = 0
+        best_key = None
+        for i, entry in enumerate(self._queue):
+            params, priority = entry[2], entry[4]
+            deadline = (
+                params.deadline if params.deadline is not None
+                else float("inf")
+            )
+            key = (-priority, deadline, i)
+            if best_key is None or key < best_key:
+                best, best_key = i, key
+        return best
+
     def _admit_queued(self, outcomes: list[StepOutcome]) -> list[int]:
         """Token-level admission: pull queued requests into free slots
         while pages last.  Runs at the top of EVERY step, so an arrival
@@ -333,7 +361,8 @@ class Scheduler:
             free = g.free_slots()
             if not free:
                 break
-            req_id, tokens, params, submitted = self._queue[0]
+            head = self._edf_head()
+            req_id, tokens, params, submitted, _ = self._queue[head]
             clamped, outcome = g.deadline_policy(params)
             if outcome == "rejected":
                 # expired between the check above and the policy's clock
@@ -348,7 +377,7 @@ class Scheduler:
             need = self._pages_needed(tokens, clamped)
             if need > g.allocator.available:
                 break  # backpressure: decode frees pages, retry next step
-            self._queue.popleft()
+            del self._queue[head]
             grant = g.allocator.allocate(need)
             slot = free[0]
             row = _Row(

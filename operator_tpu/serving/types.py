@@ -44,6 +44,10 @@ class SamplingParams:
     #: so an xplane capture joins the flight recorder's timeline.  None =
     #: untraced (external API caller without a traceparent).
     trace_tag: Optional[str] = None
+    #: SLO class this request is accounted under (obs/sloledger.py): the
+    #: engine's per-class SLOBoard buckets attainment + goodput by it and
+    #: /healthz carries the rollup.  None = the board's "default" bucket.
+    slo_class: Optional[str] = None
 
 
 @dataclass

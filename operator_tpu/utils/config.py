@@ -276,6 +276,14 @@ class OperatorConfig:
     # serving API (off by default: captures cost device attention+disk)
     profile_enabled: bool = False
     profile_dir: str = "/tmp/operator-tpu-profile"
+    # SLO ledger (obs/sloledger.py, docs/OBSERVABILITY.md "SLO ledger"):
+    # class:target-seconds pairs every analysis is admitted under, and an
+    # optional journal path for terminal records ("" / None = in-memory)
+    slo_classes: str = "interactive:2,standard:30,batch:120"
+    slo_ledger_path: Optional[str] = None
+    # open-loop load generation (operator_tpu/loadgen/): the seed every
+    # arrival-schedule draw derives from — same seed, byte-identical storm
+    loadgen_seed: int = 0
 
     @classmethod
     def from_env(cls, env: Optional[dict[str, str]] = None) -> "OperatorConfig":

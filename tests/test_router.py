@@ -548,9 +548,18 @@ async def _run_replica_kill(seed: int) -> dict:
         "trace": plan.trace(),
         "pending": plan.pending(),
         "failures": [
-            # traceId and the wall-clock stamp are freshly minted per run
+            # traceId, the wall-clock stamp, and recurrence.firstSeen (the
+            # incident's now_iso() birth second) are freshly minted per run
             # by design; everything else must replay byte-identically
-            {k: v for k, v in f.items() if k not in ("traceId", "timestamp")}
+            {
+                k: (
+                    {rk: rv for rk, rv in v.items() if rk != "firstSeen"}
+                    if k == "recurrence" and isinstance(v, dict)
+                    else v
+                )
+                for k, v in f.items()
+                if k not in ("traceId", "timestamp")
+            }
             for f in failures
         ],
         "successful_status_writes": len(

@@ -308,6 +308,58 @@ class TestPerTokenRecycling:
             )
 
 
+class TestEDFAdmission:
+    def test_urgent_late_arrival_overtakes_slack_earlier_request(self, params):
+        """Queue order under pressure: a later arrival with a tight
+        deadline (and a higher-priority class) is admitted before an
+        earlier deadline-free request — and the slack request still
+        completes afterwards (no starvation, no skip-ahead drop)."""
+        generator = make_generator(params, max_slots=1)
+        sched = Scheduler(generator, chunk=16, token_budget=32)
+        sampling = SamplingParams(max_tokens=3, temperature=0.0,
+                                  stop_on_eos=False)
+        hog = sched.enqueue("holds the only slot", sampling)
+        sched.step()  # hog occupies the slot; everything below queues
+        slack = sched.enqueue("queued first, no deadline", sampling)
+        tight = sched.enqueue(
+            "queued later, tight deadline",
+            SamplingParams(max_tokens=3, temperature=0.0, stop_on_eos=False,
+                           deadline=generator._clock() + 60.0),
+        )
+        urgent = sched.enqueue("priority class beats deadline", sampling,
+                               priority=10)
+        assert sched.queue_depth == 3
+        order: list[int] = []
+        done = {}
+        for _ in range(300):
+            for outcome in sched.step():
+                order.append(outcome.req_id)
+                done[outcome.req_id] = outcome
+            if len(done) == 4:
+                break
+        # one slot -> completion order IS admission order
+        assert order == [hog, urgent, tight, slack]
+        assert all(o.error is None for o in done.values())
+        assert_no_leaks(generator)
+
+    def test_fifo_among_deadline_free_peers(self, params):
+        """Without deadlines or priorities the EDF head degenerates to
+        FIFO — the plan-determinism contract existing traces rely on."""
+        generator = make_generator(params, max_slots=1)
+        sched = Scheduler(generator, chunk=16, token_budget=32)
+        sampling = SamplingParams(max_tokens=2, temperature=0.0,
+                                  stop_on_eos=False)
+        ids = [sched.enqueue(f"request {i}", sampling) for i in range(3)]
+        order: list[int] = []
+        for _ in range(300):
+            for outcome in sched.step():
+                order.append(outcome.req_id)
+            if len(order) == 3:
+                break
+        assert order == ids
+        assert_no_leaks(generator)
+
+
 class TestDeterminism:
     def test_fixed_arrival_trace_yields_identical_schedule(self, params):
         """Same arrival script, two fresh schedulers: the per-step plan
