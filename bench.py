@@ -214,7 +214,16 @@ def bench_mixed(params, config, tokenizer, *, slots: int, max_seq: int,
     """Run the mixed-traffic scenario under BOTH serving modes on fresh
     engines (fresh metrics registries, shared weights) and report batch
     occupancy + decode-stall alongside latency — the CPU-measurable face
-    of the continuous scheduler's win (no TPU in the loop needed)."""
+    of the continuous scheduler's win (no TPU in the loop needed).
+
+    The continuous side runs a ``sched_pipeline_depth`` sweep (the
+    decode-ahead host-gap story: the host_gap fraction collapses at
+    depth >= 2) plus one speculation run on TEMPLATED greedy prompts
+    (the repetitive-text case prompt-lookup drafting exists for); its
+    ``spec_decode`` block carries acceptance rate, mean accepted
+    tokens/round and the measured host-side draft overhead, and
+    ``decode_tokens_per_host_sync`` is the headline — 1.0 is the old
+    synchronous one-token loop's ceiling."""
     from operator_tpu.serving.engine import (
         BatchedGenerator, SamplingParams, ServingEngine,
     )
@@ -228,8 +237,8 @@ def bench_mixed(params, config, tokenizer, *, slots: int, max_seq: int,
                                    stop_on_eos=False)
     short_sampling = SamplingParams(max_tokens=24, temperature=0.3,
                                     stop_on_eos=False)
-    out: dict = {}
-    for mode in ("wave", "continuous"):
+
+    def run_engine(*, mode, depth=1, spec=False, greedy=False):
         metrics = MetricsRegistry()
         generator = BatchedGenerator(
             params, config, tokenizer, max_slots=slots, max_seq=max_seq,
@@ -238,35 +247,88 @@ def bench_mixed(params, config, tokenizer, *, slots: int, max_seq: int,
         )
         scheduler = None
         if mode == "continuous":
-            scheduler = Scheduler(generator, chunk=64)
+            scheduler = Scheduler(
+                generator, chunk=64, pipeline_depth=depth,
+                spec_decode=spec, spec_lookup_k=4,
+            )
         engine = ServingEngine(
             generator, admission_wait_s=0.002, scheduler=scheduler
         )
+        # speculation only drafts for greedy rows (byte-identical
+        # acceptance needs argmax); the sweep keeps the sampled traffic
+        long_s, short_s = long_sampling, short_sampling
+        if greedy:
+            long_s = SamplingParams(max_tokens=8, temperature=0.0,
+                                    stop_on_eos=False)
+            short_s = SamplingParams(max_tokens=24, temperature=0.0,
+                                     stop_on_eos=False)
         result = asyncio.run(run_mixed_scenario(
-            engine, long_prompts, short_prompts, long_sampling, short_sampling
+            engine, long_prompts, short_prompts, long_s, short_s
         ))
-        if mode == "continuous":
-            stats = scheduler.stats()
-            result["batch_occupancy_avg"] = stats["batch_occupancy_avg"]
-            result["decode_stall_steps"] = stats["decode_stall_steps"]
-            result["decode_stall_ms_total"] = 0.0
-            result["admitted_midwave"] = stats["admitted_midwave"]
-            result["chunked_prefills"] = stats["chunked_prefills"]
-        else:
-            occupancy = metrics.stage("batch_occupancy")
-            stall = metrics.stage("decode_stall")
-            result["batch_occupancy_avg"] = (
-                round(occupancy.mean_ms / 100.0, 4) if occupancy.count else None
-            )
-            result["decode_stall_steps"] = stall.count
-            result["decode_stall_ms_total"] = round(
-                stall.mean_ms * stall.count, 1
-            )
-        out[mode] = result
-        log(f"mixed[{mode}]: occupancy={result['batch_occupancy_avg']} "
-            f"stall_steps={result['decode_stall_steps']} "
-            f"stall_ms={result['decode_stall_ms_total']} "
+        return result, generator, scheduler
+
+    out: dict = {}
+    result, generator, _ = run_engine(mode="wave")
+    occupancy = generator.metrics.stage("batch_occupancy")
+    stall = generator.metrics.stage("decode_stall")
+    result["batch_occupancy_avg"] = (
+        round(occupancy.mean_ms / 100.0, 4) if occupancy.count else None
+    )
+    result["decode_stall_steps"] = stall.count
+    result["decode_stall_ms_total"] = round(stall.mean_ms * stall.count, 1)
+    out["wave"] = result
+    log(f"mixed[wave]: occupancy={result['batch_occupancy_avg']} "
+        f"stall_steps={result['decode_stall_steps']} "
+        f"stall_ms={result['decode_stall_ms_total']} "
+        f"p50={result['p50_s']}s wall={result['wall_s']}s")
+
+    # decode-ahead sweep: spec off so the depth axis is isolated; the
+    # host_gap fraction (step-clock attribution) is the acceptance
+    # number — it collapses once a wave is always queued behind the
+    # in-flight one
+    out["sched_pipeline_depth_sweep"] = {}
+    for depth in (1, 2, 4):
+        result, generator, scheduler = run_engine(
+            mode="continuous", depth=depth
+        )
+        stats = scheduler.stats()
+        summary = generator.step_clock.summary()
+        fractions = summary.get("fractions") or {}
+        result["batch_occupancy_avg"] = stats["batch_occupancy_avg"]
+        result["decode_stall_steps"] = stats["decode_stall_steps"]
+        result["decode_stall_ms_total"] = 0.0
+        result["admitted_midwave"] = stats["admitted_midwave"]
+        result["chunked_prefills"] = stats["chunked_prefills"]
+        result["host_gap_fraction"] = fractions.get("host_gap")
+        result["decode_tokens_per_host_sync"] = (
+            stats["decode_tokens_per_host_sync"]
+        )
+        result["dispatch_ahead_steps"] = stats["dispatch_ahead"]
+        out["sched_pipeline_depth_sweep"][str(depth)] = result
+        if depth == 2:
+            out["continuous"] = result  # the shipping default depth
+        log(f"mixed[continuous,depth={depth}]: "
+            f"occupancy={result['batch_occupancy_avg']} "
+            f"host_gap_frac={result['host_gap_fraction']} "
+            f"tok/sync={result['decode_tokens_per_host_sync']} "
             f"p50={result['p50_s']}s wall={result['wall_s']}s")
+
+    # prompt-lookup speculation on templated greedy traffic (depth 2 =
+    # the serving default, so rest rounds + verify rounds both appear)
+    result, generator, scheduler = run_engine(
+        mode="continuous", depth=2, spec=True, greedy=True,
+    )
+    stats = scheduler.stats()
+    spec_stats = dict(stats["spec_decode"])
+    spec_stats["decode_tokens_per_host_sync"] = (
+        stats["decode_tokens_per_host_sync"]
+    )
+    spec_stats["wall_s"] = result["wall_s"]
+    out["spec_decode"] = spec_stats
+    log(f"mixed[spec_decode]: acceptance={spec_stats['acceptance_rate']} "
+        f"mean_accepted/round={spec_stats['mean_accepted_per_round']} "
+        f"draft_overhead_ms={spec_stats['draft_overhead_ms']} "
+        f"tok/sync={spec_stats['decode_tokens_per_host_sync']}")
     return out
 
 

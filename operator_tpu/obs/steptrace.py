@@ -59,6 +59,11 @@ class StepRecord:
     #: per-step achieved MFU when the ring's owner knows the model's
     #: flops/token (serving/perf.py StepClock); None on bare rings
     mfu: Optional[float] = None
+    #: generated tokens actually COMMITTED this step — differs from
+    #: ``tokens`` under speculation (a verify row is billed q_count
+    #: tokens of compute but lands accept+1) and under pipelining
+    #: (voided work lands zero); None on engines that don't distinguish
+    accepted: Optional[int] = None
 
     @property
     def total_ms(self) -> float:
@@ -77,6 +82,8 @@ class StepRecord:
         }
         if self.mfu is not None:
             out["mfu"] = round(self.mfu, 6)
+        if self.accepted is not None:
+            out["accepted"] = self.accepted
         return out
 
     @classmethod
@@ -91,6 +98,10 @@ class StepRecord:
             device_ms=float(data.get("device_ms", 0.0)),
             sample_xfer_ms=float(data.get("sample_xfer_ms", 0.0)),
             mfu=(float(data["mfu"]) if data.get("mfu") is not None else None),
+            accepted=(
+                int(data["accepted"])
+                if data.get("accepted") is not None else None
+            ),
         )
 
 
@@ -129,6 +140,7 @@ class StepRing:
         device_ms: float,
         sample_xfer_ms: float,
         mfu: Optional[float] = None,
+        accepted: Optional[int] = None,
     ) -> StepRecord:
         if kind not in STEP_KINDS:
             raise ValueError(f"unknown step kind {kind!r} (one of {STEP_KINDS})")
@@ -143,6 +155,7 @@ class StepRing:
                 device_ms=max(0.0, float(device_ms)),
                 sample_xfer_ms=max(0.0, float(sample_xfer_ms)),
                 mfu=mfu,
+                accepted=(int(accepted) if accepted is not None else None),
             )
             self._seq += 1
             self._records.append(record)
@@ -201,6 +214,12 @@ def attribution(
     decode_records = [r for r in records if r.kind in ("decode", "mixed")]
     decode_ms = sum(r.total_ms for r in decode_records)
     decode_tokens = sum(r.tokens for r in decode_records)
+    # committed generated tokens: billed tokens unless the engine
+    # reported a per-step accepted count (speculation / voided work)
+    accepted_tokens = sum(
+        r.accepted if r.accepted is not None else r.tokens
+        for r in decode_records
+    )
     out = {
         "steps": len(records),
         "prefill_steps": sum(1 for r in records if r.kind == "prefill"),
@@ -210,6 +229,7 @@ def attribution(
         "host_gap_ms": round(host_gap, 3),
         "device_ms": round(device, 3),
         "sample_xfer_ms": round(xfer, 3),
+        "accepted_tokens": accepted_tokens,
         "occupancy_avg": (
             round(sum(r.occupancy for r in records) / len(records), 4)
             if records else None

@@ -28,7 +28,15 @@ Query token ``i`` of row ``b`` sits at absolute position
 ``<= `` its own.  A decode row is the ``q_count == 1`` special case; a
 whole-prompt prefill is ``q_count == kv_len``; a mid-prompt chunk is
 anything in between — one program covers all three, which is what lets
-the scheduler (serving/sched/) dispatch a mixed wave every step.
+the scheduler (serving/sched/) dispatch a mixed wave every step.  A
+speculation VERIFY row (sched/draft.py prompt-lookup drafts) is the same
+geometry again: ``q_count = 1 + k`` query tokens — the committed last
+token plus ``k`` drafts — where draft ``j`` at position
+``kv_len - q_count + 1 + j`` causally attends over the committed context
+AND every earlier draft, which is exactly the attention pattern
+speculative verification needs; no kernel change, the scheduler just
+samples all ``k + 1`` positions and accepts the longest confirmed
+prefix (sched/mixed.py).
 
 The Pallas kernel walks each row's live pages with in-kernel
 double-buffered DMAs steered by the scalar-prefetched page table (the

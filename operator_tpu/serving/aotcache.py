@@ -136,6 +136,8 @@ def generator_fingerprint(
     sample_top_k: Optional[int] = None,
     pipeline_depth: int = 1,
     prefill_chunk: Optional[int] = None,
+    sched_pipeline_depth: int = 1,
+    spec_width: int = 1,
     lora_names: Iterable[str] = (),
 ) -> dict:
     """The fingerprint payload for a ``BatchedGenerator`` shape.
@@ -173,6 +175,12 @@ def generator_fingerprint(
         "sample_top_k": int(sample_top_k) if sample_top_k else None,
         "pipeline_depth": int(pipeline_depth),
         "prefill_chunk": int(prefill_chunk) if prefill_chunk else None,
+        # continuous-scheduler shape knobs: the mixed program's sampled
+        # width (1 + spec_lookup_k) changes the compiled executable, and
+        # depth keys the persisted-executable join even though the trace
+        # is depth-independent (conservative: a depth flip re-warms)
+        "sched_pipeline_depth": int(sched_pipeline_depth),
+        "spec_width": int(spec_width),
         "lora": sorted(str(n) for n in lora_names if n),
         "runtime": runtime_versions(),
     }

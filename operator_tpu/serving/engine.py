@@ -1510,6 +1510,11 @@ class ServingEngine:
         # decode worker; call_soon_threadsafe marshals it onto the loop.
         self._partial_by_future: dict[asyncio.Future, Any] = {}
         self._partial_cbs: dict[int, Any] = {}
+        #: key -> token count already delivered to the stream (loop-side
+        #: monotonicity guard: pipelined commits + cancellation can leave
+        #: stale snapshot deliveries queued behind a restart's fresh
+        #: ones — a snapshot that does not EXTEND the stream is dropped)
+        self._partial_sent: dict[int, int] = {}
         # single-flight dedup for guided-automaton builds (ensure_guided)
         self._guided_builds: dict[tuple, asyncio.Future] = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -1688,6 +1693,7 @@ class ServingEngine:
             requests.append(request)
         self._pending.clear()
         self._partial_cbs.clear()
+        self._partial_sent.clear()
         requests.extend(self._inflight)
         self._inflight.clear()
         while not self._queue.empty():
@@ -1874,7 +1880,29 @@ class ServingEngine:
         callback, future = entry
         if future.done():  # streaming client cancelled; slot drains unheard
             return
-        self._loop.call_soon_threadsafe(callback, token_ids)
+        self._loop.call_soon_threadsafe(
+            self._deliver_partial, slot_id, callback, future, token_ids
+        )
+
+    def _deliver_partial(
+        self, key: int, callback: Any, future: "asyncio.Future",
+        token_ids: list,
+    ) -> None:
+        """Loop-side partial delivery with a per-request order guard.
+
+        The worker's ``future.done()`` check races cancellation, and a
+        supervised restart can interleave a dead registration's queued
+        snapshots with the requeued request's fresh ones (same wave-mode
+        slot key).  Re-checking here — and delivering only snapshots
+        that strictly EXTEND what this key's stream already saw — makes
+        the stream per-request monotonic in token order regardless of
+        how commits and cancellations interleave."""
+        if future.done() or self._partial_cbs.get(key, (None, None))[1] is not future:
+            return
+        if len(token_ids) <= self._partial_sent.get(key, 0):
+            return  # stale snapshot: would rewind the stream
+        self._partial_sent[key] = len(token_ids)
+        callback(token_ids)
 
     def load_report(self):
         """This replica's load, in the shape the data-plane router's shed
@@ -1959,6 +1987,7 @@ class ServingEngine:
     def _fail_outstanding(self, exc: BaseException) -> None:
         """Resolve every in-flight and queued future so callers never hang."""
         self._partial_cbs.clear()
+        self._partial_sent.clear()
         self._partial_by_future.clear()
         for request in self._restarting:  # supervisor interrupted mid-recovery
             if not request.future.done():
@@ -2320,6 +2349,7 @@ class ServingEngine:
                     )
                     if callback is not None:
                         self._partial_cbs[req_id] = (callback, request.future)
+                        self._partial_sent.pop(req_id, None)
             if sched.total_work:
                 # reclaim rows whose callers are gone (disconnects):
                 # per-token recycling frees their slot + pages THIS step
@@ -2335,6 +2365,7 @@ class ServingEngine:
                     for req_id in cancelled:
                         self._pending.pop(req_id, None)
                         self._partial_cbs.pop(req_id, None)
+                        self._partial_sent.pop(req_id, None)
             if sched.total_work:
                 step_call = loop.run_in_executor(self._executor, sched.step)
                 if self._supervisor is not None:
@@ -2355,6 +2386,7 @@ class ServingEngine:
                     outcomes = await step_call
                 for outcome in outcomes:
                     self._partial_cbs.pop(outcome.req_id, None)
+                    self._partial_sent.pop(outcome.req_id, None)
                     request = self._pending.pop(outcome.req_id, None)
                     if request is None or request.future.done():
                         continue
@@ -2430,6 +2462,7 @@ class ServingEngine:
                         if reclaimed:
                             self._pending.pop(slot_id, None)
                             self._partial_cbs.pop(slot_id, None)
+                            self._partial_sent.pop(slot_id, None)
             if self.generator.num_active:
                 step_call = loop.run_in_executor(
                     self._executor, self.generator.step
@@ -2454,6 +2487,7 @@ class ServingEngine:
                     finished = await step_call
                 for slot_id, result in finished:
                     self._partial_cbs.pop(slot_id, None)
+                    self._partial_sent.pop(slot_id, None)
                     request = self._pending.pop(slot_id, None)
                     if request is not None and not request.future.done():
                         result.queue_wait_ms = request.queue_wait_ms
@@ -2520,4 +2554,5 @@ class ServingEngine:
                 # future travels with the callback so the worker-side hook
                 # can drop deltas once the streaming client is gone
                 self._partial_cbs[slot_id] = (callback, request.future)
+                self._partial_sent.pop(slot_id, None)
         return len(slot_ids)

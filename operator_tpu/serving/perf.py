@@ -116,9 +116,13 @@ class StepClock:
         device_ms: float,
         sample_xfer_ms: float,
         commit_t: Optional[float] = None,
+        accepted: Optional[int] = None,
     ) -> StepRecord:
         """Record one step and stamp its commit as the next step's
-        host-gap origin."""
+        host-gap origin.  ``accepted`` is the step's COMMITTED generated
+        token count when it differs from the billed ``tokens``
+        (speculation verify rows, pipelined voided work); MFU stays
+        computed on billed tokens — the compute really ran."""
         total = max(0.0, host_gap_ms) + max(0.0, device_ms) + max(0.0, sample_xfer_ms)
         mfu = None
         if (
@@ -139,6 +143,7 @@ class StepClock:
             device_ms=device_ms,
             sample_xfer_ms=sample_xfer_ms,
             mfu=mfu,
+            accepted=accepted,
         )
         self._last_commit = commit_t if commit_t is not None else time.perf_counter()
         if self.metrics is not None:

@@ -348,6 +348,10 @@ def build_serving_engine(
                 sample_top_k=config.sample_top_k,
                 pipeline_depth=config.pipeline_depth,
                 prefill_chunk=prefill_chunk,
+                sched_pipeline_depth=config.sched_pipeline_depth,
+                spec_width=1 + (
+                    config.spec_lookup_k if config.spec_decode else 0
+                ),
                 lora_names=sorted(lora_adapters) if lora_adapters else (),
             ))
         except Exception:  # noqa: BLE001 - cache is an optimisation only
@@ -422,11 +426,12 @@ def build_serving_engine(
         step_ring_capacity=config.step_ring_capacity,
     )
     # continuous-batching scheduler (serving/sched/, docs/SERVING.md):
-    # opt-in via SCHED_MODE=continuous; falls back to the wave engine
-    # with a loud warning when the engine shape can't serve it (the mixed
-    # program has no mesh/LoRA path yet).  Decided BEFORE prefix priming:
-    # the scheduler prefills every prompt in full, so priming would only
-    # hold KV pages hostage for the process lifetime.
+    # the DEFAULT since the decode-ahead/speculation PR (wave stays as
+    # the explicit SCHED_MODE=wave opt-out); falls back to the wave
+    # engine with a loud warning when the engine shape can't serve it
+    # (the mixed program has no mesh/LoRA path yet).  Decided BEFORE
+    # prefix priming: the scheduler prefills every prompt in full, so
+    # priming would only hold KV pages hostage for the process lifetime.
     scheduler = None
     if config.sched_mode == "continuous":
         if not generator.paged or mesh is not None or lora_adapters:
@@ -443,11 +448,26 @@ def build_serving_engine(
                 generator,
                 chunk=config.sched_chunk,
                 token_budget=config.sched_token_budget,
+                pipeline_depth=config.sched_pipeline_depth,
+                spec_decode=config.spec_decode,
+                spec_lookup_k=config.spec_lookup_k,
             )
     elif config.sched_mode != "wave":
         raise ValueError(
             f"unknown sched_mode {config.sched_mode!r}: expected "
             "'wave' or 'continuous'"
+        )
+    # loud, unambiguous mode line: fleet operators grep for it when a
+    # rollout flips scheduling behaviour
+    if scheduler is not None:
+        log.info(
+            "serving mode: CONTINUOUS scheduler (pipeline_depth=%d "
+            "spec_decode=%s spec_lookup_k=%d); SCHED_MODE=wave opts out",
+            scheduler.depth, scheduler.spec_k > 0, scheduler.spec_k,
+        )
+    else:
+        log.info(
+            "serving mode: WAVE engine (sched_mode=%s)", config.sched_mode
         )
     if config.prefix_cache and generator.paged and scheduler is None:
         # the default template's static preamble is shared by every

@@ -41,40 +41,67 @@ from ...models.quant import mm
 __all__ = ["make_mixed_fn"]
 
 
-def make_mixed_fn(generator: Any, t_budget: int, chunk: int):
+def make_mixed_fn(generator: Any, t_budget: int, chunk: int,
+                  spec_width: int = 1):
     """Compile the mixed-step program for ``generator`` (paged, no mesh).
 
     Signature of the returned jitted function::
 
         fn(params, paged, ids, rows, pos, valid, in_row,
-           q_start, q_count, kv_len, rng, temp, top_p)
-        -> (new_paged, next_tokens [B], rng)
+           q_start, q_count, kv_len, latest, from_prev,
+           sample_start, spec_len, rng, temp, top_p)
+        -> (new_paged, toks [B, W], accept [B], latest_out [B], rng)
 
     Flat inputs (length ``t_budget``): ``ids`` token ids, ``rows`` the
     owning slot per token, ``pos`` absolute positions, ``valid`` live
     mask (padding tokens write to the trash page), ``in_row`` each
-    token's index within its row's chunk.  Per-slot inputs (length
-    ``max_slots``): ``q_start`` the flat offset of the slot's first
-    token, ``q_count`` its token count this step (0 = not scheduled),
-    ``kv_len`` the pages' valid length AFTER this step's writes (rows
-    not scheduled keep their current length).  ``next_tokens[b]``
-    samples the slot's last valid logit — meaningful only for decode
-    rows and prompt-completing prefill rows; the scheduler's commit
-    phase ignores the rest.
+    token's index within its row's chunk, ``from_prev`` tokens whose id
+    is the PREVIOUS dispatch's on-device sample for that slot (decode-
+    ahead chaining: the host dispatched this step before the last step's
+    token ever crossed to it, so the program substitutes its own carried
+    ``latest`` buffer).  Per-slot inputs (length ``max_slots``):
+    ``q_start`` the flat offset of the slot's first token, ``q_count``
+    its token count this step (0 = not scheduled), ``kv_len`` the pages'
+    valid length AFTER this step's writes assuming every draft is
+    accepted (rows not scheduled keep their current length),
+    ``sample_start`` the flat offset of the slot's first SAMPLED
+    position, ``spec_len`` the slot's draft-token count this step
+    (0 = plain row).
+
+    ``W = spec_width`` positions are sampled per slot, starting at
+    ``sample_start``: a plain row samples only its last valid logit
+    (``toks[b, 0]``); a speculation verify row of ``q_count = 1 + k``
+    tokens (committed last token + k prompt-lookup drafts) samples ALL
+    ``k + 1`` of them, and ``accept[b]`` is the length of the longest
+    draft prefix the samples confirm — standard speculative-decoding
+    acceptance, so the commit takes ``accept[b] + 1`` tokens
+    (``toks[b, :accept[b] + 1]``) and greedy output is byte-identical to
+    one-token decoding by construction.  The returned cache's lengths
+    are corrected on device to ``kv_len - (spec_len - accept)``: the
+    rejected drafts' KV writes land but are never readable.
+    ``latest_out[b]`` carries each slot's freshest sampled token for the
+    next dispatch's chaining (passthrough when the slot sat this step
+    out).
     """
     jax, jnp = generator._jax, generator._jnp
     config = generator.config
     b_slots = generator.max_slots
     inv_freq = rope_frequencies(config)
     lax = jax.lax
+    width = max(1, int(spec_width))
 
     def mixed_fn(params, paged, ids, rows, pos, valid, in_row,
-                 q_start, q_count, kv_len, rng, temp, top_p):
+                 q_start, q_count, kv_len, latest, from_prev,
+                 sample_start, spec_len, rng, temp, top_p):
         from ...ops.paged_attention import PagedKVCache
         from ...ops.ragged_attention import ragged_paged_attention
 
         page_size = paged.page_size
-        x = jnp.take(params["embed"], ids, axis=0)[None]  # [1, T, H]
+        # decode-ahead chaining: a token flagged from_prev takes its id
+        # from the carried per-slot latest-sample buffer instead of the
+        # host-packed placeholder — the sampled id never visits the host
+        eff_ids = jnp.where(from_prev, latest[rows], ids)
+        x = jnp.take(params["embed"], eff_ids, axis=0)[None]  # [1, T, H]
         positions = pos[None]  # [1, T]
         # flat -> per-row packing indices for the attention re-pack
         pack_idx = jnp.clip(
@@ -139,24 +166,62 @@ def make_mixed_fn(generator: Any, t_budget: int, chunk: int):
         x, pages_out = lax.scan(layer_step, x, scanned_in)
 
         x = rms_norm(x, params["ln_final"], config.rms_norm_eps)
-        # only each slot's LAST valid token needs a logit row: gather it
-        # before the head matmul so the [vocab] projection runs at [B],
-        # not [T]
-        last_flat = jnp.clip(q_start + jnp.maximum(q_count, 1) - 1,
-                             0, t_budget - 1)
-        x_last = x[0][last_flat]  # [B, H]
+        # only each slot's sampled positions need logit rows: gather them
+        # before the head matmul so the [vocab] projection runs at
+        # [B * W], not [T].  A plain row samples one position (its last
+        # valid token); a verify row samples its committed token AND
+        # every draft, in chunk order
+        samp_idx = jnp.clip(
+            sample_start[:, None] + jnp.arange(width, dtype=jnp.int32)[None],
+            0, t_budget - 1,
+        )  # [B, W]
+        x_samp = x[0][samp_idx]  # [B, W, H]
         head = (
             params["embed"].T if config.tie_embeddings else params["lm_head"]
         )
         logits = jnp.einsum(
-            "bh,hv->bv", x_last, head, preferred_element_type=jnp.float32
+            "bwh,hv->bwv", x_samp, head, preferred_element_type=jnp.float32
         )
-        next_tokens, rng = generator._sample(logits, rng, temp, top_p)
+        flat_toks, rng = generator._sample(
+            logits.reshape(b_slots * width, -1), rng,
+            jnp.repeat(temp, width), jnp.repeat(top_p, width),
+        )
+        toks = flat_toks.reshape(b_slots, width)
+        if width > 1:
+            # longest matching draft prefix: draft j (flat position
+            # sample_start + 1 + j) is confirmed iff the sample AT the
+            # position BEFORE it predicted exactly it, and every earlier
+            # draft was confirmed (cumprod)
+            draft_idx = jnp.clip(
+                sample_start[:, None] + 1
+                + jnp.arange(width - 1, dtype=jnp.int32)[None],
+                0, t_budget - 1,
+            )  # [B, W-1]
+            drafts = eff_ids[draft_idx]
+            confirmed = (toks[:, : width - 1] == drafts) & (
+                jnp.arange(width - 1, dtype=jnp.int32)[None]
+                < spec_len[:, None]
+            )
+            accept = jnp.sum(
+                jnp.cumprod(confirmed.astype(jnp.int32), axis=1), axis=1
+            )
+        else:
+            accept = jnp.zeros((b_slots,), jnp.int32)
+        # rejected drafts wrote KV the row must never read again: shrink
+        # the committed lengths on device (spec_len - accept positions)
+        new_lengths = kv_len - (spec_len - accept)
+        # per-slot freshest sample for the next dispatch's chaining:
+        # toks[b, accept[b]] is the last ACCEPTED token (== toks[b, 0]
+        # for plain rows); slots that sat out keep their carried value
+        fresh = jnp.take_along_axis(
+            toks, jnp.clip(accept, 0, width - 1)[:, None], axis=1
+        )[:, 0]
+        latest_out = jnp.where(q_count > 0, fresh, latest)
         new_paged = PagedKVCache(
             k_pages=pages_out["k"], v_pages=pages_out["v"],
-            page_table=paged.page_table, lengths=kv_len,
+            page_table=paged.page_table, lengths=new_lengths,
         )
-        return new_paged, next_tokens, rng
+        return new_paged, toks, accept, latest_out, rng
 
     assert b_slots <= t_budget, (b_slots, t_budget)
     return jax.jit(mixed_fn, donate_argnums=(1,))

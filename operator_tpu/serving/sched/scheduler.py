@@ -5,33 +5,57 @@ dispatches + fixed decode blocks, serving/engine.py) with an explicit
 per-step loop over ONE ragged mixed-phase program:
 
 - **schedule** (:meth:`Scheduler._schedule`) — form this step's ragged
-  wave: every decode row contributes its one next token, every prefill
-  row contributes its next chunk (Sarathi-style: at most ``chunk``
-  tokens, so a prompt storm stalls in-flight decodes for at most one
-  chunk's compute per step), and queued requests are admitted into the
-  RUNNING wave the moment a slot + pages free up — token-level
-  admission, no block boundary, no admission window;
+  wave: every decode row contributes its next token (or a prompt-lookup
+  speculation verify chunk, below), every prefill row contributes its
+  next chunk (Sarathi-style: at most ``chunk`` tokens, so a prompt
+  storm stalls in-flight decodes for at most one chunk's compute per
+  step), and queued requests are admitted into the RUNNING wave the
+  moment a slot + pages free up — token-level admission, no block
+  boundary, no admission window;
 - **dispatch** (:meth:`Scheduler._dispatch`) — pack the wave onto the
   flat token axis and run the one compiled mixed program
-  (``sched/mixed.py`` + ``ops/ragged_attention.py``);
-- **commit** (:meth:`Scheduler._commit`) — fetch the step's sampled
-  tokens (the ONE host sync), advance rows, and recycle a finished
-  row's slot and KV pages THIS step — not ``decode_block - 1`` junk
-  tokens later — so the next step's admission can reuse them.
+  (``sched/mixed.py`` + ``ops/ragged_attention.py``), WITHOUT waiting
+  for it;
+- **commit** (:meth:`Scheduler._commit_oldest`) — fetch a dispatched
+  step's sampled tokens (the step's ONE host sync), advance rows, and
+  recycle a finished row's slot and KV pages THIS step — not
+  ``decode_block - 1`` junk tokens later — so the next step's admission
+  can reuse them.
 
-The scheduler is synchronous and single-threaded by design (the
-``BatchedGenerator`` discipline: the ServingEngine serialises calls on
-its decode worker); it owns the host-side row state and drives the
-generator's page allocator, slot table and paged cache.  Deadline
-policy, prompt truncation and the chaos seam are the generator's own
-(``AdmissionMixin`` / ``fault_plan``) so wave and continuous modes can
-never diverge on admission semantics.
+**Decode-ahead pipelining** (``pipeline_depth`` > 1, the wave engine's
+in-flight-blocks discipline transplanted): dispatch and commit are
+decoupled through a bounded in-flight queue, so step N+1 is planned
+from PREDICTED row state (``_Row.pred_*``: authoritative + in-flight
+deltas) and dispatched while step N's sampled tokens are still on
+device.  A chained decode row's input id never visits the host — the
+program substitutes its carried per-slot ``latest`` sample buffer
+(``from_prev``) — so only accepted token ids ever cross the host
+boundary, at commit, asynchronously.  The replan path is conservative:
+a commit that invalidates a prediction (finish, cancel) releases the
+row immediately, later in-flight work for it commits as a no-op
+(``podmortem_sched_pipeline_voided_total``), and admission only ever
+consumes authoritatively-freed slots and pages.  Stale KV writes from
+voided work are safe by construction: device execution is serialised by
+the donated paged-cache dependency, so a re-granted page's new owner
+writes every position it will ever read AFTER the voided write lands.
+
+**Prompt-lookup self-speculation** (``spec_decode``, sched/draft.py): a
+greedy decode row with no in-flight work proposes up to
+``spec_lookup_k`` draft tokens from its own prompt+generated context
+and verifies them as ONE ``q_count = k + 1`` row; the commit accepts
+the longest sample-confirmed prefix (``accept + 1`` tokens per host
+round-trip), byte-identical to one-token greedy decoding by
+construction.
 
 Counters (docs/METRICS.md): ``podmortem_sched_admitted_midwave_total``,
 ``podmortem_sched_chunked_prefill_total``,
 ``podmortem_sched_recycled_slot_total``,
 ``podmortem_sched_stall_free_step_total``,
-``podmortem_sched_stall_step_total``.
+``podmortem_sched_stall_step_total``,
+``podmortem_sched_pipeline_dispatch_ahead_total``,
+``podmortem_sched_pipeline_voided_total``,
+``podmortem_spec_rounds_total``, ``podmortem_spec_proposed_total``,
+``podmortem_spec_accepted_total``, ``podmortem_spec_rest_total``.
 """
 
 from __future__ import annotations
@@ -54,11 +78,26 @@ from ..types import (
     pages_needed,
     prompt_budget,
 )
+from .draft import PromptLookupDraft
 from .types import RowWork, StepOutcome, StepPlan, _Row
 
 log = logging.getLogger(__name__)
 
 __all__ = ["Scheduler"]
+
+
+@dataclasses.dataclass
+class _InFlight:
+    """One dispatched-but-uncommitted step: the plan and the device-side
+    result references (token samples + per-slot accepted-draft counts)
+    the commit will fetch."""
+
+    plan: StepPlan
+    toks: Any  # device [B, W] sampled token ids
+    accept: Any  # device [B] accepted-draft counts
+    dispatch_t: float
+    started: float = 0.0
+    held_rows: int = 0
 
 
 class Scheduler:
@@ -75,6 +114,9 @@ class Scheduler:
         *,
         chunk: int = 64,
         token_budget: int = 0,
+        pipeline_depth: int = 1,
+        spec_decode: bool = False,
+        spec_lookup_k: int = 4,
     ) -> None:
         if not getattr(generator, "paged", False):
             raise ValueError("the continuous scheduler requires paged KV")
@@ -96,6 +138,25 @@ class Scheduler:
             raise ValueError(
                 f"sched chunk={self.chunk} > token_budget={self.t_budget}"
             )
+        #: bounded in-flight dispatch queue; 1 = synchronous (each step
+        #: commits the dispatch it just issued, the pre-pipelining loop)
+        self.depth = max(1, int(pipeline_depth))
+        # a verify row is one q_count = 1 + k chunk: it must fit the
+        # attention re-pack ([B, chunk]) and leave budget for peers
+        k = int(spec_lookup_k) if spec_decode else 0
+        self.spec_k = max(0, min(k, self.chunk - 1, self.t_budget - 1))
+        #: sampled positions per slot in the mixed program (static)
+        self.width = 1 + self.spec_k
+        self._draft = PromptLookupDraft() if self.spec_k else None
+        self._draft_ms = 0.0
+        #: dispatched steps whose tokens are still on device, oldest
+        #: first; bounded by ``depth``
+        self._inflight: deque = deque()
+        #: device [B] carry of each slot's freshest sampled token — the
+        #: chaining buffer ``from_prev`` decode rows read in-program
+        self._latest = None
+        self._host_syncs = 0
+        self._decode_committed = 0
         self.metrics = generator.metrics
         #: ``hook(req_id, token_ids_so_far)`` after each step for rows
         #: still generating — the streaming feed (ServingEngine marshals
@@ -118,12 +179,6 @@ class Scheduler:
         #: the determinism test replays a fixed arrival trace and
         #: asserts the schedule is byte-identical
         self.plan_log: Optional[list] = None
-        # step-clock stamps _dispatch leaves for step() to observe
-        # (serving/perf.py): dispatch start, device/xfer split, fetch end
-        self._dispatch_t = 0.0
-        self._device_ms = 0.0
-        self._xfer_ms = 0.0
-        self._fetch_t = 0.0
 
     # ------------------------------------------------------------------
     # submit side
@@ -202,7 +257,10 @@ class Scheduler:
         return len(self._rows) + len(self._queue)
 
     def stats(self) -> dict:
-        """Step-level occupancy/stall stats (bench.py reporting)."""
+        """Step-level occupancy/stall/pipelining stats (bench.py)."""
+        proposed = self.metrics.counter("spec_proposed")
+        accepted = self.metrics.counter("spec_accepted")
+        rounds = self.metrics.counter("spec_rounds")
         return {
             "steps": self.steps,
             "batch_occupancy_avg": round(
@@ -212,30 +270,70 @@ class Scheduler:
             "admitted_midwave": self.metrics.counter("sched_admitted_midwave"),
             "chunked_prefills": self.metrics.counter("sched_chunked_prefill"),
             "recycled_slots": self.metrics.counter("sched_recycled_slot"),
+            # decode-ahead + speculation: the headline is generated
+            # tokens committed per host round-trip — 1.0 is the old
+            # synchronous one-token loop's ceiling
+            "pipeline_depth": self.depth,
+            "dispatch_ahead": self.metrics.counter(
+                "sched_pipeline_dispatch_ahead"
+            ),
+            "voided_work": self.metrics.counter("sched_pipeline_voided"),
+            "host_syncs": self._host_syncs,
+            "decode_tokens_committed": self._decode_committed,
+            "decode_tokens_per_host_sync": round(
+                self._decode_committed / self._host_syncs, 4
+            ) if self._host_syncs else None,
+            "spec_decode": {
+                "enabled": self._draft is not None,
+                "lookup_k": self.spec_k,
+                "rest_rounds": self.metrics.counter("spec_rest"),
+                "verify_rounds": rounds,
+                "drafts_proposed": proposed,
+                "drafts_accepted": accepted,
+                "acceptance_rate": round(accepted / proposed, 4)
+                if proposed else None,
+                "mean_accepted_per_round": round(
+                    accepted / rounds, 4
+                ) if rounds else None,
+                "draft_overhead_ms": round(self._draft_ms, 3),
+            },
         }
 
     def reset(self) -> None:
         """Drop every row and queued request (the supervised-restart /
         recovery path: the generator rebuilds device state separately
-        and the engine has already collected the in-flight futures)."""
+        and the engine has already collected the in-flight futures).
+        In-flight dispatches are abandoned unfetched — their device
+        buffers died with the reset device state."""
         self._queue.clear()
         self._rows.clear()
         self._kv_shadow[:] = 0
         self._staged_tables.clear()
+        self._inflight.clear()
+        self._latest = None
 
     def precompile(self) -> None:
         """Compile the one mixed program before serving (an empty wave
         drives the full trace: the program's shapes are workload-
         independent by construction)."""
-        self._dispatch(StepPlan())
+        entry = self._dispatch(StepPlan())
+        np.asarray(entry.toks)  # block: precompile must finish warm
 
     # ------------------------------------------------------------------
     # the loop
     # ------------------------------------------------------------------
 
     def step(self) -> list[StepOutcome]:
-        """One schedule → dispatch → commit round; returns every request
-        that reached a terminal state (result or admission error)."""
+        """One scheduler round: plan + dispatch the next ragged wave
+        from predicted row state, then commit dispatched steps down to
+        the pipeline bound.  Returns every request that reached a
+        terminal state (result or admission error).
+
+        ``depth == 1`` degenerates to the original synchronous loop —
+        the dispatch just issued commits before the call returns.  At
+        ``depth >= 2`` the dispatch for step N+1 is issued BEFORE step
+        N's commit, so the host gap between commit N-1 and dispatch N+1
+        collapses to ~0: the chip always has a queued wave."""
         g = self.generator
         if g.fault_plan is not None:
             # chaos seam, same site as the wave engine's step so stall /
@@ -246,58 +344,47 @@ class Scheduler:
         held_rows = len(self._rows)  # snapshot BEFORE commit recycles
         if self.plan_log is not None:
             self.plan_log.append(plan.trace())
-        if not plan.work:
+        if plan.work:
+            started = time.perf_counter()
+            with g._annotation(
+                "podmortem.sched_step",
+                [row.params for row in self._rows.values()],
+            ):
+                entry = self._dispatch(plan)
+            entry.started = started
+            entry.held_rows = held_rows
+            if self._inflight:
+                self.metrics.incr("sched_pipeline_dispatch_ahead")
+            self._inflight.append(entry)
+            # step accounting at dispatch: occupancy is HELD slots over
+            # capacity (rows at any phase — the same "slots occupied"
+            # definition the wave engine's batch_occupancy stage uses,
+            # so bench.py compares like with like); a stall step is one
+            # where a decode-ready row got NO token — the schedule never
+            # defers decodes while token_budget >= max_slots, so the
+            # counter is the proof of the property, not a mechanism
+            self.steps += 1
+            occupancy = held_rows / g.max_slots
+            self.occupancy_sum += occupancy
+            self.metrics.record("sched_occupancy", occupancy * 100.0)
+            if plan.deferred_decode:
+                self.stall_steps += 1
+                self.metrics.incr("sched_stall_step")
+            else:
+                self.metrics.incr("sched_stall_free_step")
+        elif not self._inflight:
             return outcomes
-        started = time.perf_counter()
-        with g._annotation(
-            "podmortem.sched_step",
-            [row.params for row in self._rows.values()],
+        # commit down to the pipeline bound (depth - 1 stays in flight
+        # across calls); with nothing to dispatch, drain one entry per
+        # round — progress is guaranteed (a plan can only be empty while
+        # rows/queue exist if their work is already in flight) and the
+        # serve loop stays responsive to cancellation between commits
+        while len(self._inflight) > self.depth - 1 or (
+            self._inflight and not plan.work
         ):
-            toks = self._dispatch(plan)
-        elapsed_ms = (time.perf_counter() - started) * 1e3
-        # step-clock record BEFORE commit: a prompt completing this step
-        # then stamps decode_cum0 with this step already counted, so its
-        # decode window is exactly the steps it decoded in
-        if plan.decode_rows and plan.prefill_rows:
-            kind = "mixed"
-        elif plan.decode_rows:
-            kind = "decode"
-        else:
-            kind = "prefill"
-        g.step_clock.observe(
-            kind=kind,
-            tokens=plan.tokens_planned,
-            slots=held_rows,
-            host_gap_ms=g.step_clock.host_gap_ms(self._dispatch_t),
-            device_ms=self._device_ms,
-            sample_xfer_ms=self._xfer_ms,
-            commit_t=self._fetch_t,
-        )
-        outcomes.extend(self._commit(plan, toks, elapsed_ms))
-        # step accounting: occupancy is HELD slots over capacity (rows at
-        # any phase — the same "slots occupied" definition the wave
-        # engine's batch_occupancy stage uses, so bench.py compares like
-        # with like); a stall step is one where a decode-ready row got
-        # NO token — the schedule never defers decodes while
-        # token_budget >= max_slots, so the counter is the proof of the
-        # property, not a mechanism
-        self.steps += 1
-        occupancy = held_rows / g.max_slots
-        self.occupancy_sum += occupancy
-        self.metrics.record("sched_occupancy", occupancy * 100.0)
-        if plan.decode_rows and not plan.prefill_rows:
-            # wall time per one-token decode round, PURE decode steps
-            # only: the admission roofline reads p50(decode_step) as
-            # seconds-per-token (decode_token_estimate_s), and a mixed
-            # step's wall includes up to `chunk` prefill tokens' compute
-            # — folding that in would inflate the estimate ~chunk-fold
-            # and make deadline clamping over-truncate every admission
-            self.metrics.record("decode_step", elapsed_ms)
-        if plan.deferred_decode:
-            self.stall_steps += 1
-            self.metrics.incr("sched_stall_step")
-        else:
-            self.metrics.incr("sched_stall_free_step")
+            self._commit_oldest(outcomes)
+            if not plan.work:
+                break
         return outcomes
 
     # -- schedule ------------------------------------------------------
@@ -409,33 +496,118 @@ class Scheduler:
         return admitted
 
     def _schedule(self, outcomes: list[StepOutcome]) -> StepPlan:
+        """Plan the next ragged wave from PREDICTED row state (``pred_*``
+        = authoritative + in-flight deltas), so a plan can be built while
+        earlier dispatches are still on device.  The conservative-replan
+        rule is structural: a row with an in-flight verify round
+        (``pend_spec``) is skipped entirely — its true length is
+        unknowable until commit — and commit-side voiding (_commit skips
+        work whose row vanished) covers finish/cancel races."""
+        g = self.generator
         plan = StepPlan()
         plan.admitted = self._admit_queued(outcomes)
         budget = self.t_budget
         cursor = 0
-        # decode rows first — one token each, NEVER deferred (the whole
-        # point: a prefill storm cannot starve an in-flight decode)
-        for req_id, row in self._rows.items():
-            if not row.decoding:
-                continue
+        # decode rows first — one token each (plus drafts), NEVER
+        # deferred (the whole point: a prefill storm cannot starve an
+        # in-flight decode).  A row predicted to have hit max_tokens or
+        # the sequence cap sits out: its in-flight tokens already cover
+        # the request, and commit will finish it.
+        decode_ready = [
+            (req_id, row) for req_id, row in self._rows.items()
+            if not row.pend_spec
+            and row.pred_decoding
+            and row.pred_gen < row.params.max_tokens
+            and row.pred_kv + 1 < g.max_seq
+        ]
+        for i, (req_id, row) in enumerate(decode_ready):
             if cursor >= budget:  # unreachable while budget >= max_slots
                 plan.deferred_decode += 1
                 continue
-            plan.work.append(RowWork(row.slot, req_id, cursor, 1, "decode"))
-            cursor += 1
+            greedy = (
+                self._draft is not None and row.params.temperature <= 0.0
+            )
+            # speculation REST (how speculation composes with depth >= 2
+            # pipelining): a greedy row with a chained token in flight
+            # can never draft — the proposal needs its committed text —
+            # so when a probe of the STALE context finds an n-gram hit,
+            # the row sits this round out; its in-flight commit lands
+            # meanwhile and the NEXT round verifies k drafts in one
+            # dispatch.  Rest is bounded (the in-flight queue drains
+            # within ``depth`` rounds) and taken only on a probe hit, so
+            # draft-miss rows keep the 1-token/step pipelined chain.
+            if (
+                greedy
+                and row.pend_gen > 0
+                and row.pend_pos == 0
+                and row.decoding
+            ):
+                t0 = time.perf_counter()
+                probe = self._draft.propose(
+                    row.tokens + row.generated, self.spec_k
+                )
+                dms = (time.perf_counter() - t0) * 1e3
+                self._draft_ms += dms
+                self.metrics.observe("spec_draft_milliseconds", dms)
+                if probe:
+                    self.metrics.incr("spec_rest")
+                    continue
+            # speculation: greedy rows with NO in-flight work (the draft
+            # needs the committed text, and the verify row needs the
+            # committed last token as its input id) try a prompt-lookup
+            # proposal.  Draft width is capped so the row cannot overrun
+            # max_tokens, the sequence cap, or the peers' reserved
+            # one-token budget slots (rows_after).
+            k_eff = 0
+            drafts: tuple = ()
+            rows_after = len(decode_ready) - i - 1
+            if (
+                greedy
+                and row.pend_gen == 0
+                and row.pend_pos == 0
+                and row.decoding
+                and row.generated
+            ):
+                cap = min(
+                    self.spec_k,
+                    row.params.max_tokens - len(row.generated) - 1,
+                    g.max_seq - 1 - row.kv_len,
+                    budget - cursor - 1 - rows_after,
+                )
+                if cap > 0:
+                    t0 = time.perf_counter()
+                    proposed = self._draft.propose(
+                        row.tokens + row.generated, cap
+                    )
+                    dms = (time.perf_counter() - t0) * 1e3
+                    self._draft_ms += dms
+                    self.metrics.observe("spec_draft_milliseconds", dms)
+                    if proposed:
+                        drafts = tuple(proposed)
+                        k_eff = len(drafts)
+            plan.work.append(RowWork(
+                row.slot, req_id, cursor, 1 + k_eff,
+                "verify" if k_eff else "decode",
+                pos0=row.pred_kv, spec_len=k_eff, drafts=drafts,
+                from_prev=row.pend_gen > 0,
+            ))
+            cursor += 1 + k_eff
             plan.decode_rows += 1
         # prefill chunks fill the remaining budget, FIFO by admission
         for req_id, row in self._rows.items():
-            if row.decoding:
+            if row.pend_spec or row.pred_decoding:
                 continue
             remaining = budget - cursor
-            count = min(self.chunk, row.prompt_len - row.pos, remaining)
+            count = min(self.chunk, row.prompt_len - row.pred_pos, remaining)
             if count <= 0:
                 continue
             kind = (
-                "finish" if row.pos + count >= row.prompt_len else "prefill"
+                "finish" if row.pred_pos + count >= row.prompt_len
+                else "prefill"
             )
-            plan.work.append(RowWork(row.slot, req_id, cursor, count, kind))
+            plan.work.append(RowWork(
+                row.slot, req_id, cursor, count, kind, pos0=row.pred_pos,
+            ))
             cursor += count
             plan.prefill_rows += 1
         plan.tokens_planned = cursor
@@ -448,19 +620,27 @@ class Scheduler:
             from .mixed import make_mixed_fn
 
             log.info(
-                "compiling mixed-step program t_budget=%d chunk=%d slots=%d",
+                "compiling mixed-step program t_budget=%d chunk=%d slots=%d"
+                " width=%d pipeline_depth=%d",
                 self.t_budget, self.chunk, self.generator.max_slots,
+                self.width, self.depth,
             )
             self._fn = self.generator._aot_wrap(
-                f"mixed_t{self.t_budget}_c{self.chunk}",
-                make_mixed_fn(self.generator, self.t_budget, self.chunk),
+                f"mixed_t{self.t_budget}_c{self.chunk}_w{self.width}",
+                make_mixed_fn(
+                    self.generator, self.t_budget, self.chunk,
+                    spec_width=self.width,
+                ),
             )
         return self._fn
 
-    def _dispatch(self, plan: StepPlan) -> np.ndarray:
-        """Pack the plan onto the flat token axis and run the one mixed
-        program; commits the returned cache/rng and returns the sampled
-        tokens ([B] host array — the step's ONE device sync)."""
+    def _dispatch(self, plan: StepPlan) -> _InFlight:
+        """Pack the plan onto the flat token axis and ISSUE the one mixed
+        program; commits the returned cache/rng/latest handles and
+        returns the in-flight entry WITHOUT syncing — the sampled tokens
+        stay on device until ``_commit_oldest`` fetches them (the
+        pipelining point: at depth >= 2 the next plan is dispatched
+        before this fetch happens)."""
         g = self.generator
         jnp = g._jnp
         t, b = self.t_budget, g.max_slots
@@ -469,28 +649,50 @@ class Scheduler:
         pos = np.zeros((t,), np.int32)
         valid = np.zeros((t,), bool)
         in_row = np.zeros((t,), np.int32)
+        from_prev = np.zeros((t,), bool)
         q_start = np.zeros((b,), np.int32)
         q_count = np.zeros((b,), np.int32)
+        sample_start = np.zeros((b,), np.int32)
+        spec_len = np.zeros((b,), np.int32)
         temp = np.zeros((b,), np.float32)
         top_p = np.ones((b,), np.float32)
         kv_len = self._kv_shadow.copy()
         for work in plan.work:
             row = self._rows[work.req_id]
             span = slice(work.start, work.start + work.count)
-            if row.decoding:
-                ids[work.start] = row.generated[-1]
-                pos[work.start] = row.kv_len
-            else:
-                ids[span] = row.tokens[row.pos : row.pos + work.count]
+            if work.kind == "decode":
+                # a chained row's input id is the PREVIOUS dispatch's
+                # on-device sample: pack a placeholder, the program
+                # substitutes its carried latest[slot]
+                ids[work.start] = 0 if work.from_prev else row.generated[-1]
+                pos[work.start] = work.pos0
+                from_prev[work.start] = work.from_prev
+            elif work.kind == "verify":
+                # committed last token + k prompt-lookup drafts, one
+                # contiguous chunk of absolute positions
+                ids[span] = [row.generated[-1], *work.drafts]
                 pos[span] = np.arange(
-                    row.pos, row.pos + work.count, dtype=np.int32
+                    work.pos0, work.pos0 + work.count, dtype=np.int32
+                )
+            else:  # prefill / finish
+                ids[span] = row.tokens[work.pos0 : work.pos0 + work.count]
+                pos[span] = np.arange(
+                    work.pos0, work.pos0 + work.count, dtype=np.int32
                 )
             rows[span] = work.slot
             valid[span] = True
             in_row[span] = np.arange(work.count, dtype=np.int32)
             q_start[work.slot] = work.start
             q_count[work.slot] = work.count
-            kv_len[work.slot] = int(pos[work.start + work.count - 1]) + 1
+            # first sampled position: the last NON-draft token (a verify
+            # row samples it and every draft after it)
+            sample_start[work.slot] = (
+                work.start + work.count - 1 - work.spec_len
+            )
+            spec_len[work.slot] = work.spec_len
+            # optimistic: every draft accepted; the program corrects the
+            # committed lengths on device (kv_len - (spec_len - accept))
+            kv_len[work.slot] = work.pos0 + work.count
             temp[work.slot] = row.params.temperature
             top_p[work.slot] = row.params.top_p
         paged = g.paged_cache
@@ -509,31 +711,43 @@ class Scheduler:
                 lengths=paged.lengths,
             )
             self._staged_tables.clear()
-        self._dispatch_t = time.perf_counter()
-        new_paged, next_tokens, rng = self._get_fn()(
+        if self._latest is None:
+            self._latest = jnp.zeros((b,), jnp.int32)
+        dispatch_t = time.perf_counter()
+        new_paged, toks, accept, latest, rng = self._get_fn()(
             g.params, paged,
             jnp.asarray(ids), jnp.asarray(rows), jnp.asarray(pos),
             jnp.asarray(valid), jnp.asarray(in_row),
             jnp.asarray(q_start), jnp.asarray(q_count), jnp.asarray(kv_len),
+            self._latest, jnp.asarray(from_prev),
+            jnp.asarray(sample_start), jnp.asarray(spec_len),
             g._rng, jnp.asarray(temp), jnp.asarray(top_p),
         )
         g.paged_cache = new_paged
         g._rng = rng
+        self._latest = latest
+        # shadow holds the OPTIMISTIC lengths (all drafts accepted) so
+        # the next plan's packing is consistent with pred_kv; a verify
+        # commit re-anchors the slot from the row's authoritative state
+        # when drafts were rejected
         self._kv_shadow = kv_len
-        # the step's ONE host sync was always here (np.asarray); the
-        # block_until_ready in front only SPLITS it into device compute
-        # vs token-id transfer — no new sync point (GL001: host loop
-        # code, not jit-reachable)
-        try:
-            next_tokens.block_until_ready()
-        except AttributeError:
-            pass  # already a host array (fake-jax tests)
-        t_ready = time.perf_counter()
-        out = np.asarray(next_tokens)
-        self._fetch_t = time.perf_counter()
-        self._device_ms = max(0.0, (t_ready - self._dispatch_t) * 1e3)
-        self._xfer_ms = max(0.0, (self._fetch_t - t_ready) * 1e3)
-        return out
+        # NO block/fetch here: the commit side owns the step's one host
+        # sync (GL001: host loop code, not jit-reachable).  Record the
+        # in-flight deltas planning reads as pred_* until commit.
+        for work in plan.work:
+            row = self._rows[work.req_id]
+            if work.kind == "decode":
+                row.pend_gen += 1
+            elif work.kind == "verify":
+                row.pend_spec = True
+            elif work.kind == "finish":
+                row.pend_pos += work.count
+                row.pend_gen += 1  # the chunk's first sampled token
+            else:  # prefill
+                row.pend_pos += work.count
+        return _InFlight(
+            plan=plan, toks=toks, accept=accept, dispatch_t=dispatch_t,
+        )
 
     # -- commit --------------------------------------------------------
 
@@ -579,22 +793,104 @@ class Scheduler:
         self._release_row(row)
         return result
 
+    def _commit_oldest(self, outcomes: list[StepOutcome]) -> None:
+        """Fetch + commit the oldest in-flight dispatch: the step's ONE
+        host sync.  Step-clock record lands BEFORE the row commits — a
+        prompt completing this step then stamps decode_cum0 with this
+        step already counted, so its decode window is exactly the steps
+        it decoded in."""
+        g = self.generator
+        entry = self._inflight.popleft()
+        plan = entry.plan
+        # the sync was always here (np.asarray); block_until_ready in
+        # front only SPLITS it into device compute vs token-id transfer
+        # — no new sync point (GL001: host loop code, not jit-reachable)
+        try:
+            entry.toks.block_until_ready()
+        except AttributeError:
+            pass  # already a host array (fake-jax tests)
+        t_ready = time.perf_counter()
+        toks = np.asarray(entry.toks)
+        accept = np.asarray(entry.accept)
+        fetch_t = time.perf_counter()
+        self._host_syncs += 1
+        device_ms = max(0.0, (t_ready - entry.dispatch_t) * 1e3)
+        xfer_ms = max(0.0, (fetch_t - t_ready) * 1e3)
+        if plan.decode_rows and plan.prefill_rows:
+            kind = "mixed"
+        elif plan.decode_rows:
+            kind = "decode"
+        else:
+            kind = "prefill"
+        # prospective accepted-token count so MFU attribution stays
+        # honest under speculation: a verify row lands accept+1 tokens,
+        # not the q_count it was billed for (voided rows land zero)
+        accepted = 0
+        for work in plan.work:
+            if work.req_id not in self._rows:
+                continue
+            if work.kind == "verify":
+                accepted += int(accept[work.slot]) + 1
+            elif work.kind in ("decode", "finish"):
+                accepted += 1
+        g.step_clock.observe(
+            kind=kind,
+            tokens=plan.tokens_planned,
+            slots=entry.held_rows,
+            host_gap_ms=g.step_clock.host_gap_ms(entry.dispatch_t),
+            device_ms=device_ms,
+            sample_xfer_ms=xfer_ms,
+            commit_t=fetch_t,
+            accepted=accepted,
+        )
+        elapsed_ms = (fetch_t - entry.started) * 1e3
+        outcomes.extend(self._commit(plan, toks, accept, elapsed_ms))
+        if plan.decode_rows and not plan.prefill_rows:
+            # wall time per pure-decode round only: the admission
+            # roofline reads p50(decode_step) as seconds-per-token
+            # (decode_token_estimate_s), and a mixed step's wall includes
+            # up to `chunk` prefill tokens' compute — folding that in
+            # would make deadline clamping over-truncate every admission
+            self.metrics.record("decode_step", elapsed_ms)
+
+    def _push_token(self, row: _Row, token: int) -> Optional[str]:
+        """Append one committed token; returns the finish reason when
+        the row just reached a terminal state."""
+        g = self.generator
+        eos = g.tokenizer.eos_id
+        row.generated.append(token)
+        if row.params.stop_on_eos and eos is not None and token == eos:
+            return "stop"
+        if len(row.generated) >= row.params.max_tokens:
+            return "length"
+        if row.kv_len + 1 >= g.max_seq:
+            # the NEXT decode token would write past the sequence cap
+            return "length"
+        return None
+
     def _commit(
-        self, plan: StepPlan, toks: np.ndarray, elapsed_ms: float
+        self, plan: StepPlan, toks: np.ndarray, accept: np.ndarray,
+        elapsed_ms: float,
     ) -> list[StepOutcome]:
         outcomes: list[StepOutcome] = []
         g = self.generator
-        eos = g.tokenizer.eos_id
         # the step's compute is attributed to its rows by token share —
         # good enough for the prefill/decode split the spans surface
         share = elapsed_ms / max(1, plan.tokens_planned)
         for work in plan.work:
             row = self._rows.get(work.req_id)
             if row is None:
-                continue  # cancelled between dispatch and commit
-            token = int(toks[work.slot])
-            if not row.decoding:
+                # cancelled/finished between dispatch and commit: the
+                # prediction this work was planned from is void.  Slot
+                # and pages were reclaimed at release; the stale KV
+                # writes land in pages whose next owner overwrites its
+                # own positions before reading them.
+                self.metrics.incr("sched_pipeline_voided")
+                continue
+            finished: Optional[str] = None
+            if work.kind in ("prefill", "finish"):
                 row.pos += work.count
+                row.pend_pos -= work.count
                 row.prefill_ms += share * work.count
                 if not row.decoding:
                     # mid-prompt chunk: more prefill next step
@@ -607,19 +903,31 @@ class Scheduler:
                 # the prefill-sampled token counts toward max_tokens)
                 row.started = time.perf_counter()
                 row.decode_cum0 = g.step_clock.decode_cum_ms
-                row.generated = [token]
+                row.pend_gen -= 1
+                row.generated = []
                 self.metrics.record("prefill", row.prefill_ms)
-            else:
-                row.generated.append(token)
-            finished = None
-            if row.params.stop_on_eos and eos is not None and token == eos:
-                finished = "stop"
-            elif len(row.generated) >= row.params.max_tokens:
-                finished = "length"
-            elif row.kv_len + 1 >= g.max_seq:
-                # the NEXT decode token would write past the sequence
-                # cap; synchronous stepping needs a one-token margin only
-                finished = "length"
+                finished = self._push_token(row, int(toks[work.slot, 0]))
+                self._decode_committed += 1
+            elif work.kind == "decode":
+                row.pend_gen -= 1
+                finished = self._push_token(row, int(toks[work.slot, 0]))
+                self._decode_committed += 1
+            else:  # verify
+                row.pend_spec = False
+                a = int(accept[work.slot])
+                self.metrics.incr("spec_rounds")
+                self.metrics.incr("spec_proposed", work.spec_len)
+                self.metrics.incr("spec_accepted", a)
+                for j in range(a + 1):
+                    finished = self._push_token(row, int(toks[work.slot, j]))
+                    self._decode_committed += 1
+                    if finished is not None:
+                        break
+                if finished is None:
+                    # rejected drafts left the shadow optimistic: re-
+                    # anchor the slot to the row's authoritative length
+                    # so the next dispatch packs true positions
+                    self._kv_shadow[row.slot] = row.kv_len
             if finished is not None:
                 outcomes.append(
                     StepOutcome(work.req_id, result=self._finish(row, finished))

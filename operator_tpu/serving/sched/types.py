@@ -27,6 +27,15 @@ class SchedConfig:
 
     chunk: int = 64
     token_budget: int = 0  # 0 = auto: max(chunk, max_slots)
+    #: bounded in-flight dispatch queue (decode-ahead pipelining): step
+    #: N+1 is planned from predicted row state and dispatched while step
+    #: N's sampled tokens are still on device; 1 = synchronous commit
+    pipeline_depth: int = 1
+    #: prompt-lookup self-speculation (sched/draft.py): greedy rows
+    #: verify up to ``spec_lookup_k`` draft tokens per step as one
+    #: q_count=k+1 row; 0 / spec_decode off = plain one-token decode
+    spec_decode: bool = False
+    spec_lookup_k: int = 4
 
 
 @dataclass
@@ -50,6 +59,20 @@ class _Row:
     #: prompt completed — _finish derives decode_ms as the delta, so the
     #: span timing and the step records share one source of truth
     decode_cum0: float = 0.0
+    # --- decode-ahead pipelining: uncommitted in-flight deltas.  The
+    # authoritative fields above advance only at commit; planning reads
+    # the PREDICTED state (authoritative + pending) so step N+1 can be
+    # dispatched while step N's tokens are still on device. ---
+    #: prompt tokens dispatched but not yet committed (prefill chunks)
+    pend_pos: int = 0
+    #: tokens sampled on device but not yet committed (chained decodes
+    #: + a finishing chunk's first sample); their ids never left the
+    #: device — the next dispatch chains them via ``from_prev``
+    pend_gen: int = 0
+    #: a speculation verify round is in flight: the row must not be
+    #: re-planned until its commit lands (the accepted count — and so
+    #: the row's true length — is unknowable on the host until then)
+    pend_spec: bool = False
 
     @property
     def prompt_len(self) -> int:
@@ -68,18 +91,53 @@ class _Row:
         # earlier one has (prompt + generated[:-1])
         return self.prompt_len + max(0, len(self.generated) - 1)
 
+    # -- predicted state (authoritative + in-flight deltas) ------------
+
+    @property
+    def pred_pos(self) -> int:
+        return self.pos + self.pend_pos
+
+    @property
+    def pred_decoding(self) -> bool:
+        return self.pred_pos >= self.prompt_len
+
+    @property
+    def pred_gen(self) -> int:
+        return len(self.generated) + self.pend_gen
+
+    @property
+    def pred_kv(self) -> int:
+        """Pages' valid length once every in-flight dispatch lands."""
+        if not self.pred_decoding:
+            return self.pred_pos
+        return self.prompt_len + max(0, self.pred_gen - 1)
+
 
 @dataclass
 class RowWork:
     """One row's share of a step: ``count`` tokens starting at flat
-    offset ``start`` (``kind`` is forensics only — the program does not
-    distinguish phases)."""
+    offset ``start``.  ``kind`` distinguishes a speculation verify row
+    ("verify") from plain work; otherwise it is forensics only — the
+    program does not distinguish phases.  Positions are FROZEN at plan
+    time (``pos0``): under pipelining the row's authoritative state may
+    advance between this plan's dispatch and its commit, so the work
+    item must carry everything dispatch packs."""
 
     slot: int
     req_id: int
     start: int  # flat offset of the row's first token this step
     count: int
-    kind: str  # "prefill" | "finish" | "decode"
+    kind: str  # "prefill" | "finish" | "decode" | "verify"
+    #: absolute position of the row's first token this step (prefill:
+    #: the predicted prompt offset; decode/verify: the predicted kv len)
+    pos0: int = 0
+    #: draft tokens riding a verify row (count == 1 + spec_len)
+    spec_len: int = 0
+    drafts: tuple = ()
+    #: the row's input token is the previous dispatch's on-device sample
+    #: (chained decode) — the packed id is a placeholder the program
+    #: replaces with its carried ``latest`` buffer
+    from_prev: bool = False
 
 
 @dataclass
@@ -96,7 +154,9 @@ class StepPlan:
 
     def trace(self) -> tuple:
         return tuple(
-            (w.slot, w.req_id, w.start, w.count, w.kind) for w in self.work
+            (w.slot, w.req_id, w.start, w.count, w.kind, w.pos0,
+             w.spec_len, w.drafts, w.from_prev)
+            for w in self.work
         )
 
 

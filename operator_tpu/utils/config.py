@@ -210,18 +210,30 @@ class OperatorConfig:
     # decodes; 0 = one-shot prefill (power of two when set)
     prefill_chunk: int = 0
     # continuous-batching scheduler (serving/sched/, docs/SERVING.md):
-    # "continuous" replaces the wave machinery with the explicit
+    # "continuous" (the DEFAULT since the decode-ahead/speculation PR)
+    # replaces the wave machinery with the explicit
     # schedule→dispatch→commit loop over ONE ragged mixed prefill+decode
     # program — token-level admission into the running wave, per-token
-    # slot/page recycling.  Requires paged KV, no mesh, no guided/LoRA
-    # traffic.  "wave" (default until the mixed kernel is TPU-validated,
-    # the flash-prefill discipline) keeps the phase-separated engine.
-    sched_mode: str = "wave"  # "wave" | "continuous"
+    # slot/page recycling, decode-ahead pipelining and prompt-lookup
+    # speculation.  Requires paged KV, no mesh, no guided/LoRA traffic
+    # (provider falls back to wave with a loud warning).  "wave" is the
+    # explicit opt-out and still owns guided/LoRA/mesh serving.
+    sched_mode: str = "continuous"  # "continuous" | "wave"
     # max prefill tokens ONE row contributes to a step (Sarathi chunk)
     sched_chunk: int = 64
     # flat token axis of the mixed program (>= max_batch_size so a full
     # decode batch always fits); 0 = max(sched_chunk, max_batch_size)
     sched_token_budget: int = 0
+    # decode-ahead pipelining (sched/scheduler.py): dispatched steps left
+    # in flight while the next wave is planned from predicted row state;
+    # 2 hides the per-step host round-trip, 1 = synchronous commit
+    sched_pipeline_depth: int = 2
+    # prompt-lookup self-speculation (sched/draft.py): greedy rows verify
+    # up to spec_lookup_k draft tokens from their own prompt+generated
+    # context per step — multiple committed tokens per host round-trip,
+    # byte-identical greedy output by construction
+    spec_decode: bool = True
+    spec_lookup_k: int = 4
     # shared-prefix KV caching (engine.set_shared_prefix): the default
     # prompt template's static preamble is prefilled once and admissions
     # forward only their suffix; paged mode only, exact (causal) reuse
