@@ -64,6 +64,11 @@ class StepRecord:
     #: tokens of compute but lands accept+1) and under pipelining
     #: (voided work lands zero); None on engines that don't distinguish
     accepted: Optional[int] = None
+    #: prompt tokens served from the prefix KV cache by rows admitted at
+    #: this step (serving/kvstore.py) — kept off the billed ``tokens``
+    #: so MFU stays honest on compute actually performed; None on
+    #: engines without a prefix cache
+    cached_tokens: Optional[int] = None
 
     @property
     def total_ms(self) -> float:
@@ -84,6 +89,8 @@ class StepRecord:
             out["mfu"] = round(self.mfu, 6)
         if self.accepted is not None:
             out["accepted"] = self.accepted
+        if self.cached_tokens is not None:
+            out["cached_tokens"] = self.cached_tokens
         return out
 
     @classmethod
@@ -101,6 +108,10 @@ class StepRecord:
             accepted=(
                 int(data["accepted"])
                 if data.get("accepted") is not None else None
+            ),
+            cached_tokens=(
+                int(data["cached_tokens"])
+                if data.get("cached_tokens") is not None else None
             ),
         )
 
@@ -141,6 +152,7 @@ class StepRing:
         sample_xfer_ms: float,
         mfu: Optional[float] = None,
         accepted: Optional[int] = None,
+        cached_tokens: Optional[int] = None,
     ) -> StepRecord:
         if kind not in STEP_KINDS:
             raise ValueError(f"unknown step kind {kind!r} (one of {STEP_KINDS})")
@@ -156,6 +168,9 @@ class StepRing:
                 sample_xfer_ms=max(0.0, float(sample_xfer_ms)),
                 mfu=mfu,
                 accepted=(int(accepted) if accepted is not None else None),
+                cached_tokens=(
+                    int(cached_tokens) if cached_tokens is not None else None
+                ),
             )
             self._seq += 1
             self._records.append(record)
@@ -230,6 +245,8 @@ def attribution(
         "device_ms": round(device, 3),
         "sample_xfer_ms": round(xfer, 3),
         "accepted_tokens": accepted_tokens,
+        # prompt tokens the prefix cache spared from prefill compute
+        "cached_tokens": sum(r.cached_tokens or 0 for r in records),
         "occupancy_avg": (
             round(sum(r.occupancy for r in records) / len(records), 4)
             if records else None

@@ -1,0 +1,135 @@
+"""Device<->host KV page transfer for the prefix-cache offload tier.
+
+Evicted prefix blocks (serving/kvstore.py) spill device→host into a
+pinned numpy pool sized by ``KV_HOST_POOL_MB`` and are restored on the
+next hit — restore costs one page DMA + a table write instead of
+re-prefilling the block.  The transfer discipline keeps the decode hot
+path clean:
+
+- ``gather_page`` is an EAGER device-side slice: it enqueues a copy of
+  the page into a fresh buffer without any host sync, so eviction can
+  re-grant the page immediately (device-order serialisation guarantees
+  the gather reads the page before the new owner's writes land, and the
+  gathered buffer is independent of later donation of the main cache).
+- ``fetch_page`` is the ONE deliberate device→host sync, and the
+  scheduler calls it only inside the commit step's existing host sync
+  window (overlapped with the token fetch it already pays for).
+- ``restore_page`` is a jitted donated in-place page write + one
+  host→device transfer of the pooled numpy block.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import OrderedDict
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class HostKVPool:
+    """LRU host-RAM pool of offloaded KV blocks, keyed by block hash.
+
+    Entries are (k, v) numpy arrays of one page each —
+    ``[layers, page_size, kv_heads, head_dim]``.  ``capacity_mb`` bounds
+    the pool; inserting past it drops least-recently-used blocks first.
+    ``capacity_mb=0`` disables the pool (has() is always False), which
+    turns eviction into plain forgetting.
+    """
+
+    def __init__(self, capacity_mb: int = 0) -> None:
+        self.capacity_bytes = int(capacity_mb) * 1024 * 1024
+        self._entries: "OrderedDict[bytes, tuple[np.ndarray, np.ndarray]]" = (
+            OrderedDict()
+        )
+        self.bytes_used = 0
+        self.dropped = 0  # blocks LRU-dropped to make room
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def has(self, h: bytes) -> bool:
+        return h in self._entries
+
+    def get(self, h: bytes) -> Optional[tuple[np.ndarray, np.ndarray]]:
+        entry = self._entries.get(h)
+        if entry is not None:
+            self._entries.move_to_end(h)
+        return entry
+
+    def put(
+        self, h: bytes, k: np.ndarray, v: np.ndarray
+    ) -> Optional[list[bytes]]:
+        """Insert a block.  Returns None when the pool is disabled or the
+        single block exceeds capacity (caller should forget the hash);
+        otherwise the list of LRU-dropped hashes (possibly empty) — the
+        caller forgets those in its index so matches cannot go stale."""
+        size = k.nbytes + v.nbytes
+        if self.capacity_bytes <= 0 or size > self.capacity_bytes:
+            return None
+        if h in self._entries:
+            self._entries.move_to_end(h)
+            return []
+        evicted: list[bytes] = []
+        while self.bytes_used + size > self.capacity_bytes and self._entries:
+            old, (ok, ov) = self._entries.popitem(last=False)
+            self.bytes_used -= ok.nbytes + ov.nbytes
+            self.dropped += 1
+            evicted.append(old)
+        self._entries[h] = (k, v)
+        self.bytes_used += size
+        return evicted
+
+    def drop(self, h: bytes) -> None:
+        entry = self._entries.pop(h, None)
+        if entry is not None:
+            self.bytes_used -= entry[0].nbytes + entry[1].nbytes
+
+
+def gather_page(paged, page: int) -> tuple[jax.Array, jax.Array]:
+    """Eagerly slice one page out of the cache into fresh device buffers.
+
+    No host sync: the copy is enqueued on the device stream, so it is
+    ordered before any later rewrite of the page, and the result buffer
+    is safe from subsequent donation of the main cache arrays.
+    Shapes: ``[layers, page_size, kv_heads, head_dim]`` each.
+    """
+    return paged.k_pages[:, page], paged.v_pages[:, page]
+
+
+def fetch_page(k_dev: jax.Array, v_dev: jax.Array) -> tuple[np.ndarray, np.ndarray]:
+    """Materialise a gathered page on the host — the ONE deliberate sync
+    of the offload path; the scheduler calls it only inside the commit
+    step's existing host-sync window."""
+    # graftlint: disable=GL001 reason=deliberate device->host readback: offload fetch runs inside the commit step's existing host sync window, never in the dispatch hot path
+    k = jax.device_get(k_dev)
+    # graftlint: disable=GL001 reason=same deliberate offload readback as the k fetch above
+    v = jax.device_get(v_dev)
+    return np.asarray(k), np.asarray(v)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _write_page(k_pages, v_pages, page, k, v):
+    return (
+        k_pages.at[:, page].set(k.astype(k_pages.dtype)),
+        v_pages.at[:, page].set(v.astype(v_pages.dtype)),
+    )
+
+
+def restore_page(paged, page: int, k: np.ndarray, v: np.ndarray):
+    """Write a pooled host block back into device page ``page``.
+
+    One host→device transfer per array + a donated in-place page write;
+    returns a new PagedKVCache sharing table/lengths with the input
+    (whose k/v buffers are consumed by donation)."""
+    k_pages, v_pages = _write_page(
+        paged.k_pages, paged.v_pages, jnp.int32(page), k, v
+    )
+    return type(paged)(
+        k_pages=k_pages,
+        v_pages=v_pages,
+        page_table=paged.page_table,
+        lengths=paged.lengths,
+    )

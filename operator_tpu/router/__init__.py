@@ -4,7 +4,10 @@ A health-gated, affinity-aware failover router in front of N
 ``ServingEngine`` replicas: consistent-hash placement on prompt prefix /
 incident fingerprint (``ring.py``), per-replica breakers + passive
 scoring + load reports (``health.py``), and requeue-once failover with
-residual deadlines (``core.py``).
+residual deadlines (``core.py``).  ``resume.py`` adds token-level
+streaming resume: journaled generated-so-far checkpoints that turn a
+mid-stream replica death into one (mostly cached) re-prefill on a
+survivor instead of a full re-decode.
 """
 
 from .core import (
@@ -22,6 +25,7 @@ from .health import (
     ReplicaHealth,
     ReplicaLoad,
 )
+from .resume import ResumeLog
 from .ring import HashRing
 
 __all__ = [
@@ -33,6 +37,7 @@ __all__ = [
     "Replica",
     "ReplicaHealth",
     "ReplicaLoad",
+    "ResumeLog",
     "RouteDecision",
     "RouteOutcome",
     "RouterError",

@@ -188,6 +188,7 @@ class EngineRouter:
         exclude: "frozenset[str] | set[str]" = frozenset(),
         deadline_s: Optional[float] = None,
         tokens: int = 256,
+        kv_hint: Optional["list[str]"] = None,
     ) -> Optional[RouteDecision]:
         """Pick one replica for a request.
 
@@ -201,7 +202,13 @@ class EngineRouter:
         any replica is healthy).  ``exclude`` removes replicas that
         already failed this request; the exclusion is waived when it
         would empty the healthy set (a single-replica set must still be
-        retryable).  Returns None only when NO replica is healthy."""
+        retryable).  ``kv_hint`` (block-hash hexes from the prefix
+        cache's hasher) re-ranks the candidates by how many of those
+        blocks each replica's last KV inventory advertises — a failover
+        lands on the survivor that can re-prefill from cache instead of
+        recomputing; the inventory is advisory, so a zero-holder fleet
+        falls back to plain affinity order.  Returns None only when NO
+        replica is healthy."""
         order = self._ring.preference(key) if key else sorted(self._replicas)
         # PURE filter: can_route never mutates breaker state — consuming
         # a recovering replica's half-open probe token here would let
@@ -212,6 +219,20 @@ class EngineRouter:
         if not healthy:
             return None
         candidates = [rid for rid in healthy if rid not in exclude] or healthy
+        if kv_hint:
+            wanted = set(kv_hint)
+
+            def held(rid: str) -> int:
+                blocks = self.health.for_replica(rid).load.kv_blocks
+                return len(wanted.intersection(blocks)) if blocks else 0
+
+            # stable sort: block holders first (most blocks wins), the
+            # affinity walk order breaks ties — no inventory anywhere
+            # leaves the order untouched
+            candidates = sorted(
+                candidates,
+                key=lambda rid: (-held(rid), candidates.index(rid)),
+            )
         owner = candidates[0]
         chosen = owner
         load = self.health.for_replica(owner).load
@@ -252,6 +273,8 @@ class EngineRouter:
         attempts: int = 1,
         tokens: int = 256,
         backoff_s: float = 0.2,
+        resume_log: Optional[Any] = None,  # router.resume.ResumeLog
+        kv_hint: Optional["list[str]"] = None,
     ) -> RouteOutcome:
         """Run ``send(replica, attempt, budget_s)`` against the routed
         replica, failing over across the set.
@@ -265,6 +288,18 @@ class EngineRouter:
         discipline), then the dispatch fails loudly.  Same-replica
         retries (single-replica sets) are bounded by ``attempts`` with
         exponential backoff and do not count as failovers.
+
+        With ``resume_log`` (router/resume.py) the contract widens:
+        ``send`` is called as ``send(replica, attempt, budget_s,
+        resume_tokens)`` where ``resume_tokens`` is the generated-so-far
+        checkpoint for ``request_id`` (None on the first attempt) — the
+        replica re-prefills ``prompt + resume_tokens`` and decodes only
+        the continuation, so a mid-stream replica death costs one
+        re-prefill (mostly cached) instead of a full re-decode.  ``send``
+        is responsible for checkpointing tokens as they stream; the
+        router completes the log entry once the dispatch settles.
+        ``kv_hint`` is forwarded to :meth:`route` on every attempt so a
+        failover prefers survivors already holding the prompt's blocks.
         """
         tried: list[str] = []  # distinct replicas that failed, in order
         requeues = 0
@@ -278,7 +313,8 @@ class EngineRouter:
                     last_error=last_error, tried=tried,
                 )
             decision = self.route(
-                key, exclude=set(tried), deadline_s=budget, tokens=tokens
+                key, exclude=set(tried), deadline_s=budget, tokens=tokens,
+                kv_hint=kv_hint,
             )
             if decision is None:
                 self.metrics.incr("router_no_replica")
@@ -318,9 +354,14 @@ class EngineRouter:
                         self.fault_plan.apply(
                             "router.dispatch", replica=replica.id, attempt=attempt
                         )
-                    result = await asyncio.wait_for(
-                        send(replica, attempt, budget), timeout=budget
-                    )
+                    if resume_log is not None:
+                        call = send(
+                            replica, attempt, budget,
+                            resume_log.tokens(request_id),
+                        )
+                    else:
+                        call = send(replica, attempt, budget)
+                    result = await asyncio.wait_for(call, timeout=budget)
             except asyncio.CancelledError:
                 raise
             except Exception as exc:  # noqa: BLE001 - failures feed health; only
@@ -344,6 +385,11 @@ class EngineRouter:
                     await asyncio.sleep(min(2 ** attempt * backoff_s, 2.0))
                 continue
             self.health.observe_success(replica.id, self._clock() - started)
+            if resume_log is not None:
+                # settled: drop the checkpoint (tombstones it in the
+                # journal) — a replayed router must not resume a request
+                # the client already received in full
+                resume_log.complete(request_id)
             self.metrics.incr("router_routed")
             if decision.shed:
                 self.metrics.incr("router_shed")

@@ -55,6 +55,12 @@ def fleet_rollup(replicas: dict) -> dict:
     slo_requests = 0
     goodput = 0.0
     goodput_seen = False
+    # KV economy: pages sum across replicas; the fleet hit rate is
+    # weighted by each replica's lookup count (a replica that answered
+    # 10x the block lookups moves the fleet number 10x as much)
+    kv_free = kv_total = 0
+    hit_w = 0.0
+    hit_lookups = 0
     for row in replicas.values():
         queue_depth += int(row.get("queueDepth") or 0)
         inflight += int(row.get("inflight") or 0)
@@ -75,6 +81,12 @@ def fleet_rollup(replicas: dict) -> dict:
         if row.get("goodput") is not None:
             goodput += float(row["goodput"])
             goodput_seen = True
+        kv_free += int(row.get("kvPagesFree") or 0)
+        kv_total += int(row.get("kvPagesTotal") or 0)
+        if row.get("prefixHitRate") is not None:
+            weight = max(1, int(row.get("kvLookups") or 0))
+            hit_w += float(row["prefixHitRate"]) * weight
+            hit_lookups += weight
     return {
         "replicaCount": len(replicas),
         "readyCount": sum(1 for r in replicas.values() if r.get("ready")),
@@ -85,6 +97,11 @@ def fleet_rollup(replicas: dict) -> dict:
         "occupancy": round(occ_w / occ_steps, 6) if occ_steps else None,
         "sloAttainment": round(slo_w / slo_requests, 6) if slo_requests else None,
         "goodput": round(goodput, 6) if goodput_seen else None,
+        "kvPagesFree": kv_free,
+        "kvPagesTotal": kv_total,
+        "prefixHitRate": (
+            round(hit_w / hit_lookups, 6) if hit_lookups else None
+        ),
     }
 
 
@@ -248,6 +265,17 @@ class ReplicaLoad:
     goodput_tokens_s: Optional[float] = None
     slo_completed: int = 0
     slo_classes: Optional[dict] = None
+    #: KV economy (serving/kvstore.py via ``ServingEngine.load_report``):
+    #: free/total device KV pages, the prefix cache's lifetime hit rate
+    #: over ``prefix_lookups`` block lookups (None = caching off or the
+    #: replica predates it), and a bounded MRU inventory of block hashes
+    #: (hex) the replica holds — the peer index a failover consults to
+    #: prefer a survivor that already has the prompt's blocks resident.
+    kv_pages_free: int = 0
+    kv_pages_total: int = 0
+    prefix_hit_rate: Optional[float] = None
+    prefix_lookups: int = 0
+    kv_blocks: Optional[list] = None
 
     def pressure(self) -> int:
         """Scalar queue pressure used for least-loaded comparison."""
@@ -291,6 +319,14 @@ class ReplicaLoad:
             ),
             "sloCompleted": self.slo_completed,
             "sloClasses": self.slo_classes,
+            "kvPagesFree": self.kv_pages_free,
+            "kvPagesTotal": self.kv_pages_total,
+            "prefixHitRate": (
+                round(self.prefix_hit_rate, 6)
+                if self.prefix_hit_rate is not None else None
+            ),
+            "kvLookups": self.prefix_lookups,
+            "kvBlocks": self.kv_blocks,
         }
 
     @classmethod
@@ -319,6 +355,14 @@ class ReplicaLoad:
             slo_classes=(
                 data.get("sloClasses")
                 if isinstance(data.get("sloClasses"), dict) else None
+            ),
+            kv_pages_free=int(data.get("kvPagesFree") or 0),
+            kv_pages_total=int(data.get("kvPagesTotal") or 0),
+            prefix_hit_rate=_opt("prefixHitRate"),
+            prefix_lookups=int(data.get("kvLookups") or 0),
+            kv_blocks=(
+                [str(h) for h in data["kvBlocks"]]
+                if isinstance(data.get("kvBlocks"), list) else None
             ),
         )
 
@@ -470,5 +514,23 @@ class HealthBoard:
                 "goodput": load.goodput_tokens_s,
                 "sloCompleted": load.slo_completed,
                 "sloClasses": load.slo_classes,
+                "kvPagesFree": load.kv_pages_free,
+                "kvPagesTotal": load.kv_pages_total,
+                "prefixHitRate": load.prefix_hit_rate,
+                "kvLookups": load.prefix_lookups,
             }
         return {"replicas": replicas, "fleet": fleet_rollup(replicas)}
+
+    def holders(self, block_hash: str) -> list[str]:
+        """Replica ids whose last load report advertised ``block_hash``
+        (hex) in their KV inventory — the peer index a failover consults
+        to resume onto a survivor that can re-prefill from cache instead
+        of recomputing.  Reports are advisory (bounded MRU snapshot, may
+        be stale): an empty answer means "no known holder", never "no
+        holder"."""
+        found = []
+        for replica_id, health in sorted(self._health.items()):
+            blocks = health.load.kv_blocks
+            if blocks and block_hash in blocks:
+                found.append(replica_id)
+        return found

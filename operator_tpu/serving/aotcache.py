@@ -138,6 +138,7 @@ def generator_fingerprint(
     prefill_chunk: Optional[int] = None,
     sched_pipeline_depth: int = 1,
     spec_width: int = 1,
+    kv_prefix_cache: bool = False,
     lora_names: Iterable[str] = (),
 ) -> dict:
     """The fingerprint payload for a ``BatchedGenerator`` shape.
@@ -181,6 +182,10 @@ def generator_fingerprint(
         # is depth-independent (conservative: a depth flip re-warms)
         "sched_pipeline_depth": int(sched_pipeline_depth),
         "spec_width": int(spec_width),
+        # prefix caching shapes the mixed program's page-table bounds
+        # (cache-owned pages share the row tables): keying on it keeps a
+        # cache-on executable from being replayed into a cache-off boot
+        "kv_prefix_cache": bool(kv_prefix_cache),
         "lora": sorted(str(n) for n in lora_names if n),
         "runtime": runtime_versions(),
     }

@@ -139,6 +139,10 @@ class _Request:
     future: asyncio.Future
     priority: int = 0
     requeues: int = 0
+    #: token-level streaming resume (router/resume.py): generated token
+    #: ids to re-prefill VERBATIM after the prompt on a failover
+    #: survivor; the result then carries only the continuation
+    resume_tokens: Optional[list] = None
     #: perf_counter at submit (ServingEngine.generate) — queue wait is
     #: measured admission-minus-submit, not inferred from wall deltas
     submitted: float = 0.0
@@ -1930,6 +1934,24 @@ class ServingEngine:
         # up across replicas — None until steps have been recorded
         summary = self.generator.step_clock.summary()
         fractions = summary.get("fractions") or {}
+        # KV economy (serving/kvstore.py): page headroom + prefix hit
+        # rate for the router's informed-affinity choice, plus a bounded
+        # block-hash inventory so a failover can prefer a survivor that
+        # already holds the prompt's blocks (the peer index)
+        kv_pages_free = 0
+        kv_pages_total = 0
+        allocator = getattr(self.generator, "allocator", None)
+        if allocator is not None:
+            kv_pages_free = allocator.available
+            kv_pages_total = allocator.num_pages - 1
+        prefix_hit_rate = None
+        prefix_lookups = 0
+        kv_blocks = None
+        kvstore = getattr(self._sched, "_kvstore", None)
+        if kvstore is not None:
+            prefix_hit_rate = kvstore.hit_rate()
+            prefix_lookups = kvstore.lookups
+            kv_blocks = kvstore.inventory()
         return ReplicaLoad(
             queue_depth=queue_depth,
             inflight=inflight,
@@ -1943,6 +1965,11 @@ class ServingEngine:
             goodput_tokens_s=self._slo_board.goodput_tokens_s(),
             slo_completed=self._slo_board.completed,
             slo_classes=self._slo_board.per_class(),
+            kv_pages_free=kv_pages_free,
+            kv_pages_total=kv_pages_total,
+            prefix_hit_rate=prefix_hit_rate,
+            prefix_lookups=prefix_lookups,
+            kv_blocks=kv_blocks,
         )
 
     async def start(self) -> None:
@@ -2088,6 +2115,7 @@ class ServingEngine:
         *,
         on_partial: Optional[Any] = None,
         priority: int = 0,
+        resume_tokens: Optional[list] = None,
     ) -> GenerationResult:
         """Generate; ``on_partial(token_ids_so_far)`` (if given) fires on the
         event loop after each decode block while the request is generating —
@@ -2096,7 +2124,13 @@ class ServingEngine:
         ``priority`` orders ADMISSION only (higher first, FIFO within a
         class): the operator pipeline uses 10 so external API callers on the
         shared engine can never starve incident analysis.  Already-admitted
-        and backpressured-in-hand requests are not preempted."""
+        and backpressured-in-hand requests are not preempted.
+
+        ``resume_tokens`` resumes a failed-over stream mid-token: the
+        already-generated ids are re-prefilled verbatim after the prompt
+        (cheap under the prefix cache) and the result carries ONLY the
+        continuation — the caller owns stitching checkpoint + result.
+        Continuous scheduler mode only."""
         if self._closed:
             raise RuntimeError("serving engine is closed")
         if self._gave_up:
@@ -2153,6 +2187,11 @@ class ServingEngine:
                 "guided decoding and LoRA adapters are not supported in "
                 "continuous scheduler mode (sched_mode=continuous)"
             )
+        if resume_tokens and self._sched is None:
+            raise ValueError(
+                "token-level streaming resume requires the continuous "
+                "scheduler (sched_mode=continuous)"
+            )
         if params is not None and params.deadline is not None:
             # fail-fast at submit: a budget that cannot fit ONE decoded
             # token must not consume a queue slot, a prefill, or KV pages.
@@ -2197,6 +2236,9 @@ class ServingEngine:
                     _Request(
                         prompt, params or SamplingParams(), future, priority,
                         submitted=submitted,
+                        resume_tokens=(
+                            list(resume_tokens) if resume_tokens else None
+                        ),
                     ),
                 ))
                 # the put may have landed after close()/loop-death drained the
@@ -2329,6 +2371,7 @@ class ServingEngine:
                                 request.prompt, request.params,
                                 submitted=request.submitted or None,
                                 priority=request.priority,
+                                resume_tokens=request.resume_tokens,
                             ), None))
                         except Exception as exc:  # noqa: BLE001 - per-request verdict
                             out.append((request, None, exc))

@@ -117,12 +117,16 @@ class StepClock:
         sample_xfer_ms: float,
         commit_t: Optional[float] = None,
         accepted: Optional[int] = None,
+        cached_tokens: Optional[int] = None,
     ) -> StepRecord:
         """Record one step and stamp its commit as the next step's
         host-gap origin.  ``accepted`` is the step's COMMITTED generated
         token count when it differs from the billed ``tokens``
         (speculation verify rows, pipelined voided work); MFU stays
-        computed on billed tokens — the compute really ran."""
+        computed on billed tokens — the compute really ran.
+        ``cached_tokens`` is the prompt-token count rows admitted at this
+        step reused from the prefix cache — spared compute, so it never
+        enters ``tokens`` and MFU stays honest."""
         total = max(0.0, host_gap_ms) + max(0.0, device_ms) + max(0.0, sample_xfer_ms)
         mfu = None
         if (
@@ -144,6 +148,7 @@ class StepClock:
             sample_xfer_ms=sample_xfer_ms,
             mfu=mfu,
             accepted=accepted,
+            cached_tokens=cached_tokens,
         )
         self._last_commit = commit_t if commit_t is not None else time.perf_counter()
         if self.metrics is not None:

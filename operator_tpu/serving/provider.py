@@ -352,6 +352,7 @@ def build_serving_engine(
                 spec_width=1 + (
                     config.spec_lookup_k if config.spec_decode else 0
                 ),
+                kv_prefix_cache=config.kv_prefix_cache,
                 lora_names=sorted(lora_adapters) if lora_adapters else (),
             ))
         except Exception:  # noqa: BLE001 - cache is an optimisation only
@@ -444,6 +445,25 @@ def build_serving_engine(
         else:
             from .sched import Scheduler
 
+            # automatic block-hash prefix caching (serving/kvstore.py):
+            # the continuous scheduler's generalisation of the wave
+            # engine's registered-shared-prefix — any cached prompt
+            # prefix is reused, with an optional host-RAM offload tier
+            # for evicted blocks (ops/kv_transfer.py)
+            kvstore = None
+            if config.kv_prefix_cache:
+                from .kvstore import PrefixKVStore
+
+                host_pool = None
+                if config.kv_host_pool_mb > 0:
+                    from ..ops.kv_transfer import HostKVPool
+
+                    host_pool = HostKVPool(config.kv_host_pool_mb)
+                kvstore = PrefixKVStore(
+                    config.kv_page_size,
+                    host_pool=host_pool,
+                    metrics=generator.metrics,
+                )
             scheduler = Scheduler(
                 generator,
                 chunk=config.sched_chunk,
@@ -451,6 +471,7 @@ def build_serving_engine(
                 pipeline_depth=config.sched_pipeline_depth,
                 spec_decode=config.spec_decode,
                 spec_lookup_k=config.spec_lookup_k,
+                kvstore=kvstore,
             )
     elif config.sched_mode != "wave":
         raise ValueError(
@@ -462,8 +483,10 @@ def build_serving_engine(
     if scheduler is not None:
         log.info(
             "serving mode: CONTINUOUS scheduler (pipeline_depth=%d "
-            "spec_decode=%s spec_lookup_k=%d); SCHED_MODE=wave opts out",
+            "spec_decode=%s spec_lookup_k=%d kv_prefix_cache=%s "
+            "kv_host_pool_mb=%d); SCHED_MODE=wave opts out",
             scheduler.depth, scheduler.spec_k > 0, scheduler.spec_k,
+            scheduler._kvstore is not None, config.kv_host_pool_mb,
         )
     else:
         log.info(

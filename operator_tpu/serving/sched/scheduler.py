@@ -47,6 +47,20 @@ the longest sample-confirmed prefix (``accept + 1`` tokens per host
 round-trip), byte-identical to one-token greedy decoding by
 construction.
 
+**Block-hash prefix caching** (``kvstore=``, serving/kvstore.py): at
+admission the request's longest cached block chain is matched and those
+STORE-OWNED device pages are mapped into the row's page table read-only
+(refcounted); the row's ``pos`` starts at ``cached_len``, so only the
+uncached suffix prefills — the ragged program already handles arbitrary
+per-row q_count, a hit is just a shorter chunk.  At prefill completion
+the row donates its full prompt blocks' pages to the store (ownership
+transfer, no copy).  When admission needs pages, LRU refcount-zero
+blocks are evicted; with a host pool (ops/kv_transfer.py) the page's KV
+is gathered on device at eviction (no sync) and fetched to host inside
+the commit step's existing sync window, restorable later with one DMA.
+Greedy output is byte-identical cache-on vs cache-off: KV vectors are
+per-token projections, independent of how the prompt was chunked.
+
 Counters (docs/METRICS.md): ``podmortem_sched_admitted_midwave_total``,
 ``podmortem_sched_chunked_prefill_total``,
 ``podmortem_sched_recycled_slot_total``,
@@ -55,7 +69,11 @@ Counters (docs/METRICS.md): ``podmortem_sched_admitted_midwave_total``,
 ``podmortem_sched_pipeline_dispatch_ahead_total``,
 ``podmortem_sched_pipeline_voided_total``,
 ``podmortem_spec_rounds_total``, ``podmortem_spec_proposed_total``,
-``podmortem_spec_accepted_total``, ``podmortem_spec_rest_total``.
+``podmortem_spec_accepted_total``, ``podmortem_spec_rest_total``,
+``podmortem_kv_hit_total``, ``podmortem_kv_miss_total``,
+``podmortem_kv_evict_total``, ``podmortem_kv_offload_total``,
+``podmortem_kv_restore_total``,
+``podmortem_kv_prefill_tokens_saved_total``.
 """
 
 from __future__ import annotations
@@ -117,9 +135,16 @@ class Scheduler:
         pipeline_depth: int = 1,
         spec_decode: bool = False,
         spec_lookup_k: int = 4,
+        kvstore: Optional[Any] = None,
     ) -> None:
         if not getattr(generator, "paged", False):
             raise ValueError("the continuous scheduler requires paged KV")
+        if kvstore is not None and kvstore.page_size != generator.page_size:
+            raise ValueError(
+                f"kvstore page_size={kvstore.page_size} != generator "
+                f"page_size={generator.page_size}: block hashes would not "
+                f"align with KV pages"
+            )
         if getattr(generator, "mesh", None) is not None:
             raise ValueError(
                 "the continuous scheduler does not support mesh sharding yet"
@@ -170,6 +195,12 @@ class Scheduler:
         self._next_req = itertools.count(1)
         self._kv_shadow = np.zeros((generator.max_slots,), np.int32)
         self._staged_tables: list[tuple[int, np.ndarray]] = []
+        #: block-hash prefix cache (serving/kvstore.py); None = off
+        self._kvstore = kvstore
+        #: evicted blocks gathered on device but not yet fetched to the
+        #: host pool: (hash, k_dev, v_dev) — drained inside the commit
+        #: step's existing host-sync window (_drain_offload)
+        self._pending_offload: list[tuple[bytes, Any, Any]] = []
         self._fn = None
         # host-side stats the bench reads (stats())
         self.steps = 0
@@ -191,6 +222,7 @@ class Scheduler:
         *,
         submitted: Optional[float] = None,
         priority: int = 0,
+        resume_tokens: Optional[list[int]] = None,
     ) -> int:
         """Tokenise + queue one request; returns its req id.  Raises
         :class:`OversizedRequest` when the request can never fit the KV
@@ -200,7 +232,12 @@ class Scheduler:
         queue wait covers the engine handoff too, not just this queue.
         ``priority`` orders admission (higher class first); WITHIN a
         class the queue is earliest-deadline-first, so an urgent late
-        arrival overtakes an earlier request with slack (_edf_head)."""
+        arrival overtakes an earlier request with slack (_edf_head).
+        ``resume_tokens`` is the token-level failover path (streaming
+        resume, router/resume.py): already-generated token ids appended
+        VERBATIM after the prompt, so the survivor re-prefills
+        prompt+generated-so-far — cheap under the prefix cache — and the
+        result's token_ids carry only the continuation."""
         g = self.generator
         params = params or SamplingParams()
         if params.guided_choice is not None or params.guided_regex is not None:
@@ -215,9 +252,22 @@ class Scheduler:
             )
         ids = g.tokenizer.encode(prompt)
         # same truncation budget + middle-drop as the wave path's admit()
-        tokens = g._truncate_prompt(
-            ids, prompt_budget(g.max_seq, params.max_tokens)
-        )
+        budget = prompt_budget(g.max_seq, params.max_tokens)
+        if resume_tokens:
+            # resumed stream: the generated suffix must survive VERBATIM
+            # (the caller already streamed those tokens), so truncation
+            # may only eat the prompt part
+            if len(resume_tokens) >= budget:
+                raise OversizedRequest(
+                    f"resume checkpoint of {len(resume_tokens)} tokens "
+                    f"leaves no prompt budget (budget {budget})"
+                )
+            tokens = (
+                g._truncate_prompt(ids, budget - len(resume_tokens))
+                + list(resume_tokens)
+            )
+        else:
+            tokens = g._truncate_prompt(ids, budget)
         pool = g.allocator.num_pages - 1 - g.prefix_held_pages
         if self._pages_needed(tokens, params) > pool:
             raise OversizedRequest(
@@ -297,6 +347,19 @@ class Scheduler:
                 ) if rounds else None,
                 "draft_overhead_ms": round(self._draft_ms, 3),
             },
+            "kv_economy": (
+                {
+                    **self._kvstore.stats(),
+                    "evictions": self.metrics.counter("kv_evict"),
+                    "offloads": self.metrics.counter("kv_offload"),
+                    "restores": self.metrics.counter("kv_restore"),
+                    "prefill_tokens_saved": self.metrics.counter(
+                        "kv_prefill_tokens_saved"
+                    ),
+                    "offload_pending": len(self._pending_offload),
+                }
+                if self._kvstore is not None else None
+            ),
         }
 
     def reset(self) -> None:
@@ -311,6 +374,24 @@ class Scheduler:
         self._staged_tables.clear()
         self._inflight.clear()
         self._latest = None
+        self._pending_offload.clear()  # gathered buffers died with the device state
+        if self._kvstore is not None:
+            # every device page is gone (the generator rebuilds its
+            # allocator); host-pool copies survive and stay restorable
+            self._kvstore.reset()
+
+    def spill_cache(self) -> int:
+        """Evict every refcount-zero cached block off device — to the
+        host pool when one is configured, else dropped.  Returns the
+        number of blocks spilled.  The deterministic hook the bench and
+        tests use to exercise the restored-from-host lane, and an
+        operator's pre-burst page reclaim."""
+        if self._kvstore is None:
+            return 0
+        count = len(self._kvstore.evictable())
+        if count:
+            self._evict_blocks(count)
+        return count
 
     def precompile(self) -> None:
         """Compile the one mixed program before serving (an empty wave
@@ -395,6 +476,166 @@ class Scheduler:
             len(tokens), params.max_tokens, g.max_seq, g.page_size
         )
 
+    # -- prefix cache (serving/kvstore.py) -----------------------------
+
+    def _match_prefix(self, tokens: list, need: int) -> list:
+        """Match + acquire the longest AFFORDABLE cached block chain for
+        ``tokens``.  Host-resident blocks are restored into fresh
+        store-owned pages (one DMA each); LRU refcount-zero blocks are
+        evicted when the row grant + restores would not fit.  Returns
+        device-resident blocks with refs held; the chain shrinks from
+        the tail until it fits, possibly to nothing."""
+        g = self.generator
+        store = self._kvstore
+        chain = store.match(tokens)
+        if not chain:
+            return []
+        store.acquire(chain)
+        # a chain entry that lost both its device page and its host copy
+        # ends the usable prefix (match() already breaks on those; this
+        # guards the race where the host pool dropped it since)
+        usable = []
+        for blk in chain:
+            if blk.page >= 0 or store.restorable(blk.hash):
+                usable.append(blk)
+            else:
+                break
+        if len(usable) < len(chain):
+            store.release([b.hash for b in chain[len(usable) :]])
+        while usable:
+            restores = sum(1 for b in usable if b.page < 0)
+            required = (need - len(usable)) + restores
+            deficit = required - g.allocator.available
+            if deficit > 0:
+                self._evict_blocks(deficit)
+            if required <= g.allocator.available:
+                break
+            dropped = usable.pop()
+            store.release([dropped.hash])
+        for blk in usable:
+            if blk.page < 0:
+                self._restore_block(blk)
+        return usable
+
+    def _evict_blocks(self, count: int) -> None:
+        """Evict up to ``count`` LRU refcount-zero blocks from device.
+        With a host pool, each victim's page is GATHERED into fresh
+        device buffers first (an enqueued device-side copy, no sync —
+        ordering guarantees the gather reads the page before any new
+        owner's writes land) and queued for the commit-side offload
+        drain; without one the block is simply forgotten."""
+        from ...ops import kv_transfer
+
+        g = self.generator
+        store = self._kvstore
+        pool = store.host_pool
+        for blk in store.evict_lru(count):
+            # capture the page BEFORE mark_offloaded/forget clear it on
+            # the shared entry — releasing after would return -1 to the
+            # free list (a leak plus a poisoned allocation)
+            page = blk.page
+            if pool is not None and pool.has(blk.hash):
+                store.mark_offloaded(blk.hash)  # host copy already there
+            elif pool is not None and pool.capacity_bytes > 0:
+                k_dev, v_dev = kv_transfer.gather_page(g.paged_cache, page)
+                self._pending_offload.append((blk.hash, k_dev, v_dev))
+                store.pending_offload.add(blk.hash)
+                store.mark_offloaded(blk.hash)
+            else:
+                store.forget(blk.hash)
+            g.allocator.release([page])
+
+    def _restore_block(self, blk: Any) -> None:
+        """Bring an off-device block back: one freshly-allocated
+        store-owned page + one DMA (from the pending-offload device
+        buffers when the drain hasn't run yet, else from the host
+        pool) — table writes + a page copy, never recompute."""
+        from ...ops import kv_transfer
+
+        g = self.generator
+        store = self._kvstore
+        page = g.allocator.allocate(1)[0]
+        entry = None
+        if blk.hash in store.pending_offload:
+            for i, (h, k_dev, v_dev) in enumerate(self._pending_offload):
+                if h == blk.hash:
+                    entry = (k_dev, v_dev)
+                    del self._pending_offload[i]
+                    break
+            store.pending_offload.discard(blk.hash)
+        if entry is None:
+            entry = store.host_pool.get(blk.hash)
+        g.paged_cache = kv_transfer.restore_page(
+            g.paged_cache, page, entry[0], entry[1]
+        )
+        blk.page = page
+        self.metrics.incr("kv_restore")
+
+    def _drain_offload(self) -> None:
+        """Fetch gathered eviction buffers to the host pool — called
+        ONLY inside the commit step's existing host-sync window, so the
+        device→host readback overlaps the sync the loop already pays."""
+        from ...ops import kv_transfer
+
+        store = self._kvstore
+        pool = store.host_pool
+        for h, k_dev, v_dev in self._pending_offload:
+            if h not in store.pending_offload:
+                continue  # restored from these buffers meanwhile
+            store.pending_offload.discard(h)
+            dropped = pool.put(h, *kv_transfer.fetch_page(k_dev, v_dev))
+            if dropped is None:
+                store.forget(h)  # pool refused: the block is gone
+                continue
+            self.metrics.incr("kv_offload")
+            for old in dropped:
+                # LRU-dropped host copies: forget any index entry that
+                # has no device page left either
+                entry = store.get(old)
+                if entry is not None and entry.page < 0:
+                    store.forget(old)
+        self._pending_offload.clear()
+
+    def _register_row_blocks(self, row: _Row) -> None:
+        """Prefill completed: donate the row's FULL prompt blocks to the
+        store (ownership transfer of the device pages — no copy).  Only
+        full blocks are immutable by construction (generation writes at
+        positions >= prompt_len, past the last full prompt block), and
+        the row keeps a reference on each donated block until release."""
+        from ..kvstore import block_hashes
+
+        g = self.generator
+        store = self._kvstore
+        ps = g.page_size
+        k_full = row.prompt_len // ps
+        c0 = row.cached_len // ps
+        if k_full <= c0:
+            return
+        hashes = block_hashes(row.tokens[: k_full * ps], ps)
+        transferred: set[int] = set()
+        for j in range(c0, k_full):
+            h = hashes[j]
+            entry = store.get(h)
+            page = row.pages[j - c0]
+            if entry is not None and entry.page >= 0:
+                # a concurrent identical prompt registered first: keep
+                # the row-owned duplicate page, no transfer
+                continue
+            store.insert(
+                h,
+                hashes[j - 1] if j else None,
+                row.tokens[j * ps : (j + 1) * ps],
+                page,
+                refs=1,
+            )
+            store.pending_offload.discard(h)
+            transferred.add(j - c0)
+            row.cached_hashes.append(h)
+        if transferred:
+            row.pages = [
+                p for i, p in enumerate(row.pages) if i not in transferred
+            ]
+
     def _sweep_expired(self, outcomes: list[StepOutcome]) -> None:
         """Fail EVERY queued request whose deadline already expired —
         the whole queue, every step, regardless of capacity.  Checking
@@ -436,14 +677,19 @@ class Scheduler:
                 best, best_key = i, key
         return best
 
-    def _admit_queued(self, outcomes: list[StepOutcome]) -> list[int]:
+    def _admit_queued(
+        self, outcomes: list[StepOutcome]
+    ) -> tuple[list[int], int]:
         """Token-level admission: pull queued requests into free slots
         while pages last.  Runs at the top of EVERY step, so an arrival
         joins the running wave at the next step boundary — never waits
-        for a decode block or an admission window."""
+        for a decode block or an admission window.  Returns the admitted
+        req ids and the total prompt tokens they reused from the prefix
+        cache (StepPlan.cached_tokens)."""
         g = self.generator
         self._sweep_expired(outcomes)
         admitted: list[int] = []
+        cached_total = 0
         while self._queue:
             free = g.free_slots()
             if not free:
@@ -462,15 +708,45 @@ class Scheduler:
             if outcome == "truncated":
                 self.metrics.incr("admission_deadline_truncated")
             need = self._pages_needed(tokens, clamped)
-            if need > g.allocator.available:
-                break  # backpressure: decode frees pages, retry next step
+            # prefix-cache match: the longest affordable cached block
+            # chain replaces the head of the row's grant (store-owned
+            # read-only pages; refs held until the row releases)
+            picked: list = []
+            if self._kvstore is not None:
+                picked = self._match_prefix(tokens, need)
+            grant_need = need - len(picked)
+            if grant_need > g.allocator.available and self._kvstore is not None:
+                # the free list is short but the store may be sitting on
+                # refcount-zero cached pages — reclaim those first (LRU,
+                # spilled to host when a pool exists).  Without this an
+                # idle engine whose pool is fully cached would deadlock:
+                # nothing decoding means nothing ever frees a page.
+                self._evict_blocks(grant_need - g.allocator.available)
+            if grant_need > g.allocator.available:
+                # backpressure: decode frees pages, retry next step
+                if picked:
+                    self._kvstore.release([b.hash for b in picked])
+                break
             del self._queue[head]
-            grant = g.allocator.allocate(need)
+            grant = g.allocator.allocate(grant_need)
             slot = free[0]
             row = _Row(
                 req_id=req_id, slot=slot, tokens=tokens, params=clamped,
                 pages=grant, submitted=submitted,
             )
+            if picked:
+                # cached blocks ARE the prompt head: prefill starts at
+                # cached_len (always inside a row-owned page — the match
+                # is capped one token short of the prompt, so no row
+                # ever appends into a shared page: the no-CoW rule)
+                row.cached_len = len(picked) * g.page_size
+                row.cached_hashes = [b.hash for b in picked]
+                row.pos = row.cached_len
+                self._kv_shadow[slot] = row.cached_len
+                cached_total += row.cached_len
+                self.metrics.incr(
+                    "kv_prefill_tokens_saved", row.cached_len
+                )
             self._rows[req_id] = row
             # measured submit -> admission wall: the span's queue_wait_ms
             # and the sched_queue_wait gauge read the SAME number
@@ -486,14 +762,17 @@ class Scheduler:
             slot_obj.params = clamped
             slot_obj.pages = grant
             g.slots[slot] = slot_obj
-            # stage the row's page table for the next dispatch
+            # stage the row's page table for the next dispatch: cached
+            # store-owned pages first, then the row's own grant
             row_table = np.zeros((g.pages_per_seq,), np.int32)
-            row_table[: len(grant)] = grant
+            if picked:
+                row_table[: len(picked)] = [b.page for b in picked]
+            row_table[len(picked) : len(picked) + len(grant)] = grant
             self._staged_tables.append((slot, row_table))
             admitted.append(req_id)
             if len(self._rows) > 1:
                 self.metrics.incr("sched_admitted_midwave")
-        return admitted
+        return admitted, cached_total
 
     def _schedule(self, outcomes: list[StepOutcome]) -> StepPlan:
         """Plan the next ragged wave from PREDICTED row state (``pred_*``
@@ -505,7 +784,7 @@ class Scheduler:
         work whose row vanished) covers finish/cancel races."""
         g = self.generator
         plan = StepPlan()
-        plan.admitted = self._admit_queued(outcomes)
+        plan.admitted, plan.cached_tokens = self._admit_queued(outcomes)
         budget = self.t_budget
         cursor = 0
         # decode rows first — one token each (plus drafts), NEVER
@@ -760,6 +1039,11 @@ class Scheduler:
         needed, unlike the wave engine's always-dispatch-all-slots
         decode block."""
         g = self.generator
+        if self._kvstore is not None and row.cached_hashes:
+            # drop the row's references on shared/donated blocks (the
+            # pages themselves stay with the store until LRU eviction)
+            self._kvstore.release(row.cached_hashes)
+            row.cached_hashes = []
         g.allocator.release(row.pages)
         g.slots[row.slot] = _Slot()
         self._kv_shadow[row.slot] = 0
@@ -812,6 +1096,11 @@ class Scheduler:
         t_ready = time.perf_counter()
         toks = np.asarray(entry.toks)
         accept = np.asarray(entry.accept)
+        if self._pending_offload:
+            # the step just paid its host sync: piggyback the offload
+            # fetches on it (device→host page copies overlap the token
+            # readback window instead of opening a new sync point)
+            self._drain_offload()
         fetch_t = time.perf_counter()
         self._host_syncs += 1
         device_ms = max(0.0, (t_ready - entry.dispatch_t) * 1e3)
@@ -842,6 +1131,9 @@ class Scheduler:
             sample_xfer_ms=xfer_ms,
             commit_t=fetch_t,
             accepted=accepted,
+            cached_tokens=(
+                plan.cached_tokens if self._kvstore is not None else None
+            ),
         )
         elapsed_ms = (fetch_t - entry.started) * 1e3
         outcomes.extend(self._commit(plan, toks, accept, elapsed_ms))
@@ -905,6 +1197,10 @@ class Scheduler:
                 row.decode_cum0 = g.step_clock.decode_cum_ms
                 row.pend_gen -= 1
                 row.generated = []
+                if self._kvstore is not None:
+                    # the prompt's KV is complete and immutable: donate
+                    # its full blocks' pages to the prefix cache
+                    self._register_row_blocks(row)
                 self.metrics.record("prefill", row.prefill_ms)
                 finished = self._push_token(row, int(toks[work.slot, 0]))
                 self._decode_committed += 1

@@ -73,6 +73,15 @@ class _Row:
     #: re-planned until its commit lands (the accepted count — and so
     #: the row's true length — is unknowable on the host until then)
     pend_spec: bool = False
+    # --- prefix cache (serving/kvstore.py) ---
+    #: prompt tokens served from cached blocks at admission: the row's
+    #: first ``cached_len // page_size`` table entries are STORE-OWNED
+    #: read-only pages (never in ``pages``, never written — suffix
+    #: prefill starts at ``pos = cached_len`` in a row-owned page)
+    cached_len: int = 0
+    #: block hashes this row holds references on (acquired at admission
+    #: + blocks it donated at prefill completion); released on finish
+    cached_hashes: list[bytes] = field(default_factory=list)
 
     @property
     def prompt_len(self) -> int:
@@ -151,6 +160,9 @@ class StepPlan:
     prefill_rows: int = 0
     deferred_decode: int = 0  # decode-ready rows left out (stall signal)
     admitted: list[int] = field(default_factory=list)  # req ids admitted NOW
+    #: prompt tokens rows admitted THIS step reused from the prefix
+    #: cache (spared prefill compute; rides into StepRecord.cached_tokens)
+    cached_tokens: int = 0
 
     def trace(self) -> tuple:
         return tuple(

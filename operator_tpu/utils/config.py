@@ -238,6 +238,19 @@ class OperatorConfig:
     # prompt template's static preamble is prefilled once and admissions
     # forward only their suffix; paged mode only, exact (causal) reuse
     prefix_cache: bool = True
+    # automatic block-hash prefix caching for the continuous scheduler
+    # (serving/kvstore.py): page-granular APC keyed by rolling hash over
+    # page-aligned token blocks — admissions reuse any cached prompt
+    # prefix, not just a registered template preamble
+    kv_prefix_cache: bool = True
+    # host-RAM offload tier for evicted prefix blocks (ops/kv_transfer.py):
+    # pinned numpy pool size in MB; 0 = eviction simply forgets blocks
+    kv_host_pool_mb: int = 0
+    # token-level streaming resume (router/resume.py): journal path for
+    # per-request generated-token checkpoints; on failover the survivor
+    # re-prefills prompt+generated-so-far instead of restarting the
+    # stream.  None/"" = off
+    resume_checkpoint_path: Optional[str] = None
     # program-grid precompile at warmup (engine.precompile_grid): compile
     # every prefill/decode program admission can select BEFORE readiness
     # flips — a mid-run XLA compile is a multi-second p99 outlier.

@@ -1,0 +1,505 @@
+"""Fleet-wide KV economy (ISSUE 14): block-hash prefix caching in the
+continuous scheduler (serving/kvstore.py), host-RAM page offload
+(ops/kv_transfer.py), and token-level streaming resume (router/resume.py).
+
+Acceptance surface:
+
+- block-hash stability and page alignment (the rolling chain commits to
+  the whole prefix; the unaligned tail is never hashable);
+- refcount discipline: referenced blocks are never evicted, and a row
+  finishing in the same step another is admitted cannot recycle a page
+  out from under a reader (the structural no-CoW rule);
+- offload→restore round trip: spilled blocks come back by DMA with
+  byte-identical greedy output;
+- byte-identical greedy output cache-on vs cache-off;
+- the seeded chaos scenario: a replica killed mid-stream, the survivor
+  resuming from the journaled token checkpoint — the client stream
+  strictly extends, exactly one status patch, two replays byte-identical;
+- ReplicaLoad kv-field wire format, fleet rollup, and kv-hint routing.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from operator_tpu.router import EngineRouter, ReplicaLoad, ResumeLog
+from operator_tpu.router.health import fleet_rollup
+from operator_tpu.serving.kvstore import PrefixKVStore, block_hashes
+from operator_tpu.utils.faultinject import FaultPlan, raise_
+from operator_tpu.utils.timing import MetricsRegistry
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from operator_tpu.models import TINY_TEST, init_params  # noqa: E402
+from operator_tpu.models.tokenizer import ByteTokenizer  # noqa: E402
+from operator_tpu.ops.kv_transfer import HostKVPool  # noqa: E402
+from operator_tpu.serving.engine import (  # noqa: E402
+    BatchedGenerator,
+    SamplingParams,
+)
+from operator_tpu.serving.sched import Scheduler  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def make_generator(params, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_seq", 128)
+    kw.setdefault("page_size", 16)
+    return BatchedGenerator(
+        params, TINY_TEST, ByteTokenizer(), paged=True,
+        cache_dtype=jnp.float32, metrics=MetricsRegistry(), **kw,
+    )
+
+
+def make_sched(params, *, pool_mb=8, **kw):
+    generator = make_generator(params, **kw)
+    store = PrefixKVStore(
+        generator.page_size,
+        host_pool=HostKVPool(pool_mb) if pool_mb else None,
+        metrics=generator.metrics,
+    )
+    return Scheduler(generator, kvstore=store), generator, store
+
+
+def drain_one(sched, req_id, limit=500):
+    for _ in range(limit):
+        for outcome in sched.step():
+            if outcome.req_id == req_id:
+                return outcome
+    raise AssertionError(f"request {req_id} never finished")
+
+
+def greedy(max_tokens):
+    return SamplingParams(max_tokens=max_tokens, temperature=0.0,
+                          stop_on_eos=False)
+
+
+def assert_page_accounting(generator, store):
+    """Every page is owned by exactly one of: the free list, a live row
+    (none here), or the store — the KV-economy leak audit."""
+    assert (
+        generator.allocator.available + store.device_pages_held
+        == generator.allocator.num_pages - 1
+    )
+
+
+# ---------------------------------------------------------------------------
+# block hashing
+# ---------------------------------------------------------------------------
+
+
+class TestBlockHashes:
+    def test_stable_and_page_aligned(self):
+        tokens = list(range(50))
+        first = block_hashes(tokens, 16)
+        second = block_hashes(tokens, 16)
+        assert first == second
+        # 50 tokens / 16 per page = 3 FULL blocks; the 2-token tail is
+        # unaligned and must never get a hash (it can never be shared)
+        assert len(first) == 3
+        assert all(isinstance(h, bytes) and len(h) == 16 for h in first)
+
+    def test_chain_commits_to_whole_prefix(self):
+        a = block_hashes(list(range(48)), 16)
+        b = list(range(48))
+        b[0] += 1  # perturb ONE token in the first block
+        bh = block_hashes(b, 16)
+        # every downstream hash changes: block identity pins the prefix
+        assert all(x != y for x, y in zip(a, bh))
+        # and a shared prefix with a divergent tail shares exactly the
+        # leading blocks
+        c = list(range(32)) + [999] * 16
+        ch = block_hashes(c, 16)
+        assert ch[:2] == a[:2] and ch[2] != a[2]
+
+    def test_match_leaves_one_suffix_token(self):
+        store = PrefixKVStore(16)
+        tokens = list(range(32))
+        for i, h in enumerate(block_hashes(tokens, 16)):
+            store.insert(h, None, tokens[i * 16 : (i + 1) * 16], page=i + 1)
+        # 32 tokens = 2 full blocks, but the match is capped at
+        # (32-1)//16 = 1 so the row always owns its first written page
+        assert len(store.match(tokens)) == 1
+        assert len(store.match(tokens + [99])) == 2
+
+
+# ---------------------------------------------------------------------------
+# store refcounts / eviction policy
+# ---------------------------------------------------------------------------
+
+
+class TestStoreRefcounts:
+    def _store_with_blocks(self, n=3):
+        store = PrefixKVStore(4)
+        tokens = list(range(4 * n))
+        hashes = block_hashes(tokens, 4)
+        blocks = [
+            store.insert(h, hashes[i - 1] if i else None,
+                         tokens[i * 4 : (i + 1) * 4], page=i + 1)
+            for i, h in enumerate(hashes)
+        ]
+        return store, blocks
+
+    def test_referenced_blocks_are_not_evictable(self):
+        store, blocks = self._store_with_blocks()
+        store.acquire(blocks[:2])
+        assert [b.hash for b in store.evictable()] == [blocks[2].hash]
+        store.release([blocks[0].hash, blocks[1].hash])
+        assert len(store.evictable()) == 3
+
+    def test_evict_lru_order_and_adoption(self):
+        store, blocks = self._store_with_blocks()
+        store.acquire([blocks[1]])  # bump block 1's LRU tick
+        store.release([blocks[1].hash])
+        victims = store.evict_lru(2)
+        assert [v.hash for v in victims] == [blocks[0].hash, blocks[2].hash]
+        store.mark_offloaded(victims[0].hash)
+        store.forget(victims[1].hash)
+        assert store.get(blocks[0].hash).page == -1
+        assert store.get(blocks[2].hash) is None
+        # re-insert adopts the existing host-resident entry (a revival,
+        # not a duplicate)
+        revived = store.insert(blocks[0].hash, None, blocks[0].tokens, page=7)
+        assert revived is store.get(blocks[0].hash) and revived.page == 7
+        with pytest.raises(ValueError):
+            store.insert(blocks[1].hash, None, blocks[1].tokens, page=8)
+
+    def test_reset_keeps_only_host_backed_entries(self):
+        pool = HostKVPool(8)
+        store = PrefixKVStore(4, host_pool=pool)
+        tokens = list(range(8))
+        h0, h1 = block_hashes(tokens, 4)
+        store.insert(h0, None, tokens[:4], page=1)
+        store.insert(h1, h0, tokens[4:], page=2)
+        pool.put(h0, np.zeros((1, 4, 1, 2), np.float32),
+                 np.zeros((1, 4, 1, 2), np.float32))
+        store.reset()
+        assert store.get(h0) is not None and store.get(h0).page == -1
+        assert store.get(h1) is None
+
+
+# ---------------------------------------------------------------------------
+# scheduler integration (greedy parity, refcounts under recycle, offload)
+# ---------------------------------------------------------------------------
+
+
+class TestSchedulerKVEconomy:
+    PROMPT = "the quick brown fox jumps over the lazy dog " * 3
+
+    def test_greedy_byte_identical_cache_on_vs_off(self, params):
+        g_off = make_generator(params)
+        sched_off = Scheduler(g_off)
+        baseline = drain_one(sched_off, sched_off.enqueue(self.PROMPT, greedy(8)))
+
+        sched, generator, store = make_sched(params)
+        cold = drain_one(sched, sched.enqueue(self.PROMPT, greedy(8)))
+        warm = drain_one(sched, sched.enqueue(self.PROMPT, greedy(8)))
+        assert (
+            list(baseline.result.token_ids)
+            == list(cold.result.token_ids)
+            == list(warm.result.token_ids)
+        )
+        # the warm request actually reused the chain: all matchable
+        # blocks hit, and prefill tokens were saved
+        assert store.hits > 0 and store.hit_rate() == 0.5
+        assert generator.metrics.counter("kv_prefill_tokens_saved") > 0
+        assert_page_accounting(generator, store)
+
+    def test_refcounts_protect_shared_pages_under_recycle(self, params):
+        sched, generator, store = make_sched(params)
+        seed = drain_one(sched, sched.enqueue(self.PROMPT, greedy(8)))
+        # two concurrent readers of the same chain, admitted together
+        r1 = sched.enqueue(self.PROMPT, greedy(8))
+        r2 = sched.enqueue(self.PROMPT, greedy(8))
+        sched.step()
+        shared = [b for b in store._blocks.values() if b.refs > 0]
+        assert shared and all(b.refs == 2 for b in shared)
+        # eviction pressure while referenced: shared pages must survive
+        sched.spill_cache()
+        assert all(b.page >= 0 for b in shared)
+        done = {}
+        for _ in range(300):
+            for outcome in sched.step():
+                done[outcome.req_id] = outcome
+            if r1 in done and r2 in done:
+                break
+        assert list(done[r1].result.token_ids) == list(seed.result.token_ids)
+        assert list(done[r2].result.token_ids) == list(seed.result.token_ids)
+        # rows released their references; pages accounted for
+        assert all(b.refs == 0 for b in store._blocks.values())
+        assert_page_accounting(generator, store)
+
+    def test_offload_restore_round_trip_parity(self, params):
+        sched, generator, store = make_sched(params, pool_mb=8)
+        cold = drain_one(sched, sched.enqueue(self.PROMPT, greedy(8)))
+        spilled = sched.spill_cache()
+        assert spilled > 0
+        assert store.device_pages_held == 0
+        # blocks are off-device but restorable (pending buffers or pool)
+        restored = drain_one(sched, sched.enqueue(self.PROMPT, greedy(8)))
+        assert list(restored.result.token_ids) == list(cold.result.token_ids)
+        assert generator.metrics.counter("kv_restore") > 0
+        assert_page_accounting(generator, store)
+
+    def test_eviction_without_pool_forgets_and_recomputes(self, params):
+        sched, generator, store = make_sched(params, pool_mb=0)
+        cold = drain_one(sched, sched.enqueue(self.PROMPT, greedy(8)))
+        sched.spill_cache()
+        # no host pool: the blocks are gone for good — a rematch misses
+        # and the request re-prefills, with identical output
+        again = drain_one(sched, sched.enqueue(self.PROMPT, greedy(8)))
+        assert list(again.result.token_ids) == list(cold.result.token_ids)
+        assert generator.metrics.counter("kv_restore") == 0
+        assert_page_accounting(generator, store)
+
+    def test_cache_pressure_never_wedges_admission(self, params):
+        # a store holding every free page must yield to admission (the
+        # idle-engine deadlock: nothing decoding means nothing ever
+        # frees a page unless the cache is evicted)
+        sched, generator, store = make_sched(
+            params, pool_mb=4, max_slots=2, max_seq=64,
+        )
+        outs = {}
+        prompts = [f"prompt variant {i}: " + "abcdefgh " * 6 for i in range(5)]
+        for i, prompt in enumerate(prompts):
+            outs[i] = drain_one(sched, sched.enqueue(prompt, greedy(4)))
+        for i, prompt in enumerate(prompts):
+            again = drain_one(sched, sched.enqueue(prompt, greedy(4)))
+            assert list(again.result.token_ids) == list(outs[i].result.token_ids)
+        assert_page_accounting(generator, store)
+
+    def test_resume_tokens_bill_as_prompt_and_continue(self, params):
+        sched, generator, store = make_sched(params)
+        full = drain_one(sched, sched.enqueue(self.PROMPT, greedy(12)))
+        head = list(full.result.token_ids)[:5]
+        resumed = drain_one(sched, sched.enqueue(
+            self.PROMPT, greedy(7), resume_tokens=head,
+        ))
+        assert head + list(resumed.result.token_ids) == list(full.result.token_ids)
+        assert_page_accounting(generator, store)
+
+    def test_stats_and_step_records_carry_cached_tokens(self, params):
+        sched, generator, store = make_sched(params)
+        drain_one(sched, sched.enqueue(self.PROMPT, greedy(4)))
+        drain_one(sched, sched.enqueue(self.PROMPT, greedy(4)))
+        kv = sched.stats()["kv_economy"]
+        assert kv["hits"] > 0 and kv["prefill_tokens_saved"] > 0
+        summary = generator.step_clock.summary()
+        assert summary["cached_tokens"] > 0
+
+
+# ---------------------------------------------------------------------------
+# resume log (journal-backed token checkpoints)
+# ---------------------------------------------------------------------------
+
+
+class TestResumeLog:
+    def test_monotonic_checkpoints_and_replay(self, tmp_path):
+        path = os.path.join(tmp_path, "resume.jsonl")
+        log = ResumeLog(path)
+        assert log.checkpoint("r1", [1, 2])
+        assert not log.checkpoint("r1", [9])  # stale: shorter never wins
+        assert log.checkpoint("r1", [1, 2, 3])
+        assert log.tokens("r1") == [1, 2, 3]
+        log.close()
+        replayed = ResumeLog(path)
+        assert replayed.tokens("r1") == [1, 2, 3]
+        replayed.complete("r1")
+        replayed.close()
+        assert ResumeLog(path).tokens("r1") is None
+
+    def test_compaction_bounds_the_journal(self, tmp_path):
+        path = os.path.join(tmp_path, "resume.jsonl")
+        log = ResumeLog(path, compact_every=8)
+        for n in range(1, 40):
+            log.checkpoint("r1", list(range(n)))
+        log.close()
+        with open(path) as handle:
+            lines = [line for line in handle if line.strip()]
+        assert len(lines) < 40  # superseded checkpoints were compacted
+        assert ResumeLog(path).tokens("r1") == list(range(39))
+
+    def test_memory_only_mode(self):
+        log = ResumeLog(None)
+        assert log.checkpoint("r1", [1])
+        assert log.tokens("r1") == [1]
+        log.complete("r1")
+        assert len(log) == 0
+
+
+# ---------------------------------------------------------------------------
+# load-report wire format + fleet rollup + kv-hint routing
+# ---------------------------------------------------------------------------
+
+
+class TestReplicaLoadKV:
+    def test_kv_fields_round_trip(self):
+        load = ReplicaLoad(
+            kv_pages_free=5, kv_pages_total=16,
+            prefix_hit_rate=0.75, prefix_lookups=12,
+            kv_blocks=["aa", "bb"],
+        )
+        data = load.to_dict()
+        assert data["kvPagesFree"] == 5 and data["kvPagesTotal"] == 16
+        assert data["prefixHitRate"] == 0.75 and data["kvLookups"] == 12
+        parsed = ReplicaLoad.parse(data)
+        assert parsed.kv_pages_free == 5
+        assert parsed.prefix_hit_rate == 0.75
+        assert parsed.kv_blocks == ["aa", "bb"]
+        # absent fields degrade to "no cache" (old replicas stay parseable)
+        legacy = ReplicaLoad.parse({"queueDepth": 1})
+        assert legacy.kv_pages_total == 0 and legacy.prefix_hit_rate is None
+
+    def test_fleet_rollup_weights_hit_rate_by_lookups(self):
+        rows = {
+            "a": {"kvPagesFree": 4, "kvPagesTotal": 8,
+                  "prefixHitRate": 1.0, "kvLookups": 30},
+            "b": {"kvPagesFree": 2, "kvPagesTotal": 8,
+                  "prefixHitRate": 0.0, "kvLookups": 10},
+            "c": {},  # predates the KV fields entirely
+        }
+        fleet = fleet_rollup(rows)
+        assert fleet["kvPagesFree"] == 6 and fleet["kvPagesTotal"] == 16
+        assert fleet["prefixHitRate"] == 0.75  # (1.0*30 + 0.0*10) / 40
+
+    def test_kv_hint_prefers_block_holders(self):
+        router = EngineRouter(["a", "b"])
+        # find a key whose affinity owner is a, then advertise the
+        # wanted blocks only on b — the hint must override affinity
+        key = next(
+            f"key-{i}" for i in range(64)
+            if router.route(f"key-{i}").replica.id == "a"
+        )
+        router.report_load("b", ReplicaLoad(kv_blocks=["h1", "h2"]))
+        assert router.route(key).replica.id == "a"
+        assert router.route(key, kv_hint=["h1", "h2"]).replica.id == "b"
+        # no holder anywhere: affinity order is untouched
+        assert router.route(key, kv_hint=["zz"]).replica.id == "a"
+
+    def test_holders_index(self):
+        router = EngineRouter(["a", "b", "c"])
+        router.report_load("a", ReplicaLoad(kv_blocks=["h1"]))
+        router.report_load("c", ReplicaLoad(kv_blocks=["h1", "h2"]))
+        assert router.health.holders("h1") == ["a", "c"]
+        assert router.health.holders("h2") == ["c"]
+        assert router.health.holders("h9") == []
+
+
+# ---------------------------------------------------------------------------
+# seeded chaos: replica killed mid-stream, survivor resumes the stream
+# ---------------------------------------------------------------------------
+
+
+async def _run_kill_resume(params, seed: int) -> dict:
+    """One seeded failover scenario over two in-process scheduler-backed
+    replicas.  Replica a dies (seeded FaultPlan) after streaming KILL_AT
+    tokens; the router requeues on b with the journaled checkpoint, and b
+    decodes only the continuation."""
+    KILL_AT = 4
+    prompt = "the quick brown fox jumps over the lazy dog " * 3
+    max_tokens = 10
+
+    replicas = {}
+    for name in ("a", "b"):
+        sched, generator, store = make_sched(params)
+        replicas[name] = sched
+
+    plan = FaultPlan(seed=seed)
+    plan.rule(
+        "replica.stream",
+        [raise_(lambda: RuntimeError("replica killed mid-stream"), "kill")],
+        match=lambda replica, tokens: replica == "a" and tokens >= KILL_AT,
+    )
+
+    router = EngineRouter(["a", "b"], failure_threshold=1)
+    resume_log = ResumeLog(None)
+    patches: list[str] = []
+    streamed: dict[str, list[int]] = {"a": [], "b": []}
+
+    async def send(replica, attempt, budget_s, resume_tokens):
+        sched = replicas[replica.id]
+        budget = max_tokens - len(resume_tokens or [])
+        req = sched.enqueue(
+            prompt, greedy(budget),
+            resume_tokens=list(resume_tokens) if resume_tokens else None,
+        )
+        emitted = 0
+        for _ in range(500):
+            outcomes = {o.req_id: o for o in sched.step()}
+            row = sched._rows.get(req)
+            generated = list(row.generated) if row is not None else None
+            if generated is not None and len(generated) > emitted:
+                streamed[replica.id].extend(generated[emitted:])
+                emitted = len(generated)
+                full_stream = list(resume_tokens or []) + generated
+                assert resume_log.checkpoint(str(req_key), full_stream)
+                plan.apply(
+                    "replica.stream", replica=replica.id,
+                    tokens=len(full_stream),
+                )
+            if req in outcomes:
+                outcome = outcomes[req]
+                tail = list(outcome.result.token_ids)[emitted:]
+                streamed[replica.id].extend(tail)
+                patches.append(replica.id)  # the ONE status patch
+                return list(resume_tokens or []) + list(outcome.result.token_ids)
+        raise AssertionError("replica never finished")
+
+    req_key = "req-resume-1"
+    # pin affinity on the doomed replica so the kill path actually runs
+    key = next(
+        f"key-{i}" for i in range(64)
+        if router.route(f"key-{i}").replica.id == "a"
+    )
+    outcome = await router.dispatch(
+        send, key=key, request_id=str(req_key), attempts=3,
+        resume_log=resume_log, kv_hint=None,
+    )
+
+    # reference: the same request end-to-end on an untouched engine
+    ref_sched, _, _ = make_sched(params)
+    reference = drain_one(ref_sched, ref_sched.enqueue(prompt, greedy(max_tokens)))
+    return {
+        "stream": list(outcome.response),
+        "reference": list(reference.result.token_ids),
+        "served_by": outcome.replica_id,
+        "requeues": outcome.requeues,
+        "patches": list(patches),
+        "a_streamed": list(streamed["a"]),
+        "b_streamed": list(streamed["b"]),
+        "resume_live": len(resume_log),
+        "plan_pending": plan.pending(),
+    }
+
+
+def test_replica_kill_mid_stream_resumes_token_level(params):
+    out = asyncio.run(_run_kill_resume(params, seed=13))
+    # the survivor finished the request after exactly one requeue
+    assert out["served_by"] == "b" and out["requeues"] == 1
+    # exactly ONE status patch despite two attempts
+    assert out["patches"] == ["b"]
+    # the client stream strictly EXTENDS the killed replica's tokens:
+    # b never re-emitted what a already streamed
+    assert out["a_streamed"] == out["stream"][: len(out["a_streamed"])]
+    assert out["b_streamed"] == out["stream"][len(out["a_streamed"]):]
+    assert len(out["a_streamed"]) >= 1 and len(out["b_streamed"]) >= 1
+    # and the stitched stream is byte-identical to the uninterrupted run
+    assert out["stream"] == out["reference"]
+    # settled: the checkpoint was tombstoned, the fault fired exactly once
+    assert out["resume_live"] == 0
+    assert out["plan_pending"] == {}
+
+
+def test_kill_resume_replay_is_byte_identical(params):
+    first = asyncio.run(_run_kill_resume(params, seed=13))
+    second = asyncio.run(_run_kill_resume(params, seed=13))
+    assert first["stream"] == second["stream"]
+    assert first["a_streamed"] == second["a_streamed"]
+    assert first["patches"] == second["patches"]
