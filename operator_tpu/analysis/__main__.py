@@ -17,7 +17,24 @@ from pathlib import Path
 
 from .baseline import Baseline, load_baseline, write_baseline
 from .rules import ALL_RULES, rules_by_id
-from .runner import collect_context, run_analysis
+from .runner import changed_paths, collect_context, run_analysis
+
+
+def _github_line(finding) -> str:
+    """One GitHub workflow-command annotation per finding: the Actions
+    runner turns these into inline PR annotations at file:line."""
+    # workflow-command property values: escape %, then CR/LF
+    message = (
+        finding.message.replace("%", "%25")
+        .replace("\r", "%0D")
+        .replace("\n", "%0A")
+    )
+    title = finding.rule + (f" {finding.symbol}" if finding.symbol else "")
+    title = title.replace("%", "%25").replace(",", "%2C").replace("::", "")
+    return (
+        f"::error file={finding.path},line={finding.line},"
+        f"title={title}::{message}"
+    )
 
 
 def _detect_root(start: Path) -> Path:
@@ -56,11 +73,23 @@ def main(argv: list[str] | None = None) -> int:
         help="record the current findings into --baseline and exit 0",
     )
     parser.add_argument(
-        "--rules", default=None,
+        "--rules", "--rule", default=None,
         help="comma-separated rule ids to run (default: all)",
     )
     parser.add_argument(
-        "--format", choices=("text", "json"), default="text",
+        "--format", choices=("text", "json", "github"), default="text",
+        help="github = workflow-command annotations (::error file=...) so "
+        "CI findings land inline on the PR diff",
+    )
+    parser.add_argument(
+        "--changed-only", metavar="REF", default=None,
+        help="lint only files differing from git REF (plus untracked) — "
+        "the fast local pre-commit mode; repo-level artifact rules still "
+        "check the whole tree",
+    )
+    parser.add_argument(
+        "--timings", action="store_true",
+        help="print per-rule wall time after the run",
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalogue",
@@ -81,24 +110,42 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     root = (args.root or _detect_root(Path.cwd())).resolve()
+    paths = list(args.paths) if args.paths else None
+    if args.changed_only is not None:
+        if args.paths:
+            print("--changed-only and explicit paths are mutually "
+                  "exclusive", file=sys.stderr)
+            return 2
+        try:
+            paths = changed_paths(root, args.changed_only)
+        except ValueError as exc:
+            print(exc, file=sys.stderr)
+            return 2
+        if not paths:
+            print(
+                f"graftlint: clean — no .py files differ from "
+                f"{args.changed_only}"
+            )
+            return 0
     try:
-        ctx = collect_context(root, args.paths or None)
+        ctx = collect_context(root, paths)
     except (FileNotFoundError, ValueError) as exc:
         print(exc, file=sys.stderr)
         return 2
-    findings, pragma_errors = run_analysis(ctx, rules)
+    timings: dict = {}
+    findings, pragma_errors = run_analysis(ctx, rules, timings=timings)
 
     if args.write_baseline:
         if args.baseline is None:
             print("--write-baseline requires --baseline", file=sys.stderr)
             return 2
-        if args.rules or args.paths:
+        if args.rules or args.paths or args.changed_only:
             # a partial run writes a partial baseline, silently dropping
             # every other rule's grandfathered entries — refuse
             print(
                 "--write-baseline records the FULL analysis; drop --rules/"
-                "path arguments (a partial baseline would discard the "
-                "other rules' grandfathered findings)",
+                "--changed-only/path arguments (a partial baseline would "
+                "discard the other rules' grandfathered findings)",
                 file=sys.stderr,
             )
             return 2
@@ -123,10 +170,29 @@ def main(argv: list[str] | None = None) -> int:
     if args.rules:
         ran_rules = {rule.id for rule in rules}
         stale = [key for key in stale if key[0] in ran_rules]
-    if args.paths:
+    if args.paths or args.changed_only:
         analyzed = {m.relpath for m in ctx.modules}
         stale = [key for key in stale if key[1] in analyzed]
     new = pragma_errors + new
+
+    if args.timings and args.format != "json":
+        for rule in rules:
+            print(f"timing: {rule.id}  {timings.get(rule.id, 0.0) * 1e3:8.1f} ms")
+
+    if args.format == "github":
+        for finding in new:
+            print(_github_line(finding))
+        if new:
+            print(
+                f"graftlint: {len(new)} finding(s) not in the baseline "
+                "(docs/ANALYSIS.md)"
+            )
+            return 1
+        print(
+            f"graftlint: clean — {len(ctx.modules)} file(s), "
+            f"{len(rules)} rule(s)"
+        )
+        return 0
 
     if args.format == "json":
         print(json.dumps(

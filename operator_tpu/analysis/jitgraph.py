@@ -35,9 +35,14 @@ import ast
 from dataclasses import dataclass, field
 from typing import Optional
 
+from .callgraph import (
+    DEF_NODES as _DEF_NODES,
+    SymbolTables,
+    attr_chain as _attr_chain,
+    func_root as _func_root,
+    iter_scope,
+)
 from .core import AnalysisContext, ModuleSource
-
-_DEF_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
 #: attribute accesses that yield static (host) metadata at trace time
 SANITIZING_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding"}
 #: builtins whose result is a static host value
@@ -83,42 +88,6 @@ def is_trace_inert_call(func: ast.AST) -> bool:
         ):
             return True  # jax.profiler.trace / self.tracer.span / obs.span
     return False
-
-
-def iter_scope(stmt: ast.AST):
-    """Walk a statement WITHOUT descending into nested function/lambda
-    subtrees.  Nested defs are yielded (so callers can register them) but
-    their bodies belong to their own scope: a nested helper's locals,
-    returns and calls must never leak into the enclosing function's taint
-    env or finding scan (each reachable nested def is analysed as its own
-    FunctionInfo)."""
-    stack: list[ast.AST] = [stmt]
-    while stack:
-        node = stack.pop()
-        yield node
-        if isinstance(node, (*_DEF_NODES, ast.Lambda)):
-            continue
-        stack.extend(ast.iter_child_nodes(node))
-
-
-def _func_root(func: ast.AST) -> Optional[str]:
-    """Leftmost name of a (possibly dotted) call target."""
-    while isinstance(func, ast.Attribute):
-        func = func.value
-    return func.id if isinstance(func, ast.Name) else None
-
-
-def _attr_chain(func: ast.AST) -> list[str]:
-    """``jax.lax.scan`` -> ["jax", "lax", "scan"]; [] when not a pure
-    name/attribute chain."""
-    parts: list[str] = []
-    while isinstance(func, ast.Attribute):
-        parts.append(func.attr)
-        func = func.value
-    if isinstance(func, ast.Name):
-        parts.append(func.id)
-        return list(reversed(parts))
-    return []
 
 
 def _is_array_namespace_call(func: ast.AST) -> bool:
@@ -195,52 +164,23 @@ class JitGraph:
     def __init__(self, ctx: AnalysisContext, modules: list[ModuleSource]) -> None:
         self.ctx = ctx
         self.modules = [m for m in modules if m.tree is not None]
-        self._relpaths = {m.relpath for m in self.modules}
         self._infos: dict[int, FunctionInfo] = {}  # id(node) -> info
-        self._module_funcs: dict[str, dict[str, ast.AST]] = {}
-        self._methods: dict[str, list[FunctionInfo]] = {}  # name -> infos
-        self._imports: dict[str, dict[str, tuple[str, str]]] = {}
-        self._build_tables()
+        #: shared syntactic tables (callgraph.py) — the same resolution
+        #: semantics GL006's async walk uses
+        self._tables = SymbolTables(self.modules)
+        self._build_infos()
         self._detect_entries()
         self._propagate()
 
     # -- construction --------------------------------------------------
-    def _build_tables(self) -> None:
+    def _build_infos(self) -> None:
         for module in self.modules:
-            funcs: dict[str, ast.AST] = {}
             for node in ast.walk(module.tree):
                 if isinstance(node, _DEF_NODES):
-                    info = FunctionInfo(
-                        node=node, module=module, qualname=module.symbol_at(node)
+                    self._infos[id(node)] = FunctionInfo(
+                        node=node, module=module,
+                        qualname=module.symbol_at(node),
                     )
-                    self._infos[id(node)] = info
-                    parent = getattr(node, "_graftlint_parent", None)
-                    if isinstance(parent, ast.Module):
-                        funcs[node.name] = node
-                    elif isinstance(parent, ast.ClassDef):
-                        self._methods.setdefault(node.name, []).append(info)
-            self._module_funcs[module.relpath] = funcs
-            self._imports[module.relpath] = self._scan_imports(module)
-
-    def _scan_imports(self, module: ModuleSource) -> dict[str, tuple[str, str]]:
-        """local name -> (target module relpath, original name) for
-        ``from X import y [as z]`` imports resolvable inside the set."""
-        out: dict[str, tuple[str, str]] = {}
-        package_parts = module.relpath.split("/")[:-1]
-        for node in ast.walk(module.tree):
-            if not isinstance(node, ast.ImportFrom):
-                continue
-            if node.level:
-                base = package_parts[: len(package_parts) - (node.level - 1)]
-            else:
-                base = []
-            target = base + (node.module.split(".") if node.module else [])
-            rel = "/".join(target) + ".py"
-            if rel not in self._relpaths:
-                continue
-            for alias in node.names:
-                out[alias.asname or alias.name] = (rel, alias.name)
-        return out
 
     def info(self, node: ast.AST) -> Optional[FunctionInfo]:
         return self._infos.get(id(node))
@@ -309,35 +249,10 @@ class JitGraph:
                 )
                 self._infos[id(target)] = info
             return [info]
-        if isinstance(target, ast.Attribute):
-            if isinstance(target.value, ast.Name) and target.value.id == "self":
-                return list(self._methods.get(target.attr, []))
-            return []
-        if not isinstance(target, ast.Name):
-            return []
-        name = target.id
-        # nearest lexically-enclosing def with that name
-        scope = getattr(site, "_graftlint_parent", None)
-        while scope is not None:
-            if isinstance(scope, _DEF_NODES):
-                for child in ast.walk(scope):
-                    if (
-                        isinstance(child, _DEF_NODES)
-                        and child.name == name
-                        and child is not scope
-                    ):
-                        return [self._infos[id(child)]]
-            scope = getattr(scope, "_graftlint_parent", None)
-        local = self._module_funcs.get(module.relpath, {}).get(name)
-        if local is not None:
-            return [self._infos[id(local)]]
-        imported = self._imports.get(module.relpath, {}).get(name)
-        if imported is not None:
-            rel, orig = imported
-            other = self._module_funcs.get(rel, {}).get(orig)
-            if other is not None:
-                return [self._infos[id(other)]]
-        return []
+        nodes = self._tables.resolve_ref(module, site, target)
+        return [
+            self._infos[id(node)] for node in nodes if id(node) in self._infos
+        ]
 
     # -- reachability + taint fixpoint ---------------------------------
     def _propagate(self) -> None:

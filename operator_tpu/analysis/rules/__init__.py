@@ -8,6 +8,11 @@ from .gl002_tracer import TracerUnsafeControlFlow
 from .gl003_deadline import DeadlinePropagation
 from .gl004_locks import LockDiscipline
 from .gl005_drift import GeneratedArtifactDrift
+from .gl006_eventloop import EventLoopBlockingRule
+from .gl007_replay import ReplayDeterminismRule
+from .gl008_mosaic import MosaicLowerabilityRule
+from .gl009_release import ResourceReleaseRule
+from .gl010_config import ConfigDriftRule
 
 ALL_RULES: list[Rule] = [
     HostSyncInHotPath(),
@@ -15,6 +20,11 @@ ALL_RULES: list[Rule] = [
     DeadlinePropagation(),
     LockDiscipline(),
     GeneratedArtifactDrift(),
+    EventLoopBlockingRule(),
+    ReplayDeterminismRule(),
+    MosaicLowerabilityRule(),
+    ResourceReleaseRule(),
+    ConfigDriftRule(),
 ]
 
 

@@ -12,9 +12,10 @@ this one in the field:
   must appear in the docs (docs/METRICS.md) — an operator alerting on an
   undocumented counter name is debugging blind.
 
-The metric half absorbs ``scripts/check_metric_docs.py`` (now a thin shim
-over :func:`emitted_metrics`/:func:`documented_text` so existing CI
-invocations keep their exact behaviour and output).
+The metric half absorbed ``scripts/check_metric_docs.py``; the shim is
+deleted — CI runs ``python -m operator_tpu.analysis --rule GL005``
+directly (same scan via :func:`emitted_metrics`/:func:`documented_text`,
+same verdict).
 """
 
 from __future__ import annotations
@@ -45,7 +46,7 @@ CRD_MANIFEST = "deploy/crds/podmortem-crds.yaml"
 
 def emitted_metrics(root: pathlib.Path) -> set[str]:
     """Every ``podmortem_*`` metric name the code under ``root`` can emit
-    (the scan ``scripts/check_metric_docs.py`` always ran, verbatim)."""
+    (the scan the old ``check_metric_docs`` script always ran, verbatim)."""
     metrics: set[str] = set()
     for path in (root / "operator_tpu").rglob("*.py"):
         text = path.read_text(encoding="utf-8", errors="replace")

@@ -1,0 +1,283 @@
+"""GL008: Pallas kernel bodies must stay Mosaic-lowerable.
+
+A ``pallas_call`` kernel compiles through Mosaic, which supports a
+narrower op set than XLA: ``argmax``/``argmin``/``sort``/``top_k`` and
+friends have no TPU lowering inside a kernel, 1-D ``lax.iota`` is
+rejected (Mosaic needs >=2-D; use ``lax.broadcasted_iota``), and
+integer reductions hit the "Only float32 and bfloat16 reductions
+supported" wall.  Today these fail at compile time at best — on an
+interpreter-mode CI (``interpret=True``) they pass silently and only
+explode on real hardware.  This rule moves the failure to lint time.
+
+Kernel discovery handles the repo's binding idiom, which the jit
+graph's entry detection does not see through::
+
+    kernel = functools.partial(_best_window_kernel, num_windows=n, ...)
+    out = pl.pallas_call(kernel, grid=..., ...)
+
+i.e. the first ``pallas_call`` argument may be the kernel def directly,
+an inline ``partial(...)``, or a local Name bound to either — resolved
+by scanning the enclosing function's assignments.  The closure then
+expands through calls resolvable on the shared
+:class:`~..callgraph.SymbolTables` and through decorated nested defs
+(``@pl.when``).
+
+The sanctioned replacement idiom — manual argmax via
+``broadcasted_iota`` + ``jnp.where`` + float min/max, as in
+``ops/similarity.py`` — contains none of the banned calls and stays
+quiet by construction.  Integer-reduction detection is a small local
+dtype inference (int iff provably int: iota results, ``.astype(int)``,
+int-dtype creators, int-propagating arithmetic); unknown dtypes are NOT
+flagged — the rule prefers silence to crying wolf on f32 code.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..callgraph import DEF_NODES, SymbolTables, attr_chain, iter_scope
+from ..core import AnalysisContext, Finding, ModuleSource, Rule
+
+#: ops with no Mosaic lowering inside a kernel, rooted at jnp/jax/lax
+_UNLOWERABLE = {
+    "argmax", "argmin", "argsort", "sort", "top_k", "sort_key_val",
+    "approx_max_k", "approx_min_k", "nonzero", "unique", "median",
+    "searchsorted",
+}
+_ARRAY_ROOTS = {"jnp", "jax", "lax", "np", "numpy"}
+#: reductions that only lower for f32/bf16 on TPU
+_REDUCTIONS = {"sum", "prod", "max", "min", "cumsum", "cumprod"}
+#: dtype spellings that mean "integer"
+_INT_DTYPES = {
+    "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32",
+    "uint64", "int_", "intp", "integer",
+}
+
+
+def _is_pallas_call(func: ast.AST) -> bool:
+    if isinstance(func, ast.Name):
+        return func.id == "pallas_call"
+    return isinstance(func, ast.Attribute) and func.attr == "pallas_call"
+
+
+def _is_int_dtype_expr(expr: ast.AST) -> bool:
+    """Does this expression spell an integer dtype (``jnp.int32``,
+    ``"int32"``, ``np.dtype("int32")``)?"""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value.startswith(("int", "uint"))
+    chain = attr_chain(expr)
+    if chain and chain[-1] in _INT_DTYPES:
+        return True
+    if isinstance(expr, ast.Call):
+        return any(_is_int_dtype_expr(a) for a in expr.args)
+    return False
+
+
+class _IntTyper:
+    """Tiny flow-insensitive int-dtype inference over one kernel body."""
+
+    def __init__(self, body: list) -> None:
+        self.int_names: set[str] = set()
+        # two passes: straight-line `idx = iota(...); s = idx + 1` chains
+        for _ in range(2):
+            for stmt in body:
+                for node in iter_scope(stmt):
+                    if isinstance(node, ast.Assign) and self.is_int(node.value):
+                        for target in node.targets:
+                            for leaf in ast.walk(target):
+                                if isinstance(leaf, ast.Name):
+                                    self.int_names.add(leaf.id)
+
+    def is_int(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in self.int_names
+        if isinstance(expr, ast.Constant):
+            return isinstance(expr.value, int) and not isinstance(
+                expr.value, bool
+            )
+        if isinstance(expr, ast.Subscript):
+            return self.is_int(expr.value)
+        if isinstance(expr, ast.BinOp):
+            return self.is_int(expr.left) and self.is_int(expr.right)
+        if isinstance(expr, ast.UnaryOp):
+            return self.is_int(expr.operand)
+        if isinstance(expr, ast.Call):
+            chain = attr_chain(expr.func)
+            if not chain:
+                return False
+            if chain[-1] == "astype" and expr.args:
+                return _is_int_dtype_expr(expr.args[0])
+            if chain[-1] in ("iota", "broadcasted_iota"):
+                # iota's dtype is its FIRST argument in jax; int by default
+                dtype = expr.args[0] if expr.args else None
+                for kw in expr.keywords:
+                    if kw.arg == "dtype":
+                        dtype = kw.value
+                if dtype is None:
+                    return True
+                return _is_int_dtype_expr(dtype)
+            if chain[-1] in ("zeros", "ones", "full", "arange", "array"):
+                for kw in expr.keywords:
+                    if kw.arg == "dtype":
+                        return _is_int_dtype_expr(kw.value)
+                return chain[-1] == "arange"
+            if chain[-1] == "where" and len(expr.args) == 3:
+                return self.is_int(expr.args[1]) and self.is_int(expr.args[2])
+            return False
+        return False
+
+
+class MosaicLowerabilityRule(Rule):
+    id = "GL008"
+    name = "mosaic-lowerability"
+    description = (
+        "pallas_call kernel bodies must avoid ops with no Mosaic/TPU "
+        "lowering: argmax/argmin/sort/top_k (use the broadcasted_iota + "
+        "where + float-min manual form), 1-D lax.iota (use "
+        "broadcasted_iota), and integer reductions (reduce in f32, cast "
+        "at the edge)"
+    )
+    scope = (
+        r"operator_tpu/ops/.*\.py$",
+        r"operator_tpu/serving/.*\.py$",
+        r"operator_tpu/models/.*\.py$",
+    )
+
+    def check(self, ctx: AnalysisContext) -> list[Finding]:
+        modules = [m for m in ctx.in_scope(self.scope) if m.tree is not None]
+        tables = SymbolTables(modules)
+
+        # -- kernel discovery: every pallas_call's first argument -------
+        kernels: list[tuple[ast.AST, ModuleSource]] = []
+        seen: set[int] = set()
+        for module in tables.modules:
+            for node in ast.walk(module.tree):
+                if not (isinstance(node, ast.Call) and _is_pallas_call(node.func)):
+                    continue
+                target = node.args[0] if node.args else None
+                if target is None:
+                    continue
+                for fn in self._kernel_defs(tables, module, node, target):
+                    if id(fn) not in seen:
+                        seen.add(id(fn))
+                        kernels.append((fn, module))
+
+        # -- closure: calls + decorated nested defs (@pl.when) ----------
+        worklist = list(kernels)
+        while worklist:
+            fn, module = worklist.pop()
+            owner = tables.module_of.get(id(fn), module)
+            body = fn.body if isinstance(fn.body, list) else [ast.Expr(fn.body)]
+            for stmt in body:
+                for node in iter_scope(stmt):
+                    callees: list[ast.AST] = []
+                    if isinstance(node, DEF_NODES) and node.decorator_list:
+                        callees = [node]
+                    elif isinstance(node, ast.Call):
+                        callees = tables.resolve_ref(owner, node, node.func)
+                    for callee in callees:
+                        if id(callee) not in seen:
+                            seen.add(id(callee))
+                            entry = (callee, tables.module_of.get(id(callee), owner))
+                            kernels.append(entry)
+                            worklist.append(entry)
+
+        # -- scan the kernel closure for unlowerable ops ----------------
+        findings: list[Finding] = []
+        for fn, module in kernels:
+            owner = tables.module_of.get(id(fn), module)
+            qualname = owner.symbol_at(fn)
+            body = fn.body if isinstance(fn.body, list) else [ast.Expr(fn.body)]
+            typer = _IntTyper(body)
+            for stmt in body:
+                for node in iter_scope(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    message = self._unlowerable(node, typer)
+                    if message is not None:
+                        findings.append(self.finding(
+                            owner, node,
+                            f"{message} inside Pallas kernel "
+                            f"`{qualname}` — no Mosaic/TPU lowering; "
+                            "see docs/ANALYSIS.md (GL008)",
+                        ))
+        return findings
+
+    def _kernel_defs(
+        self,
+        tables: SymbolTables,
+        module: ModuleSource,
+        site: ast.AST,
+        target: ast.AST,
+    ) -> list[ast.AST]:
+        """Resolve a pallas_call's kernel argument: a def reference, an
+        inline ``partial(...)``, or a local Name bound to either."""
+        if isinstance(target, ast.Call):  # partial(kernel, ...)
+            return (
+                self._kernel_defs(tables, module, site, target.args[0])
+                if target.args else []
+            )
+        direct = tables.resolve_ref(module, site, target)
+        if direct:
+            return direct
+        if isinstance(target, ast.Name):
+            # `kernel = functools.partial(_kernel, ...)` in an enclosing
+            # function: find the binding assignment and unwrap it
+            scope = getattr(site, "_graftlint_parent", None)
+            while scope is not None:
+                if isinstance(scope, DEF_NODES):
+                    for stmt in scope.body:
+                        for node in iter_scope(stmt):
+                            if not isinstance(node, ast.Assign):
+                                continue
+                            if any(
+                                isinstance(t, ast.Name) and t.id == target.id
+                                for t in node.targets
+                            ):
+                                value = node.value
+                                if isinstance(value, ast.Call):
+                                    return self._kernel_defs(
+                                        tables, module, node, value
+                                    )
+                                return tables.resolve_ref(module, node, value)
+                scope = getattr(scope, "_graftlint_parent", None)
+        return []
+
+    def _unlowerable(
+        self, call: ast.Call, typer: _IntTyper
+    ) -> Optional[str]:
+        chain = attr_chain(call.func)
+        if not chain:
+            return None
+        leaf = chain[-1]
+        rooted = chain[0] in _ARRAY_ROOTS and len(chain) >= 2
+        if leaf in _UNLOWERABLE and rooted:
+            return f"`{'.'.join(chain)}(...)`"
+        if leaf == "iota" and rooted:
+            # jax.lax.iota(dtype, size) is ALWAYS 1-D — the Mosaic-
+            # rejected form; broadcasted_iota is the lowerable spelling
+            return (
+                "1-D `lax.iota(...)` (use `lax.broadcasted_iota` with a "
+                ">=2-D shape)"
+            )
+        if leaf in _REDUCTIONS:
+            int_typed = False
+            for kw in call.keywords:
+                if kw.arg == "dtype" and _is_int_dtype_expr(kw.value):
+                    int_typed = True
+            if rooted and call.args and typer.is_int(call.args[0]):
+                int_typed = True
+            if (
+                not rooted
+                and isinstance(call.func, ast.Attribute)
+                and typer.is_int(call.func.value)
+            ):
+                int_typed = True  # x.sum() where x is int-typed
+            if int_typed:
+                return (
+                    f"integer reduction `{'.'.join(chain)}(...)` (TPU only "
+                    "lowers f32/bf16 reductions — reduce in f32, cast at "
+                    "the edge)"
+                )
+        return None

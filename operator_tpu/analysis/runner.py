@@ -6,6 +6,8 @@ a pre-commit hook) can run the analysis without a subprocess.
 
 from __future__ import annotations
 
+import subprocess
+import time
 from pathlib import Path
 from typing import Optional, Sequence
 
@@ -47,23 +49,59 @@ def collect_context(root: Path, paths: Optional[Sequence[Path]] = None) -> Analy
     return ctx
 
 
+def changed_paths(root: Path, ref: str) -> list[Path]:
+    """Python files under ``root`` that differ from git ``ref`` (committed
+    diff + untracked), for ``--changed-only`` pre-commit runs.  Deleted
+    files are dropped (nothing to parse); a bad ref raises ValueError so
+    the CLI can fail loudly instead of reporting a clean empty run."""
+    root = root.resolve()
+
+    def _git(*argv: str) -> list[str]:
+        proc = subprocess.run(
+            ["git", "-C", str(root), *argv],
+            capture_output=True, text=True, timeout=30,
+        )
+        if proc.returncode != 0:
+            raise ValueError(
+                f"git {' '.join(argv)} failed: {proc.stderr.strip()}"
+            )
+        return [line for line in proc.stdout.splitlines() if line.strip()]
+
+    names = set(_git("diff", "--name-only", ref, "--"))
+    names.update(_git("ls-files", "--others", "--exclude-standard"))
+    out = []
+    for name in sorted(names):
+        path = root / name
+        if path.suffix == ".py" and path.exists():
+            out.append(path)
+    return out
+
+
 def run_analysis(
-    ctx: AnalysisContext, rules: Sequence[Rule]
+    ctx: AnalysisContext,
+    rules: Sequence[Rule],
+    timings: Optional[dict] = None,
 ) -> tuple[list[Finding], list[Finding]]:
     """Run ``rules`` over ``ctx``.
 
     Returns ``(findings, pragma_errors)``: rule findings surviving pragma
     suppression (sorted by location), plus one GL000 finding per malformed
     pragma (``disable=`` without ``reason=`` — a suppression that does not
-    document itself does not suppress).
+    document itself does not suppress).  When ``timings`` is a dict it is
+    filled with per-rule wall seconds (rule id -> float) — the lint job
+    prints these so a rule that grows quadratic pain is caught in review,
+    not discovered as a slow CI mystery later.
     """
     findings: list[Finding] = []
     for rule in rules:
+        started = time.perf_counter()
         for finding in rule.check(ctx):
             module = ctx.module(finding.path)
             if module is not None and module.suppressed(finding.rule, finding.line):
                 continue
             findings.append(finding)
+        if timings is not None:
+            timings[rule.id] = time.perf_counter() - started
     pragma_errors: list[Finding] = []
     for module in ctx.modules:
         if module.parse_error:

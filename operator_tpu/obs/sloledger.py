@@ -269,7 +269,14 @@ class SLOLedger:
         self._clock = clock or time.monotonic
         self._open: dict[str, SLORecord] = {}
         self._closed: list[SLORecord] = []
-        self._journal = Journal(path, label="slo-ledger") if path else None
+        # async_writes: finish() runs inside the analysis pipeline's async
+        # path — terminal-record appends must enqueue to the writer
+        # thread, not block the event loop (graftlint GL006); close()
+        # still barriers, so no record is lost on drain
+        self._journal = (
+            Journal(path, label="slo-ledger", async_writes=True)
+            if path else None
+        )
         if self._journal is not None:
             self._journal.open()
 

@@ -732,8 +732,11 @@ async def run_demo(logfile: Optional[str] = None, provider_id: str = "template")
             os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
             "tests", "fixtures", "crashloop_quarkus.log",
         )
-    with open(logfile, encoding="utf-8", errors="replace") as f:
-        crash_log = f.read()
+    def _read_crash_log() -> str:
+        with open(logfile, encoding="utf-8", errors="replace") as f:
+            return f.read()
+
+    crash_log = await asyncio.to_thread(_read_crash_log)
     pod = Pod(
         metadata=ObjectMeta(name="payment-7f9c", namespace="prod", labels={"app": "payment"}),
         status=PodStatus(phase="Running", container_statuses=[ContainerStatus(
@@ -831,7 +834,9 @@ async def _run_real(config: OperatorConfig) -> int:
 
     from .httpapi import HttpKubeApi
 
-    api = HttpKubeApi.from_env()
+    # from_env reads the serviceaccount token / kubeconfig from disk:
+    # startup-once, but _run_real is already on the loop, so offload
+    api = await asyncio.to_thread(HttpKubeApi.from_env)
     operator = Operator(api, config=config)
     loop = asyncio.get_running_loop()
     stop = asyncio.Event()

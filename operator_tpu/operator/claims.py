@@ -171,7 +171,12 @@ class ClaimLedger:
             raise KeyError(f"unknown ledger op {op!r}")
 
     def _append_locked(self, record: dict, *, wait: bool = False) -> None:
-        self._journal.append(record, wait=wait)
+        # the wait=True caller is try_claim's durable-before-analysis
+        # write: the claim record MUST hit disk before the analysis
+        # starts, or a crash in the gap loses the failure entirely —
+        # a deliberate, bounded stall (one fsync) the ledger's contract
+        # documents (utils/journal.py module doc)
+        self._journal.append(record, wait=wait)  # graftlint: disable=GL006 reason=durable-before-analysis claim write; one bounded fsync by contract
         if self._journal.lines > self.compact_factor * max(len(self._entries), 16):
             self._compact_locked()
 

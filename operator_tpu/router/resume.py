@@ -47,7 +47,10 @@ class ResumeLog:
                  compact_every: int = 256) -> None:
         self._tokens: dict[str, list[int]] = {}
         self._compact_every = max(1, compact_every)
-        self._journal = Journal(path, label="resume-log")
+        # async_writes: checkpoint/complete run on the router's dispatch
+        # path — appends must enqueue to the writer thread, not do file
+        # IO on the event loop (graftlint GL006)
+        self._journal = Journal(path, label="resume-log", async_writes=True)
         self._journal.load(self._replay)
         self._journal.open()
 

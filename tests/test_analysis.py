@@ -588,14 +588,12 @@ def test_gl005_negative_documented_metric(tmp_path):
     assert findings == []
 
 
-def test_gl005_matches_check_metric_docs_verdict_on_repo():
-    """The rule reproduces scripts/check_metric_docs.py on the live tree:
-    both derive from the same scan, so the verdict must be identical."""
-    import scripts.check_metric_docs as shim
-
+def test_gl005_metric_docs_clean_on_repo():
+    """Every podmortem_* metric the live tree can emit is documented —
+    the contract scripts/check_metric_docs.py used to enforce before
+    GL005 absorbed it (the shim is deleted; CI runs `--rule GL005`)."""
     missing = undocumented_metrics(REPO_ROOT)
     assert missing == []
-    assert shim.main() == 0
 
 
 def test_gl005_crd_manifest_in_sync_with_crdgen():
@@ -603,6 +601,399 @@ def test_gl005_crd_manifest_in_sync_with_crdgen():
 
     manifest = (REPO_ROOT / "deploy/crds/podmortem-crds.yaml").read_text()
     assert manifest.strip() == render_all().strip()
+
+
+# ---------------------------------------------------------------------------
+# GL006 event-loop-blocking
+# ---------------------------------------------------------------------------
+
+
+def test_gl006_positive_blocking_reachable_from_async(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL006", {
+        "operator_tpu/operator/loop.py": """
+            import time
+
+            async def tick():
+                _refresh()
+
+            def _refresh():
+                time.sleep(0.5)          # blocks the loop via tick()
+                data = open("state.json").read()
+                return data
+        """,
+    })
+    messages = [f.message for f in findings]
+    assert any("time.sleep" in m for m in messages)
+    assert any("open(...)" in m for m in messages)
+    # findings are attributed to the async entry that reaches them
+    assert all("async `tick`" in m for m in messages)
+
+
+def test_gl006_negative_offload_escape_hatch(tmp_path):
+    """A function reference handed to to_thread runs OFF the loop — the
+    sanctioned fix — so its body must not be walked."""
+    findings, _ = run_rule(tmp_path, "GL006", {
+        "operator_tpu/operator/loop.py": """
+            import asyncio
+            import time
+
+            async def tick():
+                await asyncio.to_thread(_refresh)
+
+            def _refresh():
+                time.sleep(0.5)  # fine: writer-thread side
+
+            def sync_only_caller():
+                _refresh()       # fine: never async-reachable
+        """,
+    })
+    assert findings == []
+
+
+def test_gl006_journal_modes(tmp_path):
+    """Writer-thread journals enqueue and stay quiet; sync-mode appends
+    and wait= (not constant-False) appends block and are flagged."""
+    findings, _ = run_rule(tmp_path, "GL006", {
+        "operator_tpu/operator/ledger.py": """
+            from operator_tpu.utils.journal import Journal
+
+            class Ledger:
+                def __init__(self, path):
+                    self._fast = Journal(path, async_writes=True)
+                    self._slow = Journal(path)
+
+                async def handle(self, rec):
+                    self._fast.append(rec)             # enqueue: quiet
+                    self._slow.append(rec)             # sync-mode: flagged
+                    self._fast.append(rec, wait=True)  # flush wait: flagged
+        """,
+    })
+    messages = [f.message for f in findings]
+    assert len(findings) == 2
+    assert any("sync-mode Journal IO `self._slow.append(...)`" in m
+               for m in messages)
+    assert any("wait=True" in m for m in messages)
+
+
+def test_gl006_done_guarded_result_is_allowed(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL006", {
+        "operator_tpu/obs/peek.py": """
+            async def drain(fut):
+                if fut.done():
+                    return fut.result()  # non-blocking by construction
+                return None
+
+            async def bad(fut):
+                return fut.result()      # flagged: can block the loop
+        """,
+    })
+    assert len(findings) == 1
+    assert "`.result()`" in findings[0].message
+    assert "async `bad`" in findings[0].message
+
+
+def test_gl006_pragma_suppresses_with_reason(tmp_path):
+    findings, pragma_errors = run_rule(tmp_path, "GL006", {
+        "operator_tpu/operator/boot.py": """
+            async def start():
+                cfg = open("boot.cfg").read()  # graftlint: disable=GL006 reason=startup-once read before the loop serves traffic
+                return cfg
+        """,
+    })
+    assert findings == []
+    assert pragma_errors == []
+
+
+# ---------------------------------------------------------------------------
+# GL007 replay-determinism
+# ---------------------------------------------------------------------------
+
+
+def test_gl007_positive_wall_clock_and_unseeded_randomness(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL007", {
+        "operator_tpu/loadgen/storm.py": """
+            import random
+            import time
+
+            def next_arrival(last):
+                now = time.time()              # forks the replay
+                jitter = random.random()       # global entropy
+                return now + jitter
+        """,
+    })
+    messages = [f.message for f in findings]
+    assert len(findings) == 2
+    assert any("time.time()" in m for m in messages)
+    assert any("random.random" in m for m in messages)
+
+
+def test_gl007_negative_seams_and_seeded_generators(tmp_path):
+    """Uncalled clock references are seams (replay injects through them);
+    perf_counter is measurement-only; seeded generators are sanctioned."""
+    findings, _ = run_rule(tmp_path, "GL007", {
+        "operator_tpu/loadgen/storm.py": """
+            import random
+            import time
+
+            import numpy as np
+
+            class Storm:
+                def __init__(self, seed, clock=None):
+                    self._clock = clock or time.monotonic  # seam: uncalled
+                    self._rng = random.Random(seed)
+                    self._np_rng = np.random.default_rng(seed)
+
+                def step(self):
+                    started = time.perf_counter()  # measurement-only: fine
+                    now = self._clock()            # through the seam: fine
+                    return now, self._rng.random(), started
+        """,
+    })
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# GL008 mosaic-lowerability
+# ---------------------------------------------------------------------------
+
+
+def test_gl008_positive_banned_ops_through_partial_binding(tmp_path):
+    """Kernel discovery must see through the repo's universal idiom:
+    `kernel = functools.partial(_fn, ...)` then `pl.pallas_call(kernel)`."""
+    findings, _ = run_rule(tmp_path, "GL008", {
+        "operator_tpu/ops/badkernel.py": """
+            import functools
+
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+
+            def _bad_kernel(x_ref, o_ref):
+                x = x_ref[...]
+                o_ref[0] = jnp.argmax(x)           # no Mosaic lowering
+                ids = jax.lax.iota(jnp.int32, 128)  # always 1-D: rejected
+                o_ref[1] = jnp.sum(ids)             # integer reduction
+
+            def best(x):
+                kernel = functools.partial(_bad_kernel)
+                return pl.pallas_call(kernel, grid=(1,))(x)
+        """,
+    })
+    messages = [f.message for f in findings]
+    assert len(findings) == 3
+    assert any("jnp.argmax" in m for m in messages)
+    assert any("lax.iota" in m for m in messages)
+    assert any("integer reduction" in m for m in messages)
+    assert all("_bad_kernel" in m for m in messages)
+
+
+def test_gl008_negative_manual_argmax_idiom(tmp_path):
+    """The sanctioned replacement (broadcasted_iota + where + float min,
+    the ops/similarity.py shape) contains none of the banned calls."""
+    findings, _ = run_rule(tmp_path, "GL008", {
+        "operator_tpu/ops/goodkernel.py": """
+            import functools
+
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+
+            def _argmax_kernel(x_ref, o_ref):
+                x = x_ref[...]
+                row = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+                best = jnp.max(x, axis=0)
+                is_max = x == best
+                o_ref[...] = jnp.min(
+                    jnp.where(is_max, row.astype(jnp.float32), jnp.inf),
+                    axis=0,
+                ).astype(jnp.int32)
+
+            def best_rows(x):
+                kernel = functools.partial(_argmax_kernel)
+                return pl.pallas_call(kernel, grid=(1,))(x)
+        """,
+    })
+    assert findings == []
+
+
+def test_gl008_host_code_outside_kernels_is_not_flagged(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL008", {
+        "operator_tpu/serving/rank.py": """
+            import jax.numpy as jnp
+
+            def host_rank(scores):
+                return jnp.argmax(scores)  # host/XLA code: argmax is fine
+        """,
+    })
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# GL009 resource-release
+# ---------------------------------------------------------------------------
+
+
+def test_gl009_positive_early_return_leak(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL009", {
+        "operator_tpu/serving/kvstore.py": """
+            class Pool:
+                def admit(self, n):
+                    pages = self.allocator.allocate(n)
+                    if n > 4:
+                        return None       # pages dropped: leak
+                    self.rows.append(pages)
+                    return pages
+        """,
+    })
+    assert len(findings) == 1
+    assert "early return" in findings[0].message
+    assert "`pages`" in findings[0].message
+
+
+def test_gl009_positive_raise_voids_allocation(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL009", {
+        "operator_tpu/serving/sched/slots.py": """
+            class Slots:
+                def reserve(self, n):
+                    lane = self.lanes.acquire()
+                    if n > self.cap:
+                        raise ValueError("over capacity")  # lane in flight
+                    return lane
+        """,
+    })
+    assert len(findings) == 1
+    assert "void-in-flight" in findings[0].message
+
+
+def test_gl009_negative_try_finally_release(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL009", {
+        "operator_tpu/serving/kvstore.py": """
+            class Pool:
+                def fill(self, n):
+                    pages = self.allocator.allocate(n)
+                    try:
+                        self.copy_in(pages)
+                    finally:
+                        self.allocator.free(pages)
+        """,
+    })
+    assert findings == []
+
+
+def test_gl009_negative_branch_release_and_transfer(tmp_path):
+    """Releasing on one branch and transferring ownership (returning the
+    handle) on the other discharges on every path."""
+    findings, _ = run_rule(tmp_path, "GL009", {
+        "operator_tpu/serving/engine.py": """
+            class Engine:
+                async def grant(self, n):
+                    pages = await self.allocator.allocate(n)
+                    if n == 0:
+                        self.allocator.free(pages)
+                        return None
+                    return pages
+        """,
+    })
+    assert findings == []
+
+
+def test_gl009_cfg_scope_excludes_other_modules(tmp_path):
+    """The CFG pass runs only over the resource economy — an `.acquire()`
+    on a lock in the operator control plane is not a tracked handle."""
+    findings, _ = run_rule(tmp_path, "GL009", {
+        "operator_tpu/operator/lease.py": """
+            class Lease:
+                def renew(self):
+                    token = self.lock.acquire()
+                    return None
+        """,
+    })
+    assert findings == []
+
+
+def test_gl009_append_open_outside_journal(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL009", {
+        "operator_tpu/obs/adhoc.py": """
+            def log_line(path, line):
+                with open(path, "a") as fh:
+                    fh.write(line)
+        """,
+        "operator_tpu/utils/journal.py": """
+            def _open_tail(path):
+                return open(path, "ab")  # the one sanctioned append site
+        """,
+    })
+    assert len(findings) == 1
+    assert findings[0].path == "operator_tpu/obs/adhoc.py"
+    assert "append-mode open" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# GL010 config-env-doc-drift
+# ---------------------------------------------------------------------------
+
+_GL010_CONFIG = """
+    from dataclasses import dataclass
+
+    @dataclass
+    class OperatorConfig:
+        poll_interval_s: float = 5.0
+        secret_token: str = ""
+"""
+
+
+def test_gl010_positive_all_three_drift_directions(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL010", {
+        "operator_tpu/utils/config.py": _GL010_CONFIG,
+        "README.md": """
+            | env | meaning |
+            |-----|---------|
+            | `POLL_INTERVAL_S` | poll cadence |
+            | `GHOST_KNOB` | documented but nothing reads it |
+        """,
+        "deploy/operator.yaml": """
+            env:
+              - name: POLL_INTERVAL_S
+              - name: OLD_RENAMED_KNOB
+        """,
+    })
+    symbols = {f.symbol for f in findings}
+    assert len(findings) == 3
+    # an undocumented field is an invisible knob
+    assert "OperatorConfig.secret_token" in symbols
+    # a deploy row nothing reads is a silently-dead setting
+    assert "OLD_RENAMED_KNOB" in symbols
+    # a README row nothing reads documents a knob that does not exist
+    assert "GHOST_KNOB" in symbols
+    by_symbol = {f.symbol: f for f in findings}
+    assert by_symbol["OLD_RENAMED_KNOB"].path == "deploy/operator.yaml"
+    assert by_symbol["GHOST_KNOB"].path == "README.md"
+
+
+def test_gl010_negative_round_trip(tmp_path):
+    """Fields documented, deploy rows consumed (by a field AND by a raw
+    os.environ read), README rows backed — clean."""
+    findings, _ = run_rule(tmp_path, "GL010", {
+        "operator_tpu/utils/config.py": _GL010_CONFIG,
+        "operator_tpu/obs/exporter.py": """
+            import os
+
+            ENDPOINT = os.environ.get("TRACE_ENDPOINT", "")
+        """,
+        "README.md": """
+            | env | meaning |
+            |-----|---------|
+            | `POLL_INTERVAL_S` | poll cadence |
+            | `SECRET_TOKEN` | provider credential |
+            | `TRACE_ENDPOINT` | exporter target |
+        """,
+        "deploy/operator.yaml": """
+            env:
+              - name: POLL_INTERVAL_S
+              - name: TRACE_ENDPOINT
+        """,
+    })
+    assert findings == []
 
 
 # ---------------------------------------------------------------------------
@@ -750,7 +1141,10 @@ def test_repo_gate_is_clean(capsys):
 def test_cli_list_rules(capsys):
     assert cli_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    for rule_id in ("GL001", "GL002", "GL003", "GL004", "GL005"):
+    for rule_id in (
+        "GL001", "GL002", "GL003", "GL004", "GL005",
+        "GL006", "GL007", "GL008", "GL009", "GL010",
+    ):
         assert rule_id in out
 
 
@@ -830,3 +1224,114 @@ def test_cli_json_format(tmp_path, capsys):
     payload = json.loads(capsys.readouterr().out)
     assert rc == 1
     assert payload["findings"][0]["rule"] == "GL003"
+
+
+def test_cli_github_format_emits_workflow_commands(tmp_path, capsys):
+    """--format github prints one ::error annotation per finding (the
+    Actions runner turns these into inline PR comments) and keeps the
+    hard-fail exit code."""
+    (tmp_path / "operator_tpu/operator").mkdir(parents=True)
+    (tmp_path / "operator_tpu/operator/pipeline.py").write_text(
+        "class P:\n"
+        "    async def fetch(self, name):\n"
+        "        return await self.api.get('Pod', name, 'ns')\n"
+    )
+    rc = cli_main(["--root", str(tmp_path), "--format", "github"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "::error file=operator_tpu/operator/pipeline.py,line=" in out
+    assert "title=GL003" in out
+
+
+def test_cli_github_format_escapes_newlines_and_percent(capsys):
+    from operator_tpu.analysis.__main__ import _github_line
+    from operator_tpu.analysis.core import Finding
+
+    line = _github_line(Finding(
+        rule="GL001", path="a.py", line=3,
+        message="100% sync\nsecond line",
+    ))
+    assert "%25" in line and "%0A" in line
+    assert "\n" not in line
+
+
+def test_cli_timings_prints_per_rule_wall_time(tmp_path, capsys):
+    (tmp_path / "operator_tpu").mkdir()
+    (tmp_path / "operator_tpu/mod.py").write_text("X = 1\n")
+    rc = cli_main(["--root", str(tmp_path), "--timings"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "timing: GL001" in out
+    assert "timing: GL010" in out
+    assert "ms" in out
+
+
+def _git(tmp_path, *argv):
+    import subprocess
+
+    subprocess.run(
+        [
+            "git", "-C", str(tmp_path),
+            "-c", "user.email=lint@test", "-c", "user.name=lint",
+            *argv,
+        ],
+        check=True, capture_output=True,
+    )
+
+
+def test_cli_changed_only_lints_only_the_diff(tmp_path, capsys):
+    """--changed-only REF analyses files differing from REF (plus
+    untracked) — a pre-existing finding in an UNCHANGED file must not
+    block the pre-commit run."""
+    import shutil
+
+    if shutil.which("git") is None:
+        pytest.skip("git not available")
+    (tmp_path / "operator_tpu/operator").mkdir(parents=True)
+    dirty = tmp_path / "operator_tpu/operator/pipeline.py"
+    dirty.write_text(
+        "class P:\n"
+        "    async def fetch(self, name):\n"
+        "        return await self.api.get('Pod', name, 'ns')\n"
+    )
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    # the committed tree has a finding; the changed set is empty
+    rc = cli_main(["--root", str(tmp_path), "--changed-only", "HEAD"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "no .py files differ" in out
+    # an untracked file with a finding IS in the changed set
+    extra = tmp_path / "operator_tpu/operator/providers.py"
+    extra.write_text(
+        "class Q:\n"
+        "    async def probe(self, name):\n"
+        "        return await self.api.get('Pod', name, 'ns')\n"
+    )
+    rc = cli_main(["--root", str(tmp_path), "--changed-only", "HEAD"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "providers.py" in out
+    assert "pipeline.py" not in out
+
+
+def test_cli_changed_only_bad_ref_is_usage_error(tmp_path, capsys):
+    import shutil
+
+    if shutil.which("git") is None:
+        pytest.skip("git not available")
+    (tmp_path / "operator_tpu").mkdir()
+    _git(tmp_path, "init", "-q")
+    rc = cli_main([
+        "--root", str(tmp_path), "--changed-only", "no-such-ref",
+    ])
+    assert rc == 2
+
+
+def test_cli_changed_only_excludes_explicit_paths(tmp_path, capsys):
+    rc = cli_main([
+        "--root", str(tmp_path), "--changed-only", "HEAD", "some/path.py",
+    ])
+    assert rc == 2
+    assert "mutually exclusive" in capsys.readouterr().err
