@@ -150,9 +150,11 @@ async def run_open_loop(
         "achieved_per_min": report["achieved_per_min"],
         "completed": total["completed"],
         "attainment": total["attainment"],
+        "degraded": total.get("degraded", 0),
         "shed": total["shed"],
         "deadline_exceeded": total["deadline_exceeded"],
         "failed": total["failed"],
+        "overload": report.get("overload"),
         "goodput_tokens_s": total["goodput_tokens_s"],
         "goodput_analyses_per_min": total["goodput_analyses_per_min"],
         "p50_s": (interactive.get("p50_s")
@@ -860,12 +862,24 @@ def main() -> None:
                         EngineReplica("bench-engine", serving,
                                       max_tokens=max_tokens),
                     ]
-                result = await run_open_loop(
-                    storm_replicas,
-                    rate_per_min=rate, duration_s=open_seconds,
-                    seed=loadgen_seed, time_scale=open_time_scale,
-                    drain_s=max(30.0, open_seconds),
-                )
+                try:
+                    result = await run_open_loop(
+                        storm_replicas,
+                        rate_per_min=rate, duration_s=open_seconds,
+                        seed=loadgen_seed, time_scale=open_time_scale,
+                        drain_s=max(30.0, open_seconds),
+                    )
+                except Exception as exc:
+                    # a broken storm lane must FAIL LOUDLY in the record —
+                    # BENCH_r04/r05 shipped a null SLO headline because the
+                    # lane died silently and nothing said why
+                    msg = (f"open-loop storm @{rate:.0f}/min raised "
+                           f"{type(exc).__name__}: {exc}")
+                    log(f"OPEN-LOOP LANE FAILED: {msg}")
+                    open_results.append(
+                        {"rate_per_min": rate, "error": msg}
+                    )
+                    continue
                 log(f"open-loop @{rate:.0f}/min: "
                     f"attainment={result['attainment']} "
                     f"p50={result['p50_s']}s shed={result['shed']} "
@@ -933,12 +947,35 @@ def main() -> None:
         f"decode~{tokens_s:.0f} tok/s  throughput={per_min:.1f} expl/min")
     degraded = platform == "cpu-fallback"
     # SLO verdict from the OPEN-loop phase (the honest p50 under sustained
-    # arrivals); closed-batch p50 is a queueing artifact kept for continuity
+    # arrivals); closed-batch p50 is a queueing artifact kept for continuity.
+    # A null verdict must carry its gating reason (open_loop_gate below) —
+    # never the silent null of BENCH_r04/r05
     slo = None
-    for result in sorted(open_results, key=lambda r: r["rate_per_min"]):
-        if result["rate_per_min"] >= 100 and result["p50_s"] is not None:
+    slo_gate_reason = None
+    judged = [
+        r for r in sorted(open_results, key=lambda r: r["rate_per_min"])
+        if r["rate_per_min"] >= 100
+    ]
+    for result in judged:
+        if "error" not in result and result.get("p50_s") is not None:
             slo = bool(result["p50_s"] < 2.0)
             break  # the lowest swept rate >= 100/min, regardless of input order
+    if slo is None:
+        if not open_enabled:
+            slo_gate_reason = "BENCH_OPEN=0: storm lane disabled by env"
+        elif not judged:
+            slo_gate_reason = (
+                f"no swept rate >= 100/min to judge "
+                f"(BENCH_SWEEP/BENCH_RATE gave {rates})"
+            )
+        elif "error" in judged[0]:
+            slo_gate_reason = judged[0]["error"]
+        else:
+            slo_gate_reason = (
+                "zero completed analyses at >= 100/min "
+                "(p50 null in every judged storm)"
+            )
+        log(f"open-loop SLO headline is null: {slo_gate_reason}")
     print(json.dumps({
         "metric": "explanations_per_min",
         "value": round(per_min, 1),
@@ -949,6 +986,8 @@ def main() -> None:
         "p99_latency_s": round(p99, 3),
         "open_loop": open_results,
         "open_loop_p50_under_2s_at_100pm": slo,
+        # why the headline above is null, when it is (never silently null)
+        "open_loop_gate": {"ran": slo is not None, "reason": slo_gate_reason},
         "decode_tokens_per_s": round(tokens_s, 1),
         # end-to-end MFU incl. host/queueing time — a decode-only step MFU
         # would be higher; this is the honest number for the whole pipeline

@@ -51,7 +51,9 @@ def emitted_metrics(root: pathlib.Path) -> set[str]:
     for path in (root / "operator_tpu").rglob("*.py"):
         text = path.read_text(encoding="utf-8", errors="replace")
         for args in INCR_CALL.findall(text):
-            for name in STRING.findall(args):
+            # the labels= kwarg of a labeled counter carries label KEYS
+            # ("reason", "slo_class"), not metric names — stop before it
+            for name in STRING.findall(args.split("labels=")[0]):
                 metrics.add(f"podmortem_{name}_total")
         for args in OBSERVE_CALL.findall(text):
             for name in STRING.findall(args):

@@ -10,6 +10,26 @@ LOUDLY unless:
 - the arrival schedule replays byte-identically (two independent
   materialisations, equal fingerprints).
 
+With ``LOADGEN_OVERLOAD=1`` it instead runs the 2×-collapse overload
+pass (router/value.py, docs/ROBUSTNESS.md "Degradation ladder"):
+``storm.simulate_overload`` replays the seeded arrival schedule against
+a virtual-clock queue through the PRODUCTION ``OverloadPolicy`` /
+``ValueModel`` — deterministic by construction, so the gate means the
+same thing on an idle laptop and a thrashing CI runner (a live-stack
+gate flakes both ways: a fast host never overloads, a contended one
+cliffs on wall-clock targets regardless of the ladder).  The sweep runs
+0.5×..2× around the collapse rate (``LOADGEN_COLLAPSE_RATE_PER_MIN``,
+default 900) and is gated on
+
+- NO-CLIFF decay: total and per-class attainment between adjacent
+  sweep points never drops more than ``LOADGEN_MAX_ATTAINMENT_STEP``;
+- ZERO value-shed events in any protected class (below its attainment
+  target at decision time) anywhere in the sweep;
+- the ladder actually engaged at 2× (degraded or shed something) —
+  a sweep that never overloads would make both gates hollow;
+- byte-identical replay: the 2× point re-run with the same seed must
+  reproduce the identical result row and decision-log sha256 (GL007).
+
 Exit code 0 = all gates green; 1 = a gate failed (printed to stderr).
 """
 
@@ -22,7 +42,12 @@ import sys
 import tempfile
 
 from .arrivals import ArrivalProcess, ArrivalSpec
-from .storm import SyntheticReplica, build_storm_stack, run_storm
+from .storm import (
+    SyntheticReplica,
+    build_storm_stack,
+    run_storm,
+    simulate_overload,
+)
 
 
 def _fail(msg: str) -> None:
@@ -113,5 +138,86 @@ async def _main() -> None:
     print("loadgen smoke: OK")
 
 
+def _engaged(row: dict) -> bool:
+    return bool(row["shed_total"] or row["degraded_total"])
+
+
+def _overload_main() -> None:
+    """The 2×-collapse overload pass (LOADGEN_OVERLOAD=1).
+
+    Pure virtual-time simulation (storm.simulate_overload) riding the
+    production value ladder — no event loop, no wall clocks, so the
+    gates below hold identically on any machine under any load."""
+    seed = int(os.environ.get("LOADGEN_SEED", "0") or 0)
+    duration = float(os.environ.get("LOADGEN_OVERLOAD_DURATION_S", "60"))
+    max_step = float(os.environ.get("LOADGEN_MAX_ATTAINMENT_STEP", "0.15"))
+    collapse = float(
+        os.environ.get("LOADGEN_COLLAPSE_RATE_PER_MIN", "") or 900.0
+    )
+
+    rows: "list[dict]" = []
+    for factor in (0.5, 0.75, 1.0, 1.5, 2.0):
+        row = simulate_overload(
+            collapse * factor, seed=seed, duration_s=duration,
+        )
+        row["factor"] = factor
+        rows.append(row)
+
+    # gate 1: NO-CLIFF — attainment decays smoothly across the sweep
+    for prev, cur in zip(rows, rows[1:]):
+        pairs = [("total", prev["attainment"], cur["attainment"])]
+        for cls, prev_att in prev["attainment_by_class"].items():
+            pairs.append((cls, prev_att, cur["attainment_by_class"].get(cls)))
+        for name, a, b in pairs:
+            if a is None or b is None:
+                continue
+            if a - b > max_step:
+                _fail(
+                    f"attainment CLIFF for {name}: {a} at "
+                    f"{prev['factor']}x -> {b} at {cur['factor']}x "
+                    f"(max smooth step {max_step})"
+                )
+
+    # gate 2: the ladder never value-shed a class that was protected at
+    # decision time, anywhere in the sweep (the sim counts these causally)
+    for row in rows:
+        if row["protected_shed"]:
+            _fail(
+                f"{row['protected_shed']} protected-class requests were "
+                f"value-shed at {row['factor']}x "
+                f"({row['rate_per_min']:.0f}/min)"
+            )
+
+    # gate 3: the ladder ENGAGED at 2x — otherwise gates 1-2 are hollow
+    peak = rows[-1]
+    if not _engaged(peak):
+        _fail(
+            "overload ladder never fired at 2x collapse "
+            f"({peak['rate_per_min']:.0f}/min) — raise "
+            "LOADGEN_COLLAPSE_RATE_PER_MIN"
+        )
+
+    # gate 4: byte-identical replay of the 2x point (GL007) — same seed,
+    # same knobs, identical result row INCLUDING the decision-log sha256
+    replay = simulate_overload(
+        collapse * 2.0, seed=seed, duration_s=duration,
+    )
+    replay["factor"] = 2.0
+    if replay != peak:
+        drift = [
+            k for k in sorted(set(peak) | set(replay))
+            if peak.get(k) != replay.get(k)
+        ]
+        _fail(f"2x overload replay is not byte-identical: {drift} differ")
+
+    for row in rows:
+        row.pop("decision_log", None)  # sha is printed; the text is bulky
+    print(json.dumps(rows, indent=2))
+    print("loadgen overload: OK")
+
+
 if __name__ == "__main__":
-    asyncio.run(_main())
+    if os.environ.get("LOADGEN_OVERLOAD", "0") == "1":
+        _overload_main()
+    else:
+        asyncio.run(_main())

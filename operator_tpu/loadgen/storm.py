@@ -26,8 +26,9 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import heapq
 from dataclasses import dataclass
-from typing import Any, Optional
+from typing import Any, Mapping, Optional
 
 from ..obs import SLOLedger, Tracer, annotate_root, parse_slo_classes
 from ..obs.sloledger import SLO_OUTCOME_ATTR
@@ -57,7 +58,13 @@ from ..utils.config import OperatorConfig
 from ..utils.deadline import Deadline
 from ..utils.timing import MetricsRegistry
 
-from .arrivals import ArrivalEvent, ArrivalProcess
+from ..router.value import (
+    RECALL_COST_FRACTION,
+    OverloadPolicy,
+    ShedDecisionLog,
+    ValueModel,
+)
+from .arrivals import ArrivalEvent, ArrivalProcess, ArrivalSpec
 from .driver import run_open_loop
 
 __all__ = [
@@ -179,9 +186,16 @@ class SyntheticReplica:
         return self.base_ms + self.per_kb_ms * (len(logs) / 1024.0)
 
     async def serve(
-        self, request: AnalysisRequest, budget_s: Optional[float]
+        self,
+        request: AnalysisRequest,
+        budget_s: Optional[float],
+        degrade_frac: float = 1.0,
     ) -> AIResponse:
         cost_s = self.service_ms(request) * self.time_scale / 1000.0
+        if degrade_frac < 1.0:
+            # overload ladder truncated the analysis depth: a shallower
+            # answer costs proportionally less service time
+            cost_s *= max(0.05, degrade_frac)
         self.waiting += 1
         try:
             async with self._gate:
@@ -224,26 +238,36 @@ class EngineReplica:
         return self.engine.load_report()
 
     async def serve(
-        self, request: AnalysisRequest, budget_s: Optional[float]
+        self,
+        request: AnalysisRequest,
+        budget_s: Optional[float],
+        degrade_frac: float = 1.0,
     ) -> AIResponse:
         from ..serving.types import SamplingParams
 
         logs = ""
-        slo_class = None
+        slo_class = getattr(request, "slo_class", None)
         if request.failure_data is not None:
             logs = request.failure_data.logs or ""
-            slo_class = getattr(request.failure_data, "slo_class", None)
+            slo_class = slo_class or getattr(
+                request.failure_data, "slo_class", None
+            )
         prompt = f"Explain this pod failure:\n{logs[:2048]}\nRoot cause:"
         deadline = (
             self.engine.generator._clock() + budget_s
             if budget_s is not None
             else None
         )
+        max_tokens = self.max_tokens
+        if degrade_frac < 1.0:
+            max_tokens = max(1, int(max_tokens * degrade_frac))
         params = SamplingParams(
-            max_tokens=self.max_tokens,
+            max_tokens=max_tokens,
             temperature=0.0,
             deadline=deadline,
             slo_class=slo_class,
+            degraded=degrade_frac < 1.0,
+            recall_p=getattr(request, "recall_p", 0.0),
         )
         priority = CLASS_PRIORITY.get(slo_class or "", 5)
         result = await self.engine.generate(prompt, params, priority=priority)
@@ -256,6 +280,7 @@ class EngineReplica:
                 "deadline-exceeded" if result.finish_reason == "deadline"
                 and not result.completion_tokens else
                 "truncated" if result.finish_reason == "deadline"
+                else "degraded" if result.finish_reason == "degraded"
                 else "completed" if budget_s is not None else None
             ),
         )
@@ -314,11 +339,38 @@ class InProcessServingBackend:
         )
         self._feed_load()
 
+        # value-aware overload ladder (router/value.py): consult BEFORE
+        # dispatch so a storm past the collapse point degrades low-value
+        # work (shallower analysis) and sheds only the lowest-value tail,
+        # never the protected class — the router's raw pressure shed stays
+        # as the backstop underneath
+        degrade_frac = 1.0
+        if getattr(self.router, "policy", None) is not None:
+            verdict = self.router.overload_verdict(
+                value=self.router.policy.model.value(
+                    slo_class=getattr(request, "slo_class", None),
+                    residual_s=budget.remaining() if budget is not None else None,
+                    recall_p=getattr(request, "recall_p", 0.0),
+                ),
+                request_id=request_key(prompt_basis),
+                site="storm",
+            )
+            if verdict is not None and verdict.action == "shed":
+                annotate_root(SLO_OUTCOME_ATTR, "shed", overwrite=False)
+                return AIResponse(
+                    error="shed by overload ladder (lowest value at storm "
+                          "admission)",
+                    provider_id="storm",
+                    deadline_outcome="shed",
+                )
+            if verdict is not None and verdict.action == "degrade":
+                degrade_frac = verdict.degrade_tokens_frac
+
         async def send(
             replica: Replica, attempt: int, budget_s: Optional[float]
         ) -> AIResponse:
             target = self.replicas[replica.id]
-            return await target.serve(request, budget_s)
+            return await target.serve(request, budget_s, degrade_frac)
 
         try:
             outcome = await self.router.dispatch(
@@ -345,6 +397,15 @@ class InProcessServingBackend:
         response: AIResponse = outcome.response
         response.replica_id = outcome.replica_id
         response.requeues = outcome.requeues
+        if (
+            degrade_frac < 1.0
+            and response.explanation
+            and not response.error
+            and response.deadline_outcome in (None, "completed")
+        ):
+            # the ladder shortened this analysis and it still landed —
+            # a DISTINCT terminal outcome, not a deadline miss
+            response.deadline_outcome = "degraded"
         return response
 
     def fleet_view(self) -> dict:
@@ -433,6 +494,10 @@ async def build_storm_stack(
         providers=registry, tracer=Tracer(recorder=None),
         slo_ledger=ledger,
     )
+    # one value model for the whole chain: the storm backend's router
+    # consults the SAME policy (same attainment feed, same decision log)
+    # the pipeline built, so shed/degrade ordering is provable end-to-end
+    backend.router.policy = pipeline.overload_policy
     provider = AIProvider(
         metadata=ObjectMeta(name="storm", namespace=namespace),
         spec=AIProviderSpec(provider_id="storm", model_id="storm"),
@@ -474,4 +539,190 @@ async def run_storm(
         **report,
         "slo": snapshot,
         "fleet": stack.backend.fleet_view(),
+        "overload": _overload_evidence(stack),
+    }
+
+
+def _overload_evidence(stack: StormStack) -> Optional[dict]:
+    """The overload ladder's verdict for one storm: labeled shed/degrade
+    totals, per-class splits, and a digest of the decision log (two runs
+    of the same seeded storm against a deterministic pressure trace must
+    produce byte-identical logs — tests/test_value.py proves the policy
+    layer; the digest makes a live storm's log comparable at a glance)."""
+    policy = getattr(stack.pipeline, "overload_policy", None)
+    if policy is None:
+        return None
+
+    def by_class(name: str) -> "dict[str, int]":
+        out: dict[str, int] = {}
+        for key, count in stack.metrics.labeled(name).items():
+            cls = dict(key).get("slo_class", "unknown")
+            out[cls] = out.get(cls, 0) + count
+        return out
+
+    log_text = policy.log.text()
+    return {
+        "shed_total": stack.metrics.labeled_total("shed"),
+        "degraded_total": stack.metrics.labeled_total("degraded"),
+        "shed_by_class": by_class("shed"),
+        "degraded_by_class": by_class("degraded"),
+        "attainment_by_class": stack.ledger.attainment_by_class(),
+        "decisions": len(policy.log.lines()),
+        "decisions_dropped": policy.log.dropped,
+        "decision_log_sha256":
+            hashlib.sha256(log_text.encode("utf-8")).hexdigest(),
+    }
+
+
+def simulate_overload(
+    rate_per_min: float,
+    *,
+    seed: int = 0,
+    duration_s: float = 60.0,
+    servers: int = 4,
+    service_s: float = 0.35,
+    long_service_s: float = 0.9,
+    classes: Optional[Mapping[str, float]] = None,
+    shed_pressure: float = 8.0,
+    degrade_pressure: Optional[float] = None,
+    degrade_tokens_frac: float = 0.25,
+    shed_value_floor: float = 1.0,
+    attainment_target: float = 0.9,
+) -> dict:
+    """One overload storm replayed through the production value ladder in
+    VIRTUAL time — the deterministic proof surface for the 2×-collapse CI
+    pass.
+
+    The live ladder keys off measured queue pressure, which is a
+    contention signal BY DESIGN: wall-clock attainment of a 2-second
+    interactive target on a loaded CI runner says more about the runner
+    than the ladder, so a live-stack gate flakes in both directions (an
+    idle host never overloads; a contended one cliffs).  Here the same
+    seeded :class:`ArrivalProcess` schedule is replayed against an M/D/c
+    queue with a virtual clock — ``servers`` slots, deterministic
+    per-kind service times, recall hits at ~:data:`RECALL_COST_FRACTION
+    <..router.value.RECALL_COST_FRACTION>` of cold cost, degraded work
+    shortened to ``degrade_tokens_frac`` — and every arrival is decided
+    by the SAME :class:`~..router.value.OverloadPolicy` /
+    :class:`~..router.value.ValueModel` the pipeline wires, with
+    pressure = unfinished jobs at the arrival instant.  The per-class
+    attainment feeding class protection updates CAUSALLY (only jobs
+    finished strictly before the deciding arrival count), so the
+    protect-below-target loop closes exactly as it does live.
+
+    No wall clock, no ambient randomness (GL007): the same ``(seed,
+    rate, knobs)`` returns a byte-identical decision log and result row.
+    """
+    class_targets = dict(
+        classes if classes is not None
+        else {"interactive": 2.0, "standard": 30.0, "batch": 120.0}
+    )
+    events = ArrivalProcess(
+        ArrivalSpec(
+            name="poisson", rate_per_min=rate_per_min,
+            duration_s=duration_s,
+        ),
+        seed=seed,
+    ).materialize()
+    counts = {
+        c: {"admitted": 0, "attained": 0, "missed": 0,
+            "shed": 0, "degraded": 0}
+        for c in class_targets
+    }
+
+    def attainment() -> "dict[str, Optional[float]]":
+        out: "dict[str, Optional[float]]" = {}
+        for cls, k in counts.items():
+            settled = k["attained"] + k["missed"]
+            out[cls] = (k["attained"] / settled) if settled else None
+        return out
+
+    model = ValueModel(
+        class_targets, attainment=attainment,
+        attainment_target=attainment_target,
+    )
+    policy = OverloadPolicy(
+        model,
+        shed_pressure=shed_pressure,
+        degrade_pressure=degrade_pressure,
+        degrade_tokens_frac=degrade_tokens_frac,
+        shed_value_floor=shed_value_floor,
+        log=ShedDecisionLog(cap=65536),
+    )
+    free = [0.0] * max(1, int(servers))  # per-slot next-free virtual time
+    heapq.heapify(free)
+    # (finish_time, slo_class, attained) for every unfinished admitted job;
+    # its length at an arrival IS the pressure signal (queued + inflight)
+    settle: "list[tuple[float, str, bool]]" = []
+    protected_shed = 0
+    for event in events:
+        # settle jobs that finished before this arrival FIRST so the
+        # attainment feed (and therefore protection) stays causal
+        while settle and settle[0][0] <= event.at_s:
+            _, cls, ok = heapq.heappop(settle)
+            counts[cls]["attained" if ok else "missed"] += 1
+        cls = event.slo_class
+        counts.setdefault(
+            cls, {"admitted": 0, "attained": 0, "missed": 0,
+                  "shed": 0, "degraded": 0},
+        )
+        counts[cls]["admitted"] += 1
+        pressure = float(len(settle))
+        value = model.value(
+            slo_class=cls,
+            recall_p=1.0 if event.recall_hot else 0.0,
+        )
+        verdict = policy.decide(
+            value, pressure, site="sim", request_id=f"req-{event.index}",
+        )
+        if verdict.action == "shed":
+            counts[cls]["missed"] += 1
+            counts[cls]["shed"] += 1
+            if value.protected:
+                protected_shed += 1
+            continue
+        cost = long_service_s if event.kind == "long" else service_s
+        if event.recall_hot:
+            cost *= RECALL_COST_FRACTION
+        if verdict.action == "degrade":
+            counts[cls]["degraded"] += 1
+            cost *= max(0.05, verdict.degrade_tokens_frac)
+        start = max(event.at_s, heapq.heappop(free))
+        finish = start + cost
+        heapq.heappush(free, finish)
+        # a degraded completion inside its target still ATTAINS — that is
+        # the degrade-before-reject mechanism paying out (the live
+        # sloledger applies the same rule to "degraded" outcomes)
+        target = class_targets.get(cls, 0.0)
+        heapq.heappush(settle, (finish, cls, finish - event.at_s <= target))
+    while settle:
+        _, cls, ok = heapq.heappop(settle)
+        counts[cls]["attained" if ok else "missed"] += 1
+
+    att = attainment()
+    settled_total = sum(k["attained"] + k["missed"] for k in counts.values())
+    attained_total = sum(k["attained"] for k in counts.values())
+    log_text = policy.log.text()
+    return {
+        "rate_per_min": float(rate_per_min),
+        "arrivals": len(events),
+        "attainment": (
+            attained_total / settled_total if settled_total else None
+        ),
+        "attainment_by_class": att,
+        "shed_total": sum(k["shed"] for k in counts.values()),
+        "degraded_total": sum(k["degraded"] for k in counts.values()),
+        "shed_by_class": {
+            c: k["shed"] for c, k in counts.items() if k["shed"]
+        },
+        "degraded_by_class": {
+            c: k["degraded"] for c, k in counts.items() if k["degraded"]
+        },
+        "protected_shed": protected_shed,
+        "protected": sorted(model.protected_classes()),
+        "decisions": len(policy.log.lines()),
+        "decisions_dropped": policy.log.dropped,
+        "decision_log": log_text,
+        "decision_log_sha256":
+            hashlib.sha256(log_text.encode("utf-8")).hexdigest(),
     }

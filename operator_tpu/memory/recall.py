@@ -172,6 +172,30 @@ class IncidentMemory:
         )
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def hit_probability(decision: RecallDecision) -> float:
+        """How likely this request resolves from memory instead of a cold
+        analysis — the admission signal the overload value model reads
+        (router/value.py: a recall hit costs ~4% of a cold analysis, so
+        a likely-recalled request is ~25x cheaper per unit value and is
+        shed only after all cold work of equal-or-lower class).
+
+        Pure read over an already-made decision: a hit IS a reuse (1.0);
+        a known incident that could not be reused this time (no cached
+        explanation for this provider ref, reuse disabled) still predicts
+        a warm path (0.75); a near-neighbor match predicts partial reuse
+        capped by the top neighbor's similarity (<= 0.5); a miss is cold
+        (0.0)."""
+        if decision.kind == RECALL_HIT:
+            return 1.0
+        if decision.kind == RECALL_NEAR:
+            top = max((s for _, s in decision.neighbors), default=0.0)
+            return min(0.5, float(top))
+        if decision.incident is not None:
+            return 0.75
+        return 0.0
+
+    # ------------------------------------------------------------------
     def insert(
         self,
         fingerprint: FailureFingerprint,

@@ -58,7 +58,10 @@ DEFAULT_SLO_CLASSES = "interactive:2,standard:30,batch:120"
 #: refused the dispatch, so shed load is attributed as shed, not failed
 SLO_OUTCOME_ATTR = "slo_outcome"
 
-TERMINAL_OUTCOMES = ("completed", "deadline-exceeded", "shed", "failed")
+#: "degraded" is a DISTINCT terminal outcome (not conflated with
+#: deadline-exceeded): the overload ladder truncated the analysis depth
+#: but the request still finished — it attains its SLO when in budget
+TERMINAL_OUTCOMES = ("completed", "degraded", "deadline-exceeded", "shed", "failed")
 
 #: latency histogram bounds (ms): analysis SLO targets run to minutes, so
 #: the serving DEFAULT_BUCKETS_MS top of 10s would dump every batch-class
@@ -176,9 +179,12 @@ def _bucket_summary(records: "list[SLORecord]") -> dict:
     shares."""
     admitted = len(records)
     completed = [r for r in records if r.outcome == "completed"]
+    degraded = [r for r in records if r.outcome == "degraded"]
     attained = [r for r in records if r.attained]
+    # degraded requests DID finish — their latencies belong in the
+    # percentile view alongside full completions
     latencies = sorted(
-        r.latency_s for r in completed if r.latency_s is not None
+        r.latency_s for r in completed + degraded if r.latency_s is not None
     )
     shed = sum(1 for r in records if r.outcome == "shed")
     deadline_exceeded = sum(
@@ -193,6 +199,7 @@ def _bucket_summary(records: "list[SLORecord]") -> dict:
     return {
         "admitted": admitted,
         "completed": len(completed),
+        "degraded": len(degraded),
         "attained": len(attained),
         "attainment": round(len(attained) / admitted, 6) if admitted else None,
         "shed": shed,
@@ -269,6 +276,10 @@ class SLOLedger:
         self._clock = clock or time.monotonic
         self._open: dict[str, SLORecord] = {}
         self._closed: list[SLORecord] = []
+        # incremental per-class [terminal, attained] counts: the live
+        # attainment feed the overload ladder's class protection reads
+        # (O(classes), no rescan of _closed per admission decision)
+        self._class_agg: dict[str, "list[int]"] = {}
         # async_writes: finish() runs inside the analysis pipeline's async
         # path — terminal-record appends must enqueue to the writer
         # thread, not block the event loop (graftlint GL006); close()
@@ -326,10 +337,18 @@ class SLOLedger:
             record.replica = replica
         if stages:
             record.stages = dict(stages)
+        # a degraded (depth-truncated) analysis that lands in budget still
+        # attains — that trade IS the degradation ladder's point: smooth
+        # attainment decay under storm instead of a reject cliff
         record.attained = (
-            outcome == "completed" and record.latency_s <= record.target_s
+            outcome in ("completed", "degraded")
+            and record.latency_s <= record.target_s
         )
         self._closed.append(record)
+        agg = self._class_agg.setdefault(record.cls, [0, 0])
+        agg[0] += 1
+        if record.attained:
+            agg[1] += 1
         if self._journal is not None:
             self._journal.append(record.to_dict())
         m = self.metrics
@@ -337,6 +356,8 @@ class SLOLedger:
             m.incr("slo_attained" if record.attained else "slo_missed")
             if outcome == "shed":
                 m.incr("slo_shed")
+            elif outcome == "degraded":
+                m.incr("slo_degraded")
             elif outcome == "deadline-exceeded":
                 m.incr("slo_deadline_exceeded")
             elif outcome == "failed":
@@ -362,6 +383,16 @@ class SLOLedger:
     @property
     def records(self) -> "list[SLORecord]":
         return list(self._closed)
+
+    def attainment_by_class(self) -> "dict[str, Optional[float]]":
+        """Live per-class attainment fraction over terminal records (None
+        until a class has any) — the feed ``router/value.py``'s
+        ValueModel protection reads, so "never shed the class already
+        below its attainment target" tracks reality, not a snapshot."""
+        out: dict[str, Optional[float]] = {}
+        for cls, (terminal, attained) in self._class_agg.items():
+            out[cls] = round(attained / terminal, 6) if terminal else None
+        return out
 
     def pending_by_class(self) -> "dict[str, int]":
         depth: dict[str, int] = {}

@@ -15,7 +15,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 from ..memory import (
     RECALL_HIT,
@@ -75,6 +75,7 @@ class AnalysisPipeline:
         tracer: Optional[Tracer] = None,
         claims: Optional[ClaimLedger] = None,
         slo_ledger: Optional[SLOLedger] = None,
+        overload_policy: Optional[Any] = None,
     ) -> None:
         self.api = api
         self.engine = engine
@@ -131,6 +132,38 @@ class AnalysisPipeline:
             self.config.breaker_reset_s,
             clock=self._clock,
         )
+        # value-aware overload ladder (router/value.py, docs/ROBUSTNESS.md
+        # "Degradation ladder"): ONE model shared by every shed site —
+        # the router's pre-dispatch verdict, the scheduler's queue
+        # eviction, and admission's degrade clamp — fed live per-class
+        # attainment from the SLO ledger so the class already below its
+        # target is never shed.  Injectable for tests; the default builds
+        # from config knobs.
+        if overload_policy is not None:
+            self.overload_policy = overload_policy
+        else:
+            from ..router.value import OverloadPolicy, ValueModel
+
+            self.overload_policy = OverloadPolicy(
+                ValueModel(
+                    parse_slo_classes(self.config.slo_classes),
+                    attainment=self.slo_ledger.attainment_by_class,
+                    attainment_target=self.config.slo_attainment_target,
+                ),
+                shed_pressure=self.config.shed_pressure,
+                degrade_pressure=(
+                    self.config.degrade_pressure
+                    if self.config.degrade_pressure > 0 else None
+                ),
+                degrade_tokens_frac=self.config.degrade_max_tokens_frac,
+                shed_value_floor=self.config.shed_value_floor,
+                metrics=self.metrics,
+            )
+        # hand the ladder to every provider that routes dispatches
+        # (OpenAICompatProvider.router_for stamps it onto its router)
+        for provider in getattr(self.providers, "_providers", {}).values():
+            if hasattr(provider, "overload_policy"):
+                provider.overload_policy = self.overload_policy
 
     def _deadline_total_for(self, podmortem: Podmortem) -> float:
         """One CR's full envelope in seconds: spec.analysisDeadline when
@@ -619,6 +652,17 @@ class AnalysisPipeline:
                             recall.fingerprint.digest if recall is not None
                             else None
                         ),
+                        # overload-value signals (router/value.py): the
+                        # SLO class weights the shed decision and the
+                        # recall-hit probability discounts the expected
+                        # cost — recalled work is shed last
+                        slo_class=(pod.metadata.annotations or {}).get(
+                            "podmortem.io/slo-class"
+                        ),
+                        recall_p=(
+                            IncidentMemory.hit_probability(recall)
+                            if recall is not None else 0.0
+                        ),
                     )
                 self._record_deadline_outcome(ai_response)
                 if ai_response is not None:
@@ -628,6 +672,14 @@ class AnalysisPipeline:
                         # the terminal deadline outcome — the black-box trigger
                         annotate_root(
                             "blackbox", "deadline-exceeded", overwrite=False
+                        )
+                    if ai_response.deadline_outcome in ("degraded", "shed"):
+                        # the overload ladder's verdict settles the SLO
+                        # record under its own outcome (the ledger's
+                        # finally reads this override)
+                        annotate_root(
+                            SLO_OUTCOME_ATTR, ai_response.deadline_outcome,
+                            overwrite=False,
                         )
                     if ai_response.error:
                         explain_span.status = "error"
@@ -817,6 +869,8 @@ class AnalysisPipeline:
             self.metrics.incr("deadline_completed")
         elif outcome == "truncated":
             self.metrics.incr("deadline_truncated")
+        elif outcome == "degraded":
+            self.metrics.incr("deadline_degraded")
         elif outcome == "deadline-exceeded":
             self.metrics.incr("deadline_exceeded")
 
@@ -832,6 +886,8 @@ class AnalysisPipeline:
         prior_incidents: Optional[list[PriorIncident]] = None,
         provider: Optional[AIProvider] = None,
         fingerprint: Optional[str] = None,
+        slo_class: Optional[str] = None,
+        recall_p: float = 0.0,
     ) -> AIResponse:
         ref = podmortem.spec.ai_provider_ref
         namespace = ref.namespace or podmortem.metadata.namespace or "default"
@@ -867,6 +923,7 @@ class AnalysisPipeline:
             failure_data=failure, deadline_s=remaining,
             prior_incidents=list(prior_incidents or []),
             fingerprint=fingerprint,
+            slo_class=slo_class, recall_p=recall_p,
         )
 
         cache_key = None

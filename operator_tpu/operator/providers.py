@@ -317,6 +317,11 @@ class OpenAICompatProvider:
         #: outbound attempt under site "http.provider" (ctx: attempt,
         #: replica) — replica kills/partitions inject here
         self.fault_plan = None
+        #: value-aware overload ladder (router/value.py): the pipeline
+        #: stamps its policy here; router_for hands it to every router so
+        #: the pre-dispatch verdict (shed / degrade / serve) and the
+        #: supervisor requeue discipline share one value model
+        self.overload_policy = None
         self._metrics = metrics
         self._router_vnodes = router_vnodes
         self._shed_pressure = shed_pressure
@@ -343,6 +348,7 @@ class OpenAICompatProvider:
             )
             self._routers[key] = router
         router.fault_plan = self.fault_plan
+        router.policy = self.overload_policy
         return router
 
     def fleet_view(self) -> dict:
@@ -443,10 +449,50 @@ class OpenAICompatProvider:
         from ..serving.prompts import build_prompt  # shared with tpu-native path
 
         prompt = build_prompt(request)
+        router = self.router_for(replicas)
+        # value-aware overload ladder (router/value.py): consult the
+        # policy BEFORE building the dispatch — shed returns here with no
+        # network traffic at all; degrade truncates analysis depth AND
+        # drops the cross-replica requeue allowance to 1 attempt (a
+        # depth-truncated answer is not worth a second replica's time —
+        # the supervisor-requeue leg of shed-lowest-value-first)
+        max_tokens = max(1, config.max_tokens)
+        attempts = max(1, config.max_retries)
+        degraded = False
+        if router.policy is not None:
+            verdict = router.overload_verdict(
+                value=router.policy.model.value(
+                    slo_class=request.slo_class,
+                    residual_s=request.deadline_s,
+                    recall_p=request.recall_p,
+                ),
+                request_id=request_key(prompt),
+                site="provider",
+            )
+            if verdict is not None and verdict.action == "shed":
+                from ..obs import annotate_root
+                from ..obs.sloledger import SLO_OUTCOME_ATTR
+
+                annotate_root(SLO_OUTCOME_ATTR, "shed", overwrite=False)
+                return AIResponse(
+                    error=(
+                        "request shed by overload ladder: lowest value "
+                        "under storm (router/value.py)"
+                    ),
+                    provider_id=config.provider_id,
+                    model_id=config.model_id,
+                    deadline_outcome="shed",
+                )
+            if verdict is not None and verdict.action == "degrade":
+                max_tokens = max(
+                    16, int(max_tokens * verdict.degrade_tokens_frac)
+                )
+                attempts = 1
+                degraded = True
         body = {
             "model": config.model_id,
             "messages": [{"role": "user", "content": prompt}],
-            "max_tokens": config.max_tokens,
+            "max_tokens": max_tokens,
             "temperature": config.temperature,
         }
         payload_bytes = json.dumps(body).encode()
@@ -511,7 +557,6 @@ class OpenAICompatProvider:
             if request.deadline_s is not None
             else None
         )
-        router = self.router_for(replicas)
         # affinity: recurrences follow the incident fingerprint (recall
         # caches are per replica), first sightings follow the shared
         # prompt prefix (the prefix-cache reuse unit)
@@ -524,8 +569,8 @@ class OpenAICompatProvider:
                 key=affinity,
                 request_id=request_id,
                 deadline=budget,
-                attempts=max(1, config.max_retries),
-                tokens=max(1, config.max_tokens),
+                attempts=attempts,
+                tokens=max_tokens,
             )
         except RouterError as exc:
             deadline_spent = budget is not None and budget.remaining() <= 0.0
@@ -547,4 +592,8 @@ class OpenAICompatProvider:
         # flight recorder's span attrs and status entries both read it
         response.replica_id = outcome.replica_id
         response.requeues = outcome.requeues
+        if degraded and response.explanation and not response.error:
+            # the ladder truncated this analysis's depth: a DISTINCT
+            # terminal outcome, not conflated with deadline truncation
+            response.deadline_outcome = "degraded"
         return response

@@ -187,6 +187,11 @@ class AnalysisStorageService:
             # the budget — not the backend — killed the AI leg; operators
             # alert on this string (and podmortem_deadline_exceeded_total)
             analysis_status = "deadline-exceeded"
+        elif deadline_outcome == "degraded":
+            # the overload ladder truncated analysis depth but the leg
+            # still produced text — a DISTINCT terminal status, not a
+            # deadline miss (podmortem_deadline_degraded_total)
+            analysis_status = "degraded"
         entry = PodFailureStatus(
             pod_name=pod.metadata.name,
             pod_namespace=pod.metadata.namespace,

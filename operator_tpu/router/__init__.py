@@ -27,6 +27,13 @@ from .health import (
 )
 from .resume import ResumeLog
 from .ring import HashRing
+from .value import (
+    OverloadPolicy,
+    OverloadVerdict,
+    RequestValue,
+    ShedDecisionLog,
+    ValueModel,
+)
 
 __all__ = [
     "BreakerBoard",
@@ -34,12 +41,17 @@ __all__ = [
     "EngineRouter",
     "HashRing",
     "HealthBoard",
+    "OverloadPolicy",
+    "OverloadVerdict",
     "Replica",
     "ReplicaHealth",
     "ReplicaLoad",
+    "RequestValue",
     "ResumeLog",
     "RouteDecision",
     "RouteOutcome",
     "RouterError",
+    "ShedDecisionLog",
+    "ValueModel",
     "request_key",
 ]

@@ -140,6 +140,12 @@ class EngineRouter:
             self.add(replica)
         #: opt-in chaos seam (utils/faultinject.py), site "router.dispatch"
         self.fault_plan = None
+        #: value-aware overload ladder (router/value.py OverloadPolicy):
+        #: callers consult overload_verdict() BEFORE dispatching so the
+        #: router can degrade or shed by value, not arrival order.
+        #: None = pre-overload-control semantics (route() still sheds to
+        #: a less-loaded replica, it just never drops work itself).
+        self.policy = None
 
     # -- membership ----------------------------------------------------
     def add(self, replica: "Replica | str") -> None:
@@ -261,6 +267,43 @@ class EngineRouter:
             affinity_owner=owner,
             shed=chosen != owner,
         )
+
+    def fleet_pressure(self) -> Optional[float]:
+        """The LEAST-loaded healthy replica's queue pressure — the best
+        offer the fleet can make a new request.  None when no replica is
+        healthy (route() would return None anyway)."""
+        pressures = [
+            self.health.for_replica(rid).load.pressure()
+            for rid in self._replicas
+            if self.health.can_route(rid)
+        ]
+        return min(pressures) if pressures else None
+
+    def overload_verdict(
+        self,
+        *,
+        value=None,
+        request_id: str = "",
+        site: str = "router",
+    ):
+        """Consult the value ladder (``self.policy``) for one request
+        BEFORE dispatch: returns an ``OverloadVerdict`` (serve / degrade
+        / shed) or None when no policy is wired, no value was scored, or
+        no replica is healthy (the route itself will fail then — a shed
+        verdict on top would misattribute the outcome)."""
+        if self.policy is None or value is None:
+            return None
+        pressure = self.fleet_pressure()
+        if pressure is None:
+            return None
+        verdict = self.policy.decide(
+            value, pressure, site=site, request_id=request_id
+        )
+        if verdict.action == "shed":
+            self.metrics.incr("router_value_shed")
+        elif verdict.action == "degrade":
+            self.metrics.incr("router_value_degraded")
+        return verdict
 
     # -- dispatch ------------------------------------------------------
     async def dispatch(

@@ -61,9 +61,14 @@ def fleet_rollup(replicas: dict) -> dict:
     kv_free = kv_total = 0
     hit_w = 0.0
     hit_lookups = 0
+    # overload-ladder totals (router/value.py): plain sums — shed and
+    # degraded counts add across replicas
+    shed = degraded = 0
     for row in replicas.values():
         queue_depth += int(row.get("queueDepth") or 0)
         inflight += int(row.get("inflight") or 0)
+        shed += int(row.get("shedTotal") or 0)
+        degraded += int(row.get("degradedTotal") or 0)
         weight = max(1, int(row.get("steps") or 0))
         if row.get("decodeMfu") is not None:
             mfu_w += float(row["decodeMfu"]) * weight
@@ -102,6 +107,8 @@ def fleet_rollup(replicas: dict) -> dict:
         "prefixHitRate": (
             round(hit_w / hit_lookups, 6) if hit_lookups else None
         ),
+        "shedTotal": shed,
+        "degradedTotal": degraded,
     }
 
 
@@ -276,6 +283,11 @@ class ReplicaLoad:
     prefix_hit_rate: Optional[float] = None
     prefix_lookups: int = 0
     kv_blocks: Optional[list] = None
+    #: value-aware overload ladder totals (router/value.py): requests
+    #: this replica shed (dropped by value) and served degraded
+    #: (depth-truncated) — rolled up fleet-wide by ``fleet_rollup``
+    shed: int = 0
+    degraded: int = 0
 
     def pressure(self) -> int:
         """Scalar queue pressure used for least-loaded comparison."""
@@ -327,6 +339,8 @@ class ReplicaLoad:
             ),
             "kvLookups": self.prefix_lookups,
             "kvBlocks": self.kv_blocks,
+            "shedTotal": self.shed,
+            "degradedTotal": self.degraded,
         }
 
     @classmethod
@@ -364,6 +378,8 @@ class ReplicaLoad:
                 [str(h) for h in data["kvBlocks"]]
                 if isinstance(data.get("kvBlocks"), list) else None
             ),
+            shed=int(data.get("shedTotal") or 0),
+            degraded=int(data.get("degradedTotal") or 0),
         )
 
 
@@ -518,6 +534,8 @@ class HealthBoard:
                 "kvPagesTotal": load.kv_pages_total,
                 "prefixHitRate": load.prefix_hit_rate,
                 "kvLookups": load.prefix_lookups,
+                "shedTotal": load.shed,
+                "degradedTotal": load.degraded,
             }
         return {"replicas": replicas, "fleet": fleet_rollup(replicas)}
 

@@ -232,6 +232,15 @@ class AnalysisRequest:
     #: incident memory computed one — the router's first-choice affinity
     #: key, so recurrences land on the replica whose recall cache is hot
     fingerprint: Optional[str] = None
+    #: SLO class this analysis is accounted under (obs/sloledger.py) —
+    #: the overload value model (router/value.py) weights shed decisions
+    #: by it.  None = the ledger's default class.
+    slo_class: Optional[str] = None
+    #: recall-hit probability (memory/recall.py hit_probability): how
+    #: likely this request resolves from incident memory instead of a
+    #: cold analysis — a recalled request costs ~4% of a cold one, so
+    #: this rides into its overload value score
+    recall_p: float = 0.0
 
     def to_dict(self) -> dict[str, Any]:
         return to_dict(self)
@@ -254,8 +263,11 @@ class AIResponse:
     cached: bool = False
     error: Optional[str] = None
     #: deadline-budget outcome: "completed" | "truncated" (output clamped
-    #: to fit the residual budget) | "deadline-exceeded" (no AI text;
-    #: pipeline degrades to pattern-only).  None = budget not involved.
+    #: to fit the residual budget) | "degraded" (overload ladder reduced
+    #: analysis depth — distinct from deadline truncation) | "shed" (the
+    #: ladder dropped the request; no AI text) | "deadline-exceeded" (no
+    #: AI text; pipeline degrades to pattern-only).  None = budget not
+    #: involved.
     deadline_outcome: Optional[str] = None
     #: which serving replica produced this response (operator_tpu/router/)
     #: — flight-recorder spans and routing forensics read it.  None =

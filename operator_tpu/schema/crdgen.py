@@ -63,7 +63,10 @@ _POD_FAILURE_STATUS = _obj(
         "severity": _STR,
         "deadlineOutcome": {
             "type": "string",
-            "enum": ["completed", "truncated", "deadline-exceeded"],
+            "enum": [
+                "completed", "truncated", "degraded", "shed",
+                "deadline-exceeded",
+            ],
         },
         # incident-memory classification (operator_tpu/memory/): stable
         # failure fingerprint + fleet-wide recurrence accounting

@@ -72,12 +72,14 @@ class PodFailureStatus:
     pod_name: Optional[str] = None
     pod_namespace: Optional[str] = None
     failure_time: Optional[str] = None
-    analysis_status: Optional[str] = None  # Analyzed|PatternOnly|Failed|deadline-exceeded
+    analysis_status: Optional[str] = None  # Analyzed|PatternOnly|Failed|degraded|deadline-exceeded
     explanation: Optional[str] = None
     severity: Optional[str] = None
     #: deadline-budget outcome for the AI leg (utils/deadline.py):
     #: completed | truncated (max_tokens clamped to fit the residual
-    #: budget) | deadline-exceeded (degraded to pattern-only)
+    #: budget) | degraded (overload ladder reduced analysis depth,
+    #: router/value.py) | shed (ladder dropped the request) |
+    #: deadline-exceeded (degraded to pattern-only)
     deadline_outcome: Optional[str] = None
     #: incident-memory classification (None when memory is disabled)
     recurrence: Optional[FailureRecurrence] = None

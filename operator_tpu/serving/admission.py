@@ -62,24 +62,71 @@ class AdmissionMixin:
         return self.roofline_token_s or 0.0
 
     def deadline_policy(
-        self, params: SamplingParams, *, now: "float | None" = None
+        self,
+        params: SamplingParams,
+        *,
+        now: "float | None" = None,
+        pressure: "float | None" = None,
     ) -> "tuple[SamplingParams, str]":
         """(possibly clamped params, outcome) for one request's budget.
 
         Outcomes: ``"ok"`` (fits, untouched), ``"truncated"``
         (``max_tokens`` clamped to the roofline fit, ``deadline_clamped``
-        set so the finish reason reads "deadline"), ``"rejected"`` (the
-        residue cannot fit even one token).  Requests without a deadline
-        always pass untouched."""
+        set so the finish reason reads "deadline"), ``"degraded"``
+        (overload ladder scaled ``max_tokens`` down — degrade-before-
+        reject, router/value.py), ``"shed"`` (the ladder dropped the
+        request outright: lowest value under storm, class unprotected),
+        ``"rejected"`` (the residue cannot fit even one token).  Requests
+        without a deadline pass the deadline leg untouched but can still
+        be degraded or shed under pressure.
+
+        ``pressure`` is the caller's load signal (queued + running rows):
+        when an ``overload_policy`` is wired (serving mixins default to
+        None) the ladder may truncate analysis depth BEFORE the deadline
+        math, so the clamp sees the already-reduced ask."""
+        policy = getattr(self, "overload_policy", None)
+        degraded = False
+        if (
+            policy is not None
+            and pressure is not None
+            and not params.degraded
+        ):
+            residual = None
+            if params.deadline is not None:
+                residual = params.deadline - (
+                    self._clock() if now is None else now
+                )
+            value = policy.model.value(
+                slo_class=params.slo_class,
+                residual_s=residual,
+                recall_p=params.recall_p,
+            )
+            verdict = policy.decide(
+                value, pressure, site="admission",
+                request_id=params.trace_tag or "",
+            )
+            if verdict.action == "shed":
+                return params, "shed"
+            if verdict.action == "degrade":
+                params = dataclasses.replace(
+                    params,
+                    max_tokens=max(
+                        1,
+                        int(params.max_tokens * verdict.degrade_tokens_frac),
+                    ),
+                    degraded=True,
+                )
+                degraded = True
+        ok = "degraded" if degraded else "ok"
         if params.deadline is None:
-            return params, "ok"
+            return params, ok
         now = self._clock() if now is None else now
         remaining = params.deadline - now
         if remaining <= 0.0:
             return params, "rejected"
         per_token = self.decode_token_estimate_s()
         if per_token <= 0.0:
-            return params, "ok"
+            return params, ok
         fit = int(remaining / per_token)
         if fit < 1:
             return params, "rejected"
@@ -90,7 +137,7 @@ class AdmissionMixin:
                 ),
                 "truncated",
             )
-        return params, "ok"
+        return params, ok
 
     def _deadline_clamp_wave(
         self, params_list: "Sequence[SamplingParams]"

@@ -210,6 +210,10 @@ class BatchedGenerator(AdmissionMixin, ProgramBuilderMixin):
         # attribute so chaos tests can inject a fake one
         self.roofline_token_s = roofline_token_s
         self._clock = time.monotonic
+        #: value-aware overload ladder (router/value.py OverloadPolicy):
+        #: when wired, admission.deadline_policy degrades/sheds by value
+        #: under pressure; None = pre-overload-control semantics
+        self.overload_policy = None
         #: opt-in chaos seam (utils/faultinject.py): consulted per step()
         #: round — stalls and simulated device errors for recovery tests
         self.fault_plan = None
@@ -1970,6 +1974,14 @@ class ServingEngine:
             prefix_hit_rate=prefix_hit_rate,
             prefix_lookups=prefix_lookups,
             kv_blocks=kv_blocks,
+            shed=(
+                self.generator.metrics.labeled_total("shed")
+                if hasattr(self.generator.metrics, "labeled_total") else 0
+            ),
+            degraded=(
+                self.generator.metrics.labeled_total("degraded")
+                if hasattr(self.generator.metrics, "labeled_total") else 0
+            ),
         )
 
     async def start(self) -> None:

@@ -92,6 +92,7 @@ from ..types import (
     GenerationResult,
     OversizedRequest,
     SamplingParams,
+    ShedLowValue,
     _Slot,
     pages_needed,
     prompt_budget,
@@ -136,6 +137,8 @@ class Scheduler:
         spec_decode: bool = False,
         spec_lookup_k: int = 4,
         kvstore: Optional[Any] = None,
+        queue_limit: int = 0,
+        overload_policy: Optional[Any] = None,
     ) -> None:
         if not getattr(generator, "paged", False):
             raise ValueError("the continuous scheduler requires paged KV")
@@ -210,6 +213,15 @@ class Scheduler:
         #: the determinism test replays a fixed arrival trace and
         #: asserts the schedule is byte-identical
         self.plan_log: Optional[list] = None
+        #: queue eviction (router/value.py): when the submit queue holds
+        #: ``queue_limit`` entries, enqueue sheds the LOWEST-VALUE
+        #: non-protected request instead of growing without bound.
+        #: 0 = unbounded (the pre-overload-control behaviour).
+        self.queue_limit = max(0, int(queue_limit))
+        self.overload_policy = overload_policy
+        # queued requests evicted by value between steps; drained into
+        # the next step()'s outcomes so callers get a terminal error
+        self._evicted: list[StepOutcome] = []
 
     # ------------------------------------------------------------------
     # submit side
@@ -275,12 +287,71 @@ class Scheduler:
                 f"pages, cache holds {pool}"
             )
         req_id = next(self._next_req)
+        if (
+            self.queue_limit
+            and self.overload_policy is not None
+            and len(self._queue) >= self.queue_limit
+        ):
+            # queue at its limit: shed the lowest-value request — which
+            # may be the arrival itself — instead of growing unboundedly
+            self._evict_lowest_value(req_id, params)
         self._queue.append((
             req_id, tokens, params,
             submitted if submitted is not None else time.perf_counter(),
             priority,
         ))
         return req_id
+
+    def _request_value(self, params: SamplingParams, now: float):
+        """Score one request with the shared value model (residual
+        deadline on the generator's injectable clock — no wall clock,
+        GL007)."""
+        residual = (
+            None if params.deadline is None else params.deadline - now
+        )
+        return self.overload_policy.model.value(
+            slo_class=params.slo_class,
+            residual_s=residual,
+            recall_p=params.recall_p,
+        )
+
+    def _evict_lowest_value(
+        self, incoming_id: int, incoming: SamplingParams
+    ) -> None:
+        """Shed-lowest-value-first queue eviction: score every queued
+        request plus the arrival, drop the minimum non-protected one.
+        A queued victim surfaces as a :class:`ShedLowValue` StepOutcome
+        at the next step; the arrival itself losing raises straight to
+        the caller.  All-protected queues grow instead (the ladder never
+        sheds a class below its attainment target)."""
+        now = self.generator._clock()
+        pressure = len(self._queue) + len(self._rows)
+        candidates = [(str(incoming_id), self._request_value(incoming, now))]
+        by_id = {}
+        for entry in self._queue:
+            value = self._request_value(entry[2], now)
+            candidates.append((str(entry[0]), value))
+            by_id[str(entry[0])] = entry
+        victim = self.overload_policy.pick_eviction(candidates)
+        if victim is None:
+            return  # every candidate protected: let the queue grow
+        rid, value = victim
+        self.overload_policy.record_eviction(
+            rid, value, pressure=pressure, site="sched",
+        )
+        self.metrics.incr("sched_queue_evicted")
+        if rid == str(incoming_id):
+            raise ShedLowValue(
+                f"request shed at enqueue: value score "
+                f"{round(value.score, 6)} is the queue minimum at "
+                f"pressure {pressure}"
+            )
+        entry = by_id[rid]
+        self._queue.remove(entry)
+        self._evicted.append(StepOutcome(entry[0], error=ShedLowValue(
+            f"queued request evicted by higher-value arrival at "
+            f"pressure {pressure}"
+        )))
 
     def cancel(self, req_id: int) -> bool:
         """Drop a queued request or reclaim a live row's slot/pages now."""
@@ -421,6 +492,11 @@ class Scheduler:
             # device-error scenarios drive both loops identically
             g.fault_plan.apply("engine.step", active=self.num_active)
         outcomes: list[StepOutcome] = []
+        if self._evicted:
+            # value-based queue evictions since the last step surface as
+            # terminal ShedLowValue outcomes here
+            outcomes.extend(self._evicted)
+            self._evicted.clear()
         plan = self._schedule(outcomes)
         held_rows = len(self._rows)  # snapshot BEFORE commit recycles
         if self.plan_log is not None:
@@ -696,7 +772,18 @@ class Scheduler:
                 break
             head = self._edf_head()
             req_id, tokens, params, submitted, _ = self._queue[head]
-            clamped, outcome = g.deadline_policy(params)
+            clamped, outcome = g.deadline_policy(
+                params, pressure=len(self._queue) + len(self._rows)
+            )
+            if outcome == "shed":
+                # overload ladder: lowest value at admission under storm
+                del self._queue[head]
+                self.metrics.incr("admission_shed")
+                outcomes.append(StepOutcome(req_id, error=ShedLowValue(
+                    "request shed at admission: lowest value under "
+                    "overload (router/value.py ladder)"
+                )))
+                continue
             if outcome == "rejected":
                 # expired between the check above and the policy's clock
                 # read: minimal one-token clamp, same as the wave path's
@@ -1056,6 +1143,10 @@ class Scheduler:
         ids = [t for t in row.generated if t != eos]
         if reason == "length" and row.params.deadline_clamped:
             reason = "deadline"
+        elif reason == "length" and row.params.degraded:
+            # overload-truncated depth, not a deadline miss: the ladder
+            # reduced max_tokens, so hitting it IS the degraded outcome
+            reason = "degraded"
         # decode wall from the step clock's monotonic cumulative, not a
         # wall-clock delta: the SAME records /metrics and black-box dumps
         # carry, so the span and the step timeline cannot disagree

@@ -39,6 +39,16 @@ class SamplingParams:
     #: set by admission when max_tokens was clamped to fit the deadline —
     #: the finish reason then reads "deadline" instead of "length"
     deadline_clamped: bool = False
+    #: set when the overload ladder truncated analysis depth (max_tokens
+    #: scaled down under pressure, admission.deadline_policy): the finish
+    #: reason then reads "degraded" — degrade-before-reject, distinct
+    #: from deadline clamping
+    degraded: bool = False
+    #: recall-hit probability from memory/recall.py's predictor: a
+    #: recalled incident costs ~4% of a cold analysis, so this rides into
+    #: the request's overload value (router/value.py) — recalled work is
+    #: shed only after all cold work of equal-or-lower class
+    recall_p: float = 0.0
     #: obs trace id of the request's analysis (operator_tpu/obs/): the
     #: engine stamps it into its jax.profiler prefill/decode annotations
     #: so an xplane capture joins the flight recorder's timeline.  None =
@@ -56,7 +66,7 @@ class GenerationResult:
     token_ids: list[int]
     prompt_tokens: int
     completion_tokens: int
-    finish_reason: str  # "stop" | "length" | "deadline" (budget-clamped length)
+    finish_reason: str  # "stop" | "length" | "deadline" (budget-clamped) | "degraded" (overload-truncated)
     prefill_ms: float = 0.0
     #: decode wall DERIVED FROM THE STEP CLOCK (obs/steptrace.py): the
     #: cumulative attributed wall of decode-bearing steps this request
@@ -119,6 +129,13 @@ class OversizedRequest(ValueError):
 class DeadlineExceeded(RuntimeError):
     """The request's deadline budget cannot fit even one decoded token
     (rejected at submit), or expired while the request was queued."""
+
+
+class ShedLowValue(RuntimeError):
+    """The overload ladder shed this request: under storm its value score
+    (router/value.py) fell below the rising cutoff and its SLO class was
+    not protected — shed-lowest-value-first, after degradation already
+    fired."""
 
 
 def _bucket(n: int, floor: int, cap: int) -> int:

@@ -309,6 +309,30 @@ class OperatorConfig:
     # open-loop load generation (operator_tpu/loadgen/): the seed every
     # arrival-schedule draw derives from — same seed, byte-identical storm
     loadgen_seed: int = 0
+    # --- value-aware overload control (router/value.py, docs/ROBUSTNESS.md
+    # "Degradation ladder"): shed-lowest-value-first + degrade-before-reject
+    # queue pressure at which the ladder starts DEGRADING (reduced
+    # max_tokens, finish_reason "degraded") before anything is rejected;
+    # 0 = half of shed_pressure
+    degrade_pressure: int = 0
+    # fraction of max_tokens a degraded request keeps (truncated analysis
+    # depth — the first ladder rung)
+    degrade_max_tokens_frac: float = 0.25
+    # per-class attainment floor: a class whose live attainment
+    # (obs/sloledger.py attainment_by_class) is below this is PROTECTED —
+    # never shed, only degraded
+    slo_attainment_target: float = 0.9
+    # value-score bar at exactly shed_pressure; the bar rises linearly
+    # with pressure beyond it, so deeper overload sheds progressively
+    # higher-value work (smooth decay, not a cliff)
+    shed_value_floor: float = 1.0
+    # ladder shed line: queue pressure past which below-bar requests are
+    # dropped outright (router_shed_pressure stays the router's
+    # move-to-lighter-replica line; this one actually sheds)
+    shed_pressure: int = 8
+    # continuous-scheduler submit queue bound: at this depth enqueue
+    # evicts the lowest-value non-protected request (0 = unbounded)
+    sched_queue_limit: int = 0
 
     @classmethod
     def from_env(cls, env: Optional[dict[str, str]] = None) -> "OperatorConfig":

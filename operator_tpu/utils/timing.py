@@ -121,6 +121,10 @@ class MetricsRegistry:
         # rendered OpenMetrics-style so an alert on a counter links
         # straight to the trace that last bumped it
         self._exemplars: dict[str, str] = {}
+        # labeled counter series: name -> {sorted (k, v) label tuple: count}.
+        # Flat counters stay in _counters; a labeled incr ALSO bumps the
+        # flat total so existing counter() readers keep working.
+        self._labeled: dict[str, dict[tuple, int]] = {}
 
     def stage(self, name: str) -> StageStats:
         with self._lock:
@@ -167,15 +171,46 @@ class MetricsRegistry:
         with self._lock:
             return self._histograms.get(name)
 
-    def incr(self, name: str, amount: int = 1, *, exemplar: Optional[str] = None) -> None:
+    def incr(
+        self,
+        name: str,
+        amount: int = 1,
+        *,
+        exemplar: Optional[str] = None,
+        labels: Optional[dict] = None,
+    ) -> None:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + amount
             if exemplar:
                 self._exemplars[name] = exemplar
+            if labels:
+                series = self._labeled.setdefault(name, {})
+                key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+                series[key] = series.get(key, 0) + amount
 
     def counter(self, name: str) -> int:
         with self._lock:
             return self._counters.get(name, 0)
+
+    def labeled(self, name: str) -> "dict[tuple, int]":
+        """Per-series counts for a labeled counter (keyed by the sorted
+        ``(label, value)`` tuple); empty when never bumped with labels."""
+        with self._lock:
+            return dict(self._labeled.get(name, {}))
+
+    def labeled_total(
+        self, name: str, *, where: Optional[dict] = None
+    ) -> int:
+        """Sum of a labeled counter's series, optionally filtered to the
+        series whose labels include every ``where`` pair."""
+        with self._lock:
+            series = self._labeled.get(name, {})
+            if not where:
+                return sum(series.values())
+            need = {(str(k), str(v)) for k, v in where.items()}
+            return sum(
+                n for key, n in series.items() if need.issubset(set(key))
+            )
 
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
@@ -200,6 +235,14 @@ class MetricsRegistry:
                 },
                 "counters": dict(self._counters),
             }
+            if self._labeled:
+                out["labeled"] = {
+                    name: {
+                        ",".join(f"{k}={v}" for k, v in key): n
+                        for key, n in series.items()
+                    }
+                    for name, series in self._labeled.items()
+                }
             if self._histograms:
                 out["histograms"] = {
                     name: {
@@ -265,6 +308,17 @@ class MetricsRegistry:
                     lines.append(f"# TYPE {family} counter")
                 else:
                     lines.append(f"# TYPE {metric} counter")
+                series = self._labeled.get(name)
+                if series:
+                    # labeled counters expose one sample per label set (the
+                    # flat total stays on the JSON surface via counter());
+                    # emitting BOTH would double every sum() over the family
+                    for key in sorted(series):
+                        labels = ",".join(
+                            f'{sane(k)}="{v}"' for k, v in key
+                        )
+                        lines.append(f"{metric}{{{labels}}} {series[key]}")
+                    continue
                 exemplar = self._exemplars.get(name) if openmetrics else None
                 if exemplar:
                     lines.append(

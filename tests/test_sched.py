@@ -11,6 +11,7 @@ determinism for a fixed arrival trace.
 """
 
 import asyncio
+import dataclasses
 
 import numpy as np
 import pytest
@@ -357,6 +358,165 @@ class TestEDFAdmission:
             if len(order) == 3:
                 break
         assert order == ids
+
+
+# ---------------------------------------------------------------------------
+# value-aware overload: queue eviction, admission ladder, degraded finish
+# ---------------------------------------------------------------------------
+
+
+from operator_tpu.router.value import OverloadPolicy, ValueModel  # noqa: E402
+from operator_tpu.serving.types import ShedLowValue  # noqa: E402
+
+SLO_CLASSES = {"interactive": 2.0, "standard": 30.0, "batch": 120.0}
+
+
+def make_policy(**kw):
+    model = ValueModel(SLO_CLASSES, attainment=kw.pop("attainment", None))
+    kw.setdefault("shed_pressure", 8.0)
+    return OverloadPolicy(model, **kw)
+
+
+class TestValueEviction:
+    def test_full_queue_evicts_lowest_value_for_higher_value_arrival(
+        self, params
+    ):
+        """Queue at its limit: a high-class arrival displaces the
+        lowest-value QUEUED request, which surfaces as a ShedLowValue
+        StepOutcome at the next step — shed-lowest-value-first, not
+        tail-drop."""
+        generator = make_generator(params, max_slots=1)
+        policy = make_policy()
+        sched = Scheduler(generator, chunk=16, token_budget=32,
+                          queue_limit=2, overload_policy=policy)
+        sampling = SamplingParams(max_tokens=2, temperature=0.0,
+                                  stop_on_eos=False)
+        hog = sched.enqueue("holds the only slot", sampling)
+        sched.step()  # hog occupies the slot; everything below queues
+        cheap = sched.enqueue(
+            "batch class, lowest value",
+            dataclasses.replace(sampling, slo_class="batch"),
+        )
+        mid = sched.enqueue(
+            "standard class",
+            dataclasses.replace(sampling, slo_class="standard"),
+        )
+        assert sched.queue_depth == 2  # at the limit
+        urgent = sched.enqueue(
+            "interactive arrival displaces the batch request",
+            dataclasses.replace(sampling, slo_class="interactive"),
+        )
+        assert sched.queue_depth == 2  # evicted, not grown
+        done = drain(sched, 4)
+        assert isinstance(done[cheap].error, ShedLowValue)
+        for rid in (hog, mid, urgent):
+            assert done[rid].error is None, rid
+        assert generator.metrics.counter("sched_queue_evicted") == 1
+        line = policy.log.lines()[-1]
+        assert "site=sched" in line and "action=shed" in line
+        assert "reason=queue-evict" in line and "cls=batch" in line
+        assert_no_leaks(generator)
+
+    def test_lowest_value_arrival_is_shed_at_enqueue(self, params):
+        """When the ARRIVAL is the queue minimum, it is refused straight
+        at enqueue (ShedLowValue raised to the caller) and the queued
+        higher-value work is untouched."""
+        generator = make_generator(params, max_slots=1)
+        sched = Scheduler(generator, chunk=16, token_budget=32,
+                          queue_limit=2, overload_policy=make_policy())
+        sampling = SamplingParams(max_tokens=2, temperature=0.0,
+                                  stop_on_eos=False,
+                                  slo_class="interactive")
+        hog = sched.enqueue("holds the only slot", sampling)
+        sched.step()
+        queued = [sched.enqueue(f"interactive {i}", sampling)
+                  for i in range(2)]
+        with pytest.raises(ShedLowValue):
+            sched.enqueue(
+                "batch arrival loses to the interactive queue",
+                dataclasses.replace(sampling, slo_class="batch"),
+            )
+        assert sched.queue_depth == 2
+        done = drain(sched, 3)
+        assert all(done[r].error is None for r in [hog, *queued])
+        assert_no_leaks(generator)
+
+    def test_all_protected_queue_grows_instead_of_shedding(self, params):
+        """Every candidate in a class below its attainment target: the
+        ladder refuses to pick a victim and the queue grows past its
+        limit — 'never shed the SLO class already below target'."""
+        generator = make_generator(params, max_slots=1)
+        policy = make_policy(attainment=lambda: {"batch": 0.1})
+        sched = Scheduler(generator, chunk=16, token_budget=32,
+                          queue_limit=1, overload_policy=policy)
+        sampling = SamplingParams(max_tokens=2, temperature=0.0,
+                                  stop_on_eos=False, slo_class="batch")
+        hog = sched.enqueue("holds the only slot", sampling)
+        sched.step()
+        first = sched.enqueue("queued batch, protected", sampling)
+        second = sched.enqueue("another protected batch", sampling)
+        assert sched.queue_depth == 2  # grew past queue_limit=1
+        assert generator.metrics.counter("sched_queue_evicted") == 0
+        done = drain(sched, 3)
+        assert all(done[r].error is None for r in (hog, first, second))
+        assert_no_leaks(generator)
+
+    def test_degraded_request_finishes_with_degraded_reason(self, params):
+        """A ladder-truncated request that exhausts its reduced budget
+        reports finish_reason 'degraded' — the distinct terminal outcome
+        the SLO ledger counts as attained when it lands in target."""
+        generator = make_generator(params)
+        sched = Scheduler(generator, chunk=16, token_budget=32)
+        req = sched.enqueue(
+            "depth-truncated analysis",
+            SamplingParams(max_tokens=2, temperature=0.0,
+                           stop_on_eos=False, degraded=True),
+        )
+        outcome = drain(sched, 1)[req]
+        assert outcome.error is None
+        assert outcome.result.finish_reason == "degraded"
+        assert_no_leaks(generator)
+
+
+class TestAdmissionLadder:
+    def test_pressure_band_truncates_analysis_depth(self, params):
+        """deadline_policy consults the ladder before the deadline math:
+        in the degrade band max_tokens shrinks and the params are stamped
+        degraded — degrade-before-reject at the admission clamp."""
+        generator = make_generator(params)
+        generator.overload_policy = make_policy(degrade_tokens_frac=0.25)
+        sampling = SamplingParams(max_tokens=40, temperature=0.0)
+        clamped, outcome = generator.deadline_policy(sampling, pressure=5.0)
+        assert outcome == "degraded"
+        assert clamped.max_tokens == 10
+        assert clamped.degraded is True
+        # idempotent: an already-degraded request is not re-truncated
+        again, outcome2 = generator.deadline_policy(clamped, pressure=5.0)
+        assert outcome2 == "ok"
+        assert again.max_tokens == 10
+
+    def test_deep_overload_sheds_low_value_class(self, params):
+        generator = make_generator(params)
+        generator.overload_policy = make_policy(shed_value_floor=4.0)
+        sampling = SamplingParams(max_tokens=8, temperature=0.0,
+                                  slo_class="batch")
+        # cutoff at pressure 16 = 4 * 16/8 = 8 > batch weight 1 -> shed
+        _, outcome = generator.deadline_policy(sampling, pressure=16.0)
+        assert outcome == "shed"
+        # same pressure, interactive (16 >= 8) degrades instead
+        clamped, outcome = generator.deadline_policy(
+            SamplingParams(max_tokens=8, temperature=0.0,
+                           slo_class="interactive"),
+            pressure=16.0,
+        )
+        assert outcome == "degraded" and clamped.degraded
+
+    def test_no_pressure_signal_leaves_request_untouched(self, params):
+        generator = make_generator(params)
+        generator.overload_policy = make_policy()
+        sampling = SamplingParams(max_tokens=8, temperature=0.0)
+        same, outcome = generator.deadline_policy(sampling)
+        assert outcome == "ok" and same == sampling
         assert_no_leaks(generator)
 
 

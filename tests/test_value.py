@@ -1,0 +1,357 @@
+"""Value-aware overload control (router/value.py, docs/ROBUSTNESS.md
+"Degradation ladder").
+
+Covers the ISSUE 16 acceptance surface without JAX: the single value
+model (class weight x deadline feasibility / expected recall cost), the
+degrade-before-reject ladder paths, attainment-fed class protection with
+the all-below anti-deadlock waiver, lowest-value-first eviction, the
+labeled shed/degrade counters, and — the replay contract — a seeded
+arrival schedule driven through two fresh policies producing a
+BYTE-IDENTICAL shed/degrade decision log, with the shed ordering
+invariants ("recalled shed only after all cold of equal-or-lower class",
+"every shed score below every same-pressure degrade score") asserted
+from the parsed log rather than trusted from the implementation.
+"""
+
+import pytest
+
+from operator_tpu.loadgen.arrivals import ArrivalProcess, ArrivalSpec
+from operator_tpu.router.value import (
+    RECALL_COST_FRACTION,
+    OverloadPolicy,
+    RequestValue,
+    ShedDecisionLog,
+    ValueModel,
+)
+from operator_tpu.utils.timing import MetricsRegistry
+
+CLASSES = {"interactive": 2.0, "standard": 30.0, "batch": 120.0}
+
+
+def make_model(**kw):
+    return ValueModel(CLASSES, **kw)
+
+
+# ---------------------------------------------------------------------------
+# the value model: weights, feasibility, recall economics
+# ---------------------------------------------------------------------------
+
+
+class TestValueModel:
+    def test_rank_weights_are_powers_of_four_tightest_highest(self):
+        model = make_model()
+        assert model.weights == {"batch": 1.0, "standard": 4.0,
+                                 "interactive": 16.0}
+
+    def test_unknown_class_scores_as_cheapest(self):
+        model = make_model()
+        assert model.weight("no-such-class") == 1.0
+        assert model.weight(None) == 1.0
+
+    def test_feasibility_scales_with_residual_budget(self):
+        model = make_model()
+        full = model.value(slo_class="standard", residual_s=30.0)
+        half = model.value(slo_class="standard", residual_s=15.0)
+        assert full.feasibility == 1.0
+        assert half.feasibility == 0.5
+        assert half.score == pytest.approx(full.score / 2)
+        # surplus budget does not inflate value past the class weight
+        assert model.value(slo_class="standard", residual_s=300.0).score == \
+            full.score
+
+    def test_blown_deadline_is_worthless(self):
+        model = make_model()
+        assert model.value(slo_class="interactive", residual_s=0.0).score == 0.0
+        assert model.value(slo_class="interactive", residual_s=-5.0).score == 0.0
+
+    def test_no_deadline_means_full_feasibility(self):
+        model = make_model()
+        assert model.value(slo_class="batch", residual_s=None).feasibility == 1.0
+
+    def test_recall_hit_divides_expected_cost(self):
+        value = RequestValue(slo_class="standard", weight=4.0,
+                             feasibility=1.0, recall_p=1.0)
+        assert value.expected_cost == pytest.approx(RECALL_COST_FRACTION)
+        assert value.score == pytest.approx(4.0 / RECALL_COST_FRACTION)
+
+    def test_recalled_outranks_every_cold_of_equal_or_lower_class(self):
+        """The ISSUE invariant, structurally: a sure recall hit of class c
+        scores ~25x its class weight, above ANY cold request of class <= c
+        — so plain min-score shedding rejects cold before recalled."""
+        model = make_model()
+        for cls, lower in (
+            ("batch", ["batch"]),
+            ("standard", ["batch", "standard"]),
+            ("interactive", ["batch", "standard", "interactive"]),
+        ):
+            recalled = model.value(slo_class=cls, recall_p=1.0)
+            for other in lower:
+                cold = model.value(slo_class=other, recall_p=0.0)
+                assert recalled.score > cold.score, (cls, other)
+
+    def test_recall_multiplier_and_weight_spacing_pinned(self):
+        """Pin the numbers the equal-or-lower-class guarantee rides on:
+        a sure recall hit multiplies score by 1/0.04 = 25x, and adjacent
+        class weights are 4x apart — so recalled-of-class-c (25 x 4^r)
+        clears cold-of-class-c (4^r) and cold one rank up (4^(r+1)), and
+        a weight-spacing change that silently breaks the ordering fails
+        here before it fails in a storm."""
+        model = make_model()
+        assert model.value(slo_class="batch", recall_p=1.0).score == \
+            pytest.approx(25.0)
+        assert model.value(slo_class="interactive").score == pytest.approx(16.0)
+        assert model.value(slo_class="standard", recall_p=1.0).score == \
+            pytest.approx(100.0)
+
+
+class TestClassProtection:
+    def test_no_attainment_feed_protects_nothing(self):
+        assert make_model().protected_classes() == frozenset()
+
+    def test_below_target_class_is_protected(self):
+        att = {"interactive": 0.5, "standard": 0.95, "batch": None}
+        model = make_model(attainment=lambda: att, attainment_target=0.9)
+        assert model.protected_classes() == frozenset({"interactive"})
+        assert model.value(slo_class="interactive").protected is True
+        assert model.value(slo_class="standard").protected is False
+
+    def test_all_below_waiver_unprotects_best_attaining_class(self):
+        """Total overload: every known class below target would deadlock
+        the ladder (nothing sheddable).  The least-behind class loses
+        protection so someone absorbs the shed."""
+        att = {"interactive": 0.2, "standard": 0.6, "batch": 0.4}
+        model = make_model(attainment=lambda: att, attainment_target=0.9)
+        assert model.protected_classes() == frozenset(
+            {"interactive", "batch"}
+        )
+
+    def test_single_known_class_keeps_protection(self):
+        # with one known class the waiver would unprotect EVERYTHING —
+        # keep it; the pressure-band degrade path still applies
+        att = {"interactive": 0.2}
+        model = make_model(attainment=lambda: att, attainment_target=0.9)
+        assert model.protected_classes() == frozenset({"interactive"})
+
+    def test_unknown_class_attainment_is_ignored(self):
+        att = {"mystery": 0.1, "interactive": 0.95}
+        model = make_model(attainment=lambda: att, attainment_target=0.9)
+        assert model.protected_classes() == frozenset()
+
+
+# ---------------------------------------------------------------------------
+# the ladder: serve -> degrade -> shed, never the protected class
+# ---------------------------------------------------------------------------
+
+
+class TestOverloadPolicy:
+    def make_policy(self, **kw):
+        kw.setdefault("shed_pressure", 8.0)
+        kw.setdefault("shed_value_floor", 4.0)
+        metrics = kw.pop("metrics", MetricsRegistry())
+        return OverloadPolicy(make_model(), metrics=metrics, **kw), metrics
+
+    def test_under_pressure_serves_untouched(self):
+        policy, _ = self.make_policy()
+        v = policy.model.value(slo_class="batch")
+        verdict = policy.decide(v, pressure=1.0)
+        assert verdict.action == "serve"
+        assert verdict.reason == "under-pressure"
+        assert verdict.degrade_tokens_frac == 1.0
+
+    def test_pressure_band_degrades_everyone(self):
+        """Between degrade and shed pressure the ladder truncates analysis
+        depth for every class — degrade-before-reject, step one."""
+        policy, metrics = self.make_policy(degrade_tokens_frac=0.25)
+        for cls in CLASSES:
+            verdict = policy.decide(
+                policy.model.value(slo_class=cls), pressure=5.0
+            )
+            assert verdict.action == "degrade"
+            assert verdict.reason == "pressure-band"
+            assert verdict.degrade_tokens_frac == 0.25
+        assert metrics.counter("degraded") == len(CLASSES)
+        assert metrics.labeled_total(
+            "degraded", where={"slo_class": "batch"}
+        ) == 1
+
+    def test_past_shed_line_low_value_sheds_high_value_degrades(self):
+        policy, metrics = self.make_policy()
+        # cutoff at pressure 16 = floor 4 * 16/8 = 8: batch (1) sheds,
+        # interactive (16) degrades
+        low = policy.decide(
+            policy.model.value(slo_class="batch"), pressure=16.0
+        )
+        high = policy.decide(
+            policy.model.value(slo_class="interactive"), pressure=16.0
+        )
+        assert (low.action, low.reason) == ("shed", "below-cutoff")
+        assert (high.action, high.reason) == ("degrade", "above-cutoff")
+        assert low.cutoff == high.cutoff == pytest.approx(8.0)
+        assert metrics.labeled_total(
+            "shed", where={"slo_class": "batch"}
+        ) == 1
+        assert metrics.labeled_total("shed", where={"reason": "below-cutoff"}) == 1
+
+    def test_cutoff_rises_with_pressure(self):
+        """Deeper overload sheds progressively higher-value work — the
+        smooth-decay mechanism, not a fixed bar."""
+        policy, _ = self.make_policy()
+        standard = policy.model.value(slo_class="standard")  # score 4
+        at_shed_line = policy.decide(standard, pressure=8.0)
+        deep = policy.decide(standard, pressure=20.0)
+        assert at_shed_line.action == "degrade"  # score 4 >= cutoff 4
+        assert deep.action == "shed"  # cutoff 10 > 4
+        assert deep.cutoff > at_shed_line.cutoff
+
+    def test_protected_class_is_degraded_never_shed(self):
+        att = {"interactive": 0.1, "standard": 0.99, "batch": 0.99}
+        model = ValueModel(CLASSES, attainment=lambda: att,
+                           attainment_target=0.9)
+        policy = OverloadPolicy(model, shed_pressure=8.0,
+                                shed_value_floor=1000.0)
+        # cutoff astronomically above every score: only protection can
+        # keep this request alive
+        verdict = policy.decide(
+            model.value(slo_class="interactive"), pressure=50.0
+        )
+        assert verdict.action == "degrade"
+        assert verdict.reason == "class-protected"
+
+    def test_pick_eviction_lowest_score_skipping_protected(self):
+        policy, _ = self.make_policy()
+        model = policy.model
+        protected_low = model.value(slo_class="batch", protected=True)
+        cold_standard = model.value(slo_class="standard")
+        recalled_batch = model.value(slo_class="batch", recall_p=1.0)
+        victim = policy.pick_eviction([
+            ("a", protected_low),
+            ("b", recalled_batch),
+            ("c", cold_standard),
+        ])
+        assert victim is not None
+        rid, value = victim
+        # cold standard (4) < recalled batch (25); protected batch skipped
+        assert rid == "c"
+        assert value.score == pytest.approx(4.0)
+
+    def test_pick_eviction_all_protected_returns_none(self):
+        policy, _ = self.make_policy()
+        v = policy.model.value(slo_class="interactive", protected=True)
+        assert policy.pick_eviction([("a", v), ("b", v)]) is None
+
+    def test_pick_eviction_tie_breaks_on_id(self):
+        policy, _ = self.make_policy()
+        v = policy.model.value(slo_class="batch")
+        victim = policy.pick_eviction([("z", v), ("a", v), ("m", v)])
+        assert victim is not None and victim[0] == "a"
+
+
+# ---------------------------------------------------------------------------
+# decision log: canonical lines, bounded, byte-identical under replay
+# ---------------------------------------------------------------------------
+
+
+def drive_storm(seed: int):
+    """One seeded storm through a fresh policy: every random draw comes
+    from the ArrivalProcess materialisation (GL007 — no ambient
+    randomness here), pressure is a deterministic function of the event
+    index, and the decision log is the output."""
+    spec = ArrivalSpec(name="storm", rate_per_min=1200.0, duration_s=4.0,
+                       burst_factor=4.0, burst_every_s=1.0, burst_len_s=0.4)
+    events = ArrivalProcess(spec, seed=seed).materialize()
+    att = {"interactive": 0.5, "standard": 0.95, "batch": 0.95}
+    model = ValueModel(CLASSES, attainment=lambda: att,
+                       attainment_target=0.9)
+    policy = OverloadPolicy(model, shed_pressure=8.0, shed_value_floor=4.0,
+                            log=ShedDecisionLog())
+    verdicts = []
+    for event in events:
+        # deterministic pressure ramp: sawtooth over the shed line so the
+        # storm exercises serve, degrade-band, shed and protected paths
+        pressure = float(event.index % 24)
+        value = model.value(
+            slo_class=event.slo_class,
+            residual_s=model.target_s(event.slo_class),
+            recall_p=0.9 if event.recall_hot else 0.0,
+        )
+        verdicts.append(
+            policy.decide(value, pressure,
+                          site="storm", request_id=f"req-{event.index}")
+        )
+    return policy, verdicts
+
+
+class TestDecisionLogReplay:
+    def test_seeded_storm_replays_byte_identical(self):
+        """ISSUE 16 satellite: same seed + same storm => byte-identical
+        shed/degrade decision log on replay — two independent policy
+        instances, compared with == on the canonical text."""
+        first, _ = drive_storm(seed=7)
+        second, _ = drive_storm(seed=7)
+        assert first.log.text() == second.log.text()
+        assert len(first.log.lines()) > 0
+        assert first.log.dropped == second.log.dropped == 0
+
+    def test_different_seed_differs(self):
+        # guard against the vacuous pass where the log ignores its input
+        first, _ = drive_storm(seed=7)
+        other, _ = drive_storm(seed=8)
+        assert first.log.text() != other.log.text()
+
+    def test_shed_ordering_invariants_hold_in_the_log(self):
+        """Parse the replayed log and re-check the ladder's promises from
+        the outside: (1) at any pressure, every shed score is below the
+        cutoff and every above-cutoff degrade is at/above it; (2) the
+        protected class never sheds; (3) a recalled request only sheds
+        when every cold request of equal-or-lower class at that cutoff
+        was shed too."""
+        policy, _ = drive_storm(seed=7)
+        rows = []
+        for line in policy.log.lines():
+            fields = dict(kv.split("=", 1) for kv in line.split(" "))
+            rows.append({
+                "cls": fields["cls"],
+                "action": fields["action"],
+                "reason": fields["reason"],
+                "score": float(fields["score"]),
+                "cutoff": float(fields["cutoff"]),
+                "recalled": fields["recalled"] == "1",
+                "protected": fields["protected"] == "1",
+            })
+        sheds = [r for r in rows if r["action"] == "shed"]
+        assert sheds, "storm never exercised the shed path"
+        weights = {"batch": 1.0, "standard": 4.0, "interactive": 16.0}
+        for row in sheds:
+            assert row["score"] < row["cutoff"]
+            assert not row["protected"]
+            assert row["cls"] != "interactive"  # protected class never sheds
+        for row in rows:
+            if row["reason"] == "above-cutoff":
+                assert row["score"] >= row["cutoff"]
+        # recalled-after-cold: wherever a recalled request of class c was
+        # shed, every cold request of class <= c seen at the SAME cutoff
+        # must also have been shed (not degraded above the bar)
+        for shed in sheds:
+            if not shed["recalled"]:
+                continue
+            for other in rows:
+                if (
+                    other["action"] in ("shed", "degrade")
+                    and not other["recalled"]
+                    and other["cutoff"] == shed["cutoff"]
+                    and not other["protected"]
+                    and weights[other["cls"]] <= weights[shed["cls"]]
+                    and other["reason"] != "pressure-band"
+                ):
+                    assert other["action"] == "shed", (shed, other)
+
+    def test_log_is_bounded_with_dropped_counter(self):
+        log = ShedDecisionLog(cap=3)
+        policy = OverloadPolicy(make_model(), shed_pressure=8.0, log=log)
+        v = policy.model.value(slo_class="batch")
+        for i in range(5):
+            policy.decide(v, pressure=5.0, request_id=f"r{i}")
+        assert len(log.lines()) == 3
+        assert log.dropped == 2
+        log.clear()
+        assert log.lines() == [] and log.dropped == 0
