@@ -461,6 +461,63 @@ def bench_kv_economy(params, config, tokenizer, *, slots: int, max_seq: int,
     return out
 
 
+def bench_cold_start(params, config, tokenizer, *, slots: int, max_seq: int,
+                     page_size: int, decode_block: int) -> dict:
+    """Token-one latency from replica-does-not-exist (docs/SCALING.md):
+    the serverless wake path the autoscaler creates when the first arrival
+    lands on a fleet scaled to zero.  Each lane builds a FRESH
+    BatchedGenerator (the pod-boot stand-in — params are assumed resident,
+    so the number isolates program bring-up + prefill, not weight load)
+    and times prompt -> first token:
+
+    - AOT-cold: empty AOT cache directory, every serving program compiles
+      live inside the measured window — the first-ever wake on a
+      fingerprint;
+    - AOT-warm: a second fresh generator over the now-populated cache —
+      the wake the fleet actually pays once the image ships its programs.
+
+    The split is the case for shipping the cache with the image: the
+    autoscaler can only scale to zero as aggressively as
+    token-one-from-zero is cheap."""
+    import tempfile
+
+    from operator_tpu.serving.engine import BatchedGenerator, SamplingParams
+
+    prompt = ("analyse this pod failure: probe timeout after node drain; "
+              "the serving fleet was scaled to zero when it arrived")
+    one_tok = SamplingParams(max_tokens=1, temperature=0.0, stop_on_eos=False)
+
+    with tempfile.TemporaryDirectory(prefix="bench-coldstart-") as aot_dir:
+        def wake() -> tuple:
+            started = time.perf_counter()
+            generator = BatchedGenerator(
+                params, config, tokenizer, max_slots=slots, max_seq=max_seq,
+                paged=True, page_size=page_size, decode_block=decode_block,
+                aot_cache=aot_dir,
+            )
+            result = generator.generate(prompt, one_tok)
+            return (time.perf_counter() - started, result,
+                    generator._aot.stats())
+
+        cold_s, cold_result, cold_stats = wake()
+        warm_s, warm_result, warm_stats = wake()
+    assert list(cold_result.token_ids) == list(warm_result.token_ids), \
+        "cold-start lanes diverged"
+
+    return {
+        # the headline: token-one from a fleet that did not exist, with
+        # the image's AOT cache warm (the steady-state wake)
+        "token_one_s": round(warm_s, 3),
+        # first-ever wake on this fingerprint: live XLA compiles inside
+        "token_one_cold_s": round(cold_s, 3),
+        "aot_warm_speedup": (round(cold_s / warm_s, 2) if warm_s > 0
+                             else None),
+        "aot_cold": {k: cold_stats[k] for k in ("stored", "live_compiles")},
+        "aot_warm": {k: warm_stats[k]
+                     for k in ("hits", "live_compiles", "symbol_errors")},
+    }
+
+
 #: memoized probe verdict — BENCH_r03-r05 paid the 75 s probe repeatedly
 #: in one run; a degraded bench should pay for the bad backend ONCE.
 #: Also carries the probe forensics ("attempts", "retried", "platform")
@@ -927,6 +984,20 @@ def main() -> None:
             page_size=page_size,
         )
 
+    # cold-start: token-one from replica-does-not-exist — the serverless
+    # wake the autoscaler's scale-to-zero bets on (docs/SCALING.md)
+    cold_start = None
+    if os.environ.get("BENCH_COLD_START", "1") == "1":
+        log("cold-start scenario (token-one from zero, AOT cold vs warm)")
+        cold_start = bench_cold_start(
+            params, config, tokenizer,
+            slots=min(slots, 4), max_seq=min(max_seq, 512),
+            page_size=page_size, decode_block=decode_block,
+        )
+        log(f"cold-start: token_one={cold_start['token_one_s']}s "
+            f"(aot-cold {cold_start['token_one_cold_s']}s, "
+            f"x{cold_start['aot_warm_speedup']})")
+
     # wave-engine occupancy/stall over the MAIN timed phases (the mixed
     # scenario above reports per-mode numbers on fresh engines)
     from operator_tpu.utils.timing import METRICS as _METRICS
@@ -1004,6 +1075,9 @@ def main() -> None:
         ),
         "mixed": mixed,
         "kv_economy": kv_economy,
+        # token-one-from-zero, AOT-warm vs AOT-cold split — the number
+        # SCALE_TO_ZERO_IDLE_S trades against (docs/SCALING.md)
+        "cold_start": cold_start,
         # step-clock attribution (serving/perf.py): the MEASURED decode
         # MFU decomposed per step — host-gap / device / sample-xfer
         # fractions sum to 1.0 by construction; decode_mfu here counts

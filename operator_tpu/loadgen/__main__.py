@@ -138,6 +138,204 @@ async def _main() -> None:
     print("loadgen smoke: OK")
 
 
+async def _elastic_main() -> None:
+    """The scale-to-zero-and-back elastic smoke (LOADGEN_ELASTIC=1).
+
+    The fleet starts at ZERO replicas.  A seeded storm arrives; the
+    autoscaler (operator/autoscale.py) sees the pending admissions and
+    scales the fake Deployment up through the scale subresource; a tiny
+    in-process "deployment controller" turns spec.replicas into Endpoints
+    addresses; the endpoint watch (router/discovery.py) turns those into
+    live ring members serving the very arrivals that woke the fleet.
+    When the storm drains, the idle window elapses and the fleet scales
+    back to zero.  Gates: byte-identical arrival replay (twice), every
+    arrival settled with zero torn ledger lines, the fleet actually made
+    the 0→N→0 round trip, and the membership/autoscale counters fired.
+    """
+    from ..operator.autoscale import AutoscaleController
+    from ..router.discovery import EndpointDiscovery
+
+    seed = int(os.environ.get("LOADGEN_SEED", "0") or 0)
+    time_scale = 0.2
+    spec = ArrivalSpec(
+        name="elastic",
+        rate_per_min=float(os.environ.get("LOADGEN_ELASTIC_RATE_PER_MIN", "300")),
+        duration_s=float(os.environ.get("LOADGEN_ELASTIC_DURATION_S", "4")),
+        burst_factor=3.0,
+        burst_every_s=2.0,
+        burst_len_s=0.5,
+    )
+    process = ArrivalProcess(spec, seed=seed)
+
+    # replay gate first: two independent materialisations of the same
+    # (spec, seed) must be byte-identical — the elastic storm is as
+    # replayable as the static one
+    replay = ArrivalProcess(spec, seed=seed)
+    if process.fingerprint() != replay.fingerprint():
+        _fail("elastic arrival schedule is not replay-identical")
+    if [e.to_dict() for e in process.materialize()] != [
+        e.to_dict() for e in replay.materialize()
+    ]:
+        _fail("fingerprints matched but materialised events differ")
+
+    with tempfile.TemporaryDirectory(prefix="loadgen-elastic-") as tmp:
+        ledger_path = os.path.join(tmp, "slo-ledger.jsonl")
+        # replicas=[] — the fleet REALLY starts empty (scale-from-zero)
+        stack = await build_storm_stack(
+            replicas=[], time_scale=time_scale, ledger_path=ledger_path,
+        )
+        api, backend, ns = stack.api, stack.backend, stack.namespace
+        deployment = "podmortem-serving"
+        await api.create("Deployment", {
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": deployment, "namespace": ns},
+            "spec": {"replicas": 0},
+        })
+        await api.create("Endpoints", {
+            "apiVersion": "v1", "kind": "Endpoints",
+            "metadata": {"name": deployment, "namespace": ns},
+            "subsets": [],
+        })
+
+        class RingAdapter:
+            """EngineRouter facade for the discovery loop: a joining
+            endpoint becomes a live synthetic replica in the storm
+            backend (which pulses the wake event arrivals wait on)."""
+
+            def add(self, replica) -> None:
+                backend.add_replica(SyntheticReplica(
+                    replica.id, concurrency=2, time_scale=time_scale,
+                ))
+
+            def remove(self, replica_id: str) -> None:
+                backend.remove_replica(replica_id)
+
+        discovery = EndpointDiscovery(
+            api, RingAdapter(), service=deployment, namespace=ns,
+            kube_timeout_s=5.0, restart_delay_s=0.05,
+        )
+        autoscaler = AutoscaleController(
+            api, deployment=deployment, namespace=ns,
+            min_replicas=0, max_replicas=4, target_pressure=4.0,
+            idle_s=0.5, interval_s=0.05, kube_timeout_s=5.0,
+            fleet=lambda: backend.fleet_view()["fleet"],
+            attainment=stack.ledger.attainment_by_class,
+            pending=lambda: stack.ledger.pending,
+            metrics=stack.metrics,
+        )
+
+        stop = asyncio.Event()
+        peak = 0
+
+        async def actuate() -> None:
+            # the in-process "deployment controller": spec.replicas
+            # becomes ready Endpoints addresses, like kubelets turning
+            # pods Ready behind the headless Service
+            known = -1
+            while not stop.is_set():
+                try:
+                    scale = await api.get_scale("Deployment", deployment, ns)
+                    desired = int(scale["spec"]["replicas"])
+                    if desired != known:
+                        subsets = [{
+                            "addresses": [
+                                {"ip": f"10.0.0.{i + 1}"}
+                                for i in range(desired)
+                            ],
+                            "ports": [{"name": "http", "port": 8000}],
+                        }] if desired else []
+                        await api.patch("Endpoints", deployment, ns,
+                                        {"subsets": subsets})
+                        known = desired
+                except Exception:  # noqa: BLE001 - reconcile again next tick
+                    pass
+                await asyncio.sleep(0.03)
+
+        async def monitor() -> None:
+            nonlocal peak
+            while not stop.is_set():
+                peak = max(peak, len(backend.router))
+                await asyncio.sleep(0.02)
+
+        tasks = [asyncio.create_task(coro) for coro in (
+            discovery.run(stop), autoscaler.run(stop), actuate(), monitor(),
+        )]
+        settled_to_zero = False
+        try:
+            report = await run_storm(stack, process, drain_s=20.0)
+            # the round trip's back half: idle window elapses, the fleet
+            # scales to zero and the ring empties (bounded wait, no gate
+            # on exact timing)
+            for _ in range(300):
+                scale = await api.get_scale("Deployment", deployment, ns)
+                if (int(scale["spec"]["replicas"]) == 0
+                        and len(backend.router) == 0):
+                    settled_to_zero = True
+                    break
+                await asyncio.sleep(0.05)
+        finally:
+            stop.set()
+            api.close_watches()  # unblocks the discovery watch stream
+            for task in tasks:
+                task.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+        stack.close()
+
+        # gate: every arrival settled, nothing leaked, nothing torn
+        if report["arrivals"] <= 0:
+            _fail("elastic storm produced no arrivals")
+        total = report["slo"]["total"]
+        if total["admitted"] != report["arrivals"] - report["cancelled_at_drain"]:
+            _fail(
+                f"ledger admitted {total['admitted']} != "
+                f"{report['arrivals']} arrivals - "
+                f"{report['cancelled_at_drain']} cancelled"
+            )
+        if report["slo"]["pending"] != 0:
+            _fail(f"{report['slo']['pending']} records leaked pending")
+        with open(ledger_path) as fh:
+            raw_lines = [line for line in fh if line.strip()]
+        parsed = 0
+        for line in raw_lines:
+            try:
+                json.loads(line)
+                parsed += 1
+            except ValueError:
+                _fail(f"torn ledger line: {line[:80]!r}")
+        if parsed != total["admitted"]:
+            _fail(f"journal has {parsed} lines, ledger settled "
+                  f"{total['admitted']}")
+
+        # gate: the fleet made the 0→N→0 round trip
+        if peak < 1:
+            _fail("fleet never scaled up from zero (peak membership 0)")
+        if not settled_to_zero:
+            _fail("fleet never scaled back to zero after the storm drained")
+        counters = stack.metrics.snapshot()["counters"]
+        for name in ("autoscale_up", "autoscale_to_zero",
+                     "ring_member_added", "ring_member_removed"):
+            if counters.get(name, 0) < 1:
+                _fail(f"counter {name} never fired (got "
+                      f"{counters.get(name, 0)})")
+
+    print(json.dumps({
+        "arrivals": report["arrivals"],
+        "attainment": total["attainment"],
+        "attainment_by_class": report["overload"]["attainment_by_class"]
+        if report.get("overload") else None,
+        "peak_fleet": peak,
+        "scaled_to_zero": settled_to_zero,
+        "autoscale_up": counters.get("autoscale_up", 0),
+        "autoscale_down": counters.get("autoscale_down", 0),
+        "autoscale_to_zero": counters.get("autoscale_to_zero", 0),
+        "ring_member_added": counters.get("ring_member_added", 0),
+        "ring_member_removed": counters.get("ring_member_removed", 0),
+        "fingerprint": report["fingerprint"][:16],
+        "journal_lines": parsed,
+    }, indent=2))
+    print("loadgen elastic: OK")
+
+
 def _engaged(row: dict) -> bool:
     return bool(row["shed_total"] or row["degraded_total"])
 
@@ -219,5 +417,7 @@ def _overload_main() -> None:
 if __name__ == "__main__":
     if os.environ.get("LOADGEN_OVERLOAD", "0") == "1":
         _overload_main()
+    elif os.environ.get("LOADGEN_ELASTIC", "0") == "1":
+        asyncio.run(_elastic_main())
     else:
         asyncio.run(_main())

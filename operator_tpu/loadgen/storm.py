@@ -308,8 +308,9 @@ class InProcessServingBackend:
         metrics: Optional[MetricsRegistry] = None,
         shed_pressure: int = 8,
         max_failover: int = 1,
+        allow_empty: bool = False,
     ) -> None:
-        if not replicas:
+        if not replicas and not allow_empty:
             raise ValueError("storm backend needs at least one replica")
         self.replicas = {r.id: r for r in replicas}
         self.metrics = metrics
@@ -319,6 +320,23 @@ class InProcessServingBackend:
             max_failover=max_failover,
             metrics=metrics,
         )
+        #: pulsed on every membership change; arrivals against an empty
+        #: fleet wait here for the autoscaler to wake a replica
+        self._members_changed = asyncio.Event()
+
+    # -- elastic membership (docs/SCALING.md): the discovery loop mutates
+    # the serving plane mid-storm through these, without restart --------
+    def add_replica(
+        self, replica: "SyntheticReplica | EngineReplica"
+    ) -> None:
+        self.replicas[replica.id] = replica
+        self.router.add(Replica(id=replica.id, url=f"inproc://{replica.id}"))
+        self._members_changed.set()
+
+    def remove_replica(self, replica_id: str) -> None:
+        self.replicas.pop(replica_id, None)
+        self.router.remove(replica_id)
+        self._members_changed.set()
 
     def _feed_load(self) -> None:
         for rid, replica in self.replicas.items():
@@ -337,6 +355,29 @@ class InProcessServingBackend:
             if request.deadline_s is not None
             else None
         )
+        # scale-from-zero: an arrival against an EMPTY fleet is the wake
+        # signal (the autoscaler sees it as ledger pending) — wait for a
+        # member to join instead of failing, bounded by the arrival's own
+        # deadline envelope so a fleet that never wakes settles as a
+        # deadline miss, not a hang
+        while len(self.router) == 0:
+            self._members_changed.clear()
+            if len(self.router):
+                break  # joined between the check and the clear
+            wait_s = budget.remaining() if budget is not None else 5.0
+            if wait_s <= 0.0:
+                return AIResponse(
+                    error="deadline exhausted waiting for the fleet to "
+                          "wake from zero",
+                    provider_id="storm",
+                    deadline_outcome="deadline-exceeded",
+                )
+            try:
+                await asyncio.wait_for(
+                    self._members_changed.wait(), timeout=min(wait_s, 5.0)
+                )
+            except asyncio.TimeoutError:
+                continue
         self._feed_load()
 
         # value-aware overload ladder (router/value.py): consult BEFORE
@@ -410,7 +451,10 @@ class InProcessServingBackend:
 
     def fleet_view(self) -> dict:
         self._feed_load()
-        return self.router.health.fleet_view()
+        view = self.router.health.fleet_view()
+        # the autoscaler's burst signal (least-loaded healthy pressure)
+        view["fleet"]["pressure"] = self.router.fleet_pressure()
+        return view
 
 
 # --------------------------------------------------------------------------
@@ -481,12 +525,18 @@ async def build_storm_stack(
         path=ledger_path,
         metrics=metrics,
     )
+    # an EXPLICIT empty list is the elastic (scale-from-zero) shape: the
+    # fleet starts at zero and membership arrives through add_replica;
+    # None keeps the classic two-synthetic-replica CI smoke
+    allow_empty = replicas is not None and not replicas
     if replicas is None:
         replicas = [
             SyntheticReplica(f"storm-replica-{i}", time_scale=time_scale)
             for i in range(2)
         ]
-    backend = InProcessServingBackend(replicas, metrics=metrics)
+    backend = InProcessServingBackend(
+        replicas, metrics=metrics, allow_empty=allow_empty
+    )
     registry = default_registry()
     registry.register("storm", backend)
     pipeline = AnalysisPipeline(

@@ -108,6 +108,53 @@ class Operator:
         self.pattern_reconciler = PatternLibraryReconciler(
             api, GitSyncService(self.config), engine=self.engine, config=self.config
         )
+        # serverless fleet (docs/SCALING.md): SLO-judged autoscaler
+        # (leader-only, _spawn_control_tasks) + endpoint-watch membership
+        # (leaders AND standbys, start() — a standby's router must track
+        # the live fleet or its first routed request after takeover would
+        # hit pods that no longer exist)
+        self.autoscaler = None
+        if self.config.autoscale_enabled:
+            from .autoscale import AutoscaleController
+
+            self.autoscaler = AutoscaleController.from_config(
+                api,
+                self.config,
+                fleet=self._fleet_signals,
+                attainment=(
+                    lambda: self.pipeline.slo_ledger.attainment_by_class()
+                ),
+                pending=(lambda: self.pipeline.slo_ledger.pending),
+                metrics=self.metrics,
+            )
+        self.discovery = None
+        if self.config.discovery_enabled and self._http_backend is not None:
+            from ..router.discovery import EndpointDiscovery
+
+            backend = self._http_backend
+            self.discovery = EndpointDiscovery(
+                api,
+                backend.dynamic_router(),
+                service=self.config.discovery_service,
+                namespace=(
+                    self.config.discovery_namespace
+                    or getattr(api, "namespace", None)
+                    or "default"
+                ),
+                scheme=self.config.discovery_scheme,
+                port_name=self.config.discovery_port,
+                kube_timeout_s=self.config.kube_call_timeout_s,
+                restart_delay_s=self.config.watch_restart_delay_s,
+                prewarm=(
+                    (
+                        lambda replica: backend.prewarm_replica(
+                            replica, timeout_s=self.config.kube_call_timeout_s
+                        )
+                    )
+                    if self.config.discovery_prewarm
+                    else None
+                ),
+            )
         # engine warmth starts "disabled": flipped to loading/ready/failed
         # by _start_completion_api; readiness gates on it (health.py) so a
         # pod never reports Ready while minutes of weight load + XLA
@@ -131,7 +178,7 @@ class Operator:
                 # sets are first routed, and the poll loop keeps feeding
                 # their health boards while the server runs
                 fleet=(
-                    (lambda: self._http_backend.fleet_view())
+                    (lambda: self._fleet_view())
                     if self._http_backend is not None else None
                 ),
                 # per-class queue depth + attainment from the pipeline's
@@ -216,6 +263,29 @@ class Operator:
         self._http_backend = backend
         for pid in http_ids:
             self.providers.register(pid, backend)
+
+    def _fleet_signals(self) -> dict:
+        """The autoscaler's rollup feed: the ``fleet`` half of the
+        backend's fleet view (queueDepth / inflight / pressure)."""
+        if self._http_backend is None:
+            return {}
+        return self._http_backend.fleet_view().get("fleet") or {}
+
+    def _fleet_view(self) -> dict:
+        """``GET /fleet`` body: the backend's per-replica rows + rollup,
+        plus the serverless-fleet fields — live member count and the
+        autoscaler's last verdict."""
+        view = (
+            self._http_backend.fleet_view()
+            if self._http_backend is not None
+            else {"replicas": {}, "fleet": {}}
+        )
+        view["fleetSize"] = len(view.get("replicas") or {})
+        view["desiredReplicas"] = None
+        view["lastScaleReason"] = None
+        if self.autoscaler is not None:
+            view.update(self.autoscaler.view())
+        return view
 
     def _build_semantic(self):
         """Neural semantic matcher when an encoder checkpoint is mounted;
@@ -457,14 +527,28 @@ class Operator:
             self._tasks.append(asyncio.create_task(
                 self._health_poll_loop(), name="replica-health-poll"
             ))
+        if self.discovery is not None:
+            # endpoint-watch membership runs on leaders AND standbys (like
+            # the health poll): a standby whose ring already tracks the
+            # live fleet takes over without a stale-member window
+            self._tasks.append(asyncio.create_task(
+                self.discovery.run(self._stop), name="endpoint-discovery"
+            ))
 
     def _spawn_control_tasks(self) -> list[asyncio.Task]:
-        return [
+        tasks = [
             asyncio.create_task(self.watcher.run(self._stop), name="pod-watcher"),
             asyncio.create_task(self.podmortem_reconciler.run(self._stop), name="podmortem-reconciler"),
             asyncio.create_task(self.aiprovider_reconciler.run(self._stop), name="aiprovider-reconciler"),
             asyncio.create_task(self.pattern_reconciler.run(self._stop), name="patternlibrary-reconciler"),
         ]
+        if self.autoscaler is not None:
+            # leader-only like the reconcilers: two replicas scaling one
+            # Deployment would fight through the rv guard forever
+            tasks.append(asyncio.create_task(
+                self.autoscaler.run(self._stop), name="autoscaler"
+            ))
+        return tasks
 
     async def _single_replica_cycle(self) -> None:
         await self._resume_claims()

@@ -57,6 +57,8 @@ _KINDS: dict[str, tuple[str, str, bool]] = {
     "Pod": ("/api/v1", "pods", True),
     "Secret": ("/api/v1", "secrets", True),
     "ConfigMap": ("/api/v1", "configmaps", True),
+    "Endpoints": ("/api/v1", "endpoints", True),
+    "Service": ("/api/v1", "services", True),
     "Event": ("/apis/events.k8s.io/v1", "events", True),
     "ReplicaSet": ("/apis/apps/v1", "replicasets", True),
     "Deployment": ("/apis/apps/v1", "deployments", True),
@@ -358,6 +360,30 @@ class HttpKubeApi(KubeApi):
 
     async def delete(self, kind: str, name: str, namespace: str) -> None:
         await self._request("DELETE", self._path(kind, namespace, name))
+
+    async def get_scale(self, kind: str, name: str, namespace: str) -> dict:
+        return await self._request(
+            "GET", self._path(kind, namespace, name, "scale")
+        )
+
+    async def patch_scale(
+        self,
+        kind: str,
+        name: str,
+        namespace: str,
+        replicas: int,
+        *,
+        resource_version: Optional[str] = None,
+    ) -> dict:
+        patch: dict = {"spec": {"replicas": int(replicas)}}
+        if resource_version is not None:
+            patch["metadata"] = {"resourceVersion": resource_version}
+        return await self._request(
+            "PATCH",
+            self._path(kind, namespace, name, "scale"),
+            patch,
+            content_type="application/merge-patch+json",
+        )
 
     async def get_log(
         self,

@@ -142,6 +142,27 @@ class KubeApi:
     async def delete(self, kind: str, name: str, namespace: str) -> None:
         raise NotImplementedError
 
+    async def get_scale(self, kind: str, name: str, namespace: str) -> dict:
+        """Read the ``scale`` subresource (autoscaling/v1 Scale dict) of a
+        scalable object — ``spec.replicas`` is the desired count, an RBAC
+        grant on ``deployments/scale`` alone suffices (the autoscaler
+        never needs the full Deployment)."""
+        raise NotImplementedError
+
+    async def patch_scale(
+        self,
+        kind: str,
+        name: str,
+        namespace: str,
+        replicas: int,
+        *,
+        resource_version: Optional[str] = None,
+    ) -> dict:
+        """Set ``spec.replicas`` through the ``scale`` subresource.  With
+        ``resource_version`` the write is guarded by the same optimistic
+        concurrency as :meth:`patch` (409 on mismatch)."""
+        raise NotImplementedError
+
     async def get_log(
         self,
         name: str,
@@ -268,10 +289,13 @@ class FakeKubeApi(KubeApi):
         kind: Optional[str] = None,
     ) -> None:
         """Raise ``error_factory()`` for the next ``times`` calls of ``op``
-        (op is 'get'/'list'/'create'/'patch'/'patch_status'/'delete'/'get_log').
-        ``kind`` narrows the fault to one object kind — e.g. partitioning a
-        leader away from its Lease (``kind="Lease"``) without touching its
-        Pod/Podmortem traffic (tests/test_leader.py)."""
+        (op is 'get'/'list'/'create'/'patch'/'patch_status'/'delete'/
+        'get_log'/'get_scale'/'patch_scale').  ``kind`` narrows the fault
+        to one object kind — e.g. partitioning a leader away from its
+        Lease (``kind="Lease"``) without touching its Pod/Podmortem
+        traffic (tests/test_leader.py), or partitioning the autoscaler
+        away from the Deployment scale subresource mid-scale-up
+        (``kind="Deployment"``, tests/test_chaos.py)."""
         remaining = {"n": times}
 
         def hook(actual_op: str, actual_kind: str, name: str) -> Optional[Exception]:
@@ -424,6 +448,71 @@ class FakeKubeApi(KubeApi):
         # watch resume strictly after the previous event still replays it)
         obj["metadata"]["resourceVersion"] = self._next_rv()
         self._notify("DELETED", kind, obj)
+
+    # --- scale subresource ------------------------------------------------
+    async def get_scale(self, kind: str, name: str, namespace: str) -> dict:
+        self._check_hooks("get_scale", kind, name)
+        obj = self._bucket(kind).get((namespace, name))
+        if obj is None:
+            raise NotFoundError(f"{kind} {namespace}/{name} not found")
+        spec_replicas = (obj.get("spec") or {}).get("replicas")
+        return {
+            "apiVersion": "autoscaling/v1",
+            "kind": "Scale",
+            "metadata": {
+                "name": name,
+                "namespace": namespace,
+                "resourceVersion": obj["metadata"].get("resourceVersion"),
+            },
+            "spec": {"replicas": int(spec_replicas or 0)},
+            "status": {
+                "replicas": int(
+                    (obj.get("status") or {}).get("replicas")
+                    or spec_replicas
+                    or 0
+                ),
+            },
+        }
+
+    async def patch_scale(
+        self,
+        kind: str,
+        name: str,
+        namespace: str,
+        replicas: int,
+        *,
+        resource_version: Optional[str] = None,
+    ) -> dict:
+        self._check_hooks("patch_scale", kind, name)
+        bucket = self._bucket(kind)
+        current = bucket.get((namespace, name))
+        if current is None:
+            raise NotFoundError(f"{kind} {namespace}/{name} not found")
+        if (
+            resource_version is not None
+            and current["metadata"].get("resourceVersion") != resource_version
+        ):
+            raise ConflictError(
+                f"Operation cannot be fulfilled on {kind} "
+                f"{namespace}/{name}: the object has been modified"
+            )
+        merged = _deep_merge(current, {"spec": {"replicas": int(replicas)}})
+        merged["metadata"]["resourceVersion"] = self._next_rv()
+        bucket[(namespace, name)] = merged
+        # a scale write IS a Deployment modification: watchers of the kind
+        # see it exactly as they would from the real apiserver
+        self._notify("MODIFIED", kind, merged)
+        return {
+            "apiVersion": "autoscaling/v1",
+            "kind": "Scale",
+            "metadata": {
+                "name": name,
+                "namespace": namespace,
+                "resourceVersion": merged["metadata"]["resourceVersion"],
+            },
+            "spec": {"replicas": int(replicas)},
+            "status": {"replicas": int(replicas)},
+        }
 
     # --- pod logs ---------------------------------------------------------
     def set_pod_log(self, namespace: str, name: str, text: str, *, previous: bool = False) -> None:

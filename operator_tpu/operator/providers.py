@@ -333,6 +333,71 @@ class OpenAICompatProvider:
         #: would be re-probed by every analysis
         self._routers: dict[tuple[str, ...], EngineRouter] = {}
 
+    #: sentinel replica-set key for the DISCOVERY-driven router: its
+    #: membership is mutated live by router/discovery.py instead of being
+    #: derived from a CR's apiUrl
+    DYNAMIC_KEY: tuple[str, ...] = ("<discovery>",)
+
+    def dynamic_router(self) -> EngineRouter:
+        """The endpoint-watch fleet's router (created empty on first
+        use).  Living in ``_routers`` means ``fleet_view()`` and the
+        health-poll sweep cover discovered replicas for free; when it has
+        members, :meth:`generate` prefers it over the static apiUrl set —
+        the serving fleet scales without a single CR edit or restart."""
+        router = self._routers.get(self.DYNAMIC_KEY)
+        if router is None:
+            router = EngineRouter(
+                [],
+                vnodes=self._router_vnodes,
+                shed_pressure=self._shed_pressure,
+                failure_threshold=self._replica_failure_threshold,
+                reset_s=self._replica_reset_s,
+                clock=self._clock,
+                metrics=self._metrics,
+            )
+            self._routers[self.DYNAMIC_KEY] = router
+        router.fault_plan = self.fault_plan
+        router.policy = self.overload_policy
+        return router
+
+    async def prewarm_replica(
+        self, replica: Replica, *, timeout_s: float = 5.0
+    ) -> bool:
+        """The discovery loop's join gate: one bounded ``GET /healthz``
+        probe against a replica that just appeared in the Endpoints.  A
+        200 with ``status == "ok"`` admits it — and the probe body's load
+        report primes the health board (queue depth, KV inventory) BEFORE
+        the first routed request, so the new member joins warm, not
+        blind.  Anything else (still compiling its warmup grid, foreign
+        body, unreachable) defers the join to the next Endpoints event."""
+
+        split = urllib.parse.urlsplit(replica.url)
+        health_url = f"{split.scheme}://{split.netloc}/healthz"
+
+        def probe() -> dict:
+            if self.fault_plan is not None:
+                self.fault_plan.apply("http.healthz", replica=replica.id)
+            req = urllib.request.Request(health_url, method="GET")
+            with self._opener(req, timeout=timeout_s) as resp:
+                payload = json.loads(resp.read().decode())
+            if not isinstance(payload, dict) or not isinstance(
+                payload.get("status"), str
+            ):
+                raise ValueError(f"foreign /healthz body: {payload!r}")
+            return payload
+
+        payload = await asyncio.to_thread(probe)  # raising defers the join
+        if payload["status"] != "ok":
+            return False
+        router = self.dynamic_router()
+        router.mark_probe(replica.id, True)
+        load = payload.get("load")
+        if isinstance(load, dict):
+            from ..router.health import ReplicaLoad
+
+            router.report_load(replica.id, ReplicaLoad.parse(load))
+        return True
+
     def router_for(self, replicas: list[Replica]) -> EngineRouter:
         key = tuple(sorted(r.id for r in replicas))
         router = self._routers.get(key)
@@ -362,7 +427,24 @@ class OpenAICompatProvider:
         replicas: dict = {}
         for router in list(self._routers.values()):
             replicas.update(router.health.fleet_view()["replicas"])
-        return {"replicas": replicas, "fleet": fleet_rollup(replicas)}
+        fleet = fleet_rollup(replicas)
+        # the overload ladder's storm signal, fleet-wide: the best offer
+        # any routed replica can make — what the autoscaler bursts on
+        fleet["pressure"] = self.fleet_pressure()
+        return {"replicas": replicas, "fleet": fleet}
+
+    def fleet_pressure(self) -> "Optional[float]":
+        """Least-loaded healthy replica's queue pressure across every
+        routed set (None = no healthy replica anywhere)."""
+        pressures = [
+            p
+            for p in (
+                router.fleet_pressure()
+                for router in list(self._routers.values())
+            )
+            if p is not None
+        ]
+        return min(pressures) if pressures else None
 
     async def poll_replica_health(self, *, timeout_s: float = 5.0) -> int:
         """Active ``GET /healthz`` sweep over every routed replica set,
@@ -436,20 +518,33 @@ class OpenAICompatProvider:
 
     async def generate(self, request: AnalysisRequest) -> AIResponse:
         config = request.provider_config or AIProviderConfig()
-        if not config.api_url:
-            return AIResponse(error="provider has no apiUrl", provider_id=config.provider_id)
-        try:
-            replicas = replica_set(config.api_url)
-        except ProviderError as exc:
-            # a malformed apiUrl is a CONFIG error, not backend weather:
-            # surface it verbatim (it names the offending entry) instead
-            # of letting urllib produce "unknown url type" noise
-            return AIResponse(error=str(exc), provider_id=config.provider_id,
-                              model_id=config.model_id)
+        # discovery-driven fleet first: when the endpoint watch has
+        # populated the dynamic router, IT is the replica set — the CR's
+        # apiUrl (typically the headless Service DNS) is the bootstrap
+        # fallback for installs without discovery (an EMPTY dynamic
+        # router falls through rather than failing every request while
+        # the fleet is scaled to zero mid-wake)
+        router = self._routers.get(self.DYNAMIC_KEY)
+        if router is not None and len(router) > 0:
+            router.fault_plan = self.fault_plan
+            router.policy = self.overload_policy
+        else:
+            router = None
+        if router is None:
+            if not config.api_url:
+                return AIResponse(error="provider has no apiUrl", provider_id=config.provider_id)
+            try:
+                replicas = replica_set(config.api_url)
+            except ProviderError as exc:
+                # a malformed apiUrl is a CONFIG error, not backend weather:
+                # surface it verbatim (it names the offending entry) instead
+                # of letting urllib produce "unknown url type" noise
+                return AIResponse(error=str(exc), provider_id=config.provider_id,
+                                  model_id=config.model_id)
+            router = self.router_for(replicas)
         from ..serving.prompts import build_prompt  # shared with tpu-native path
 
         prompt = build_prompt(request)
-        router = self.router_for(replicas)
         # value-aware overload ladder (router/value.py): consult the
         # policy BEFORE building the dispatch — shed returns here with no
         # network traffic at all; degrade truncates analysis depth AND

@@ -18,6 +18,7 @@ from .core import (
     RouterError,
     request_key,
 )
+from .discovery import EndpointDiscovery, endpoint_urls
 from .health import (
     BreakerBoard,
     CircuitBreaker,
@@ -38,6 +39,7 @@ from .value import (
 __all__ = [
     "BreakerBoard",
     "CircuitBreaker",
+    "EndpointDiscovery",
     "EngineRouter",
     "HashRing",
     "HealthBoard",
@@ -53,5 +55,6 @@ __all__ = [
     "RouterError",
     "ShedDecisionLog",
     "ValueModel",
+    "endpoint_urls",
     "request_key",
 ]

@@ -151,12 +151,21 @@ class EngineRouter:
     def add(self, replica: "Replica | str") -> None:
         if isinstance(replica, str):
             replica = Replica(id=replica)
+        joined = replica.id not in self._replicas
         self._replicas[replica.id] = replica
         self._ring.add(replica.id)
+        if joined:
+            # counted only on a REAL membership change (idempotent re-adds
+            # from a relist are silent) so ring_resize tracks actual remaps
+            self.metrics.incr("ring_member_added")
+            self.metrics.incr("ring_resize")
 
     def remove(self, replica_id: str) -> None:
-        self._replicas.pop(replica_id, None)
+        left = self._replicas.pop(replica_id, None) is not None
         self._ring.remove(replica_id)
+        if left:
+            self.metrics.incr("ring_member_removed")
+            self.metrics.incr("ring_resize")
 
     def replicas(self) -> list[Replica]:
         return [self._replicas[rid] for rid in sorted(self._replicas)]

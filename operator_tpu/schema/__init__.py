@@ -43,6 +43,11 @@ from .kube import (
     ContainerStateWaiting,
     ContainerStatus,
     Deployment,
+    DeploymentSpec,
+    EndpointAddress,
+    EndpointPort,
+    Endpoints,
+    EndpointSubset,
     Event,
     Lease,
     LeaseSpec,
@@ -51,6 +56,9 @@ from .kube import (
     PodSpec,
     PodStatus,
     ReplicaSet,
+    Scale,
+    ScaleSpec,
+    ScaleStatus,
     Secret,
 )
 from .meta import (

@@ -217,6 +217,17 @@ class AotCache:
         #: programs compiled LIVE under this cache (cold or fallback) —
         #: the number a warm-boot assertion wants to see at zero
         self.live_compiles = 0
+        #: entries discarded for the KNOWN environmental failure: XLA
+        #: raising "Symbols not found" at deserialize_and_load.  It means
+        #: the stored executable was serialized from an XLA
+        #: persistent-compilation-cache HIT — the runtime handed back a
+        #: cached binary whose jitted symbol definitions were never
+        #: embedded in the serialized payload, so the .aotx is poisoned
+        #: at STORE time and only detectable at the next boot's load.
+        #: Distinct from ``errors`` so warm-boot tests can tell "cache
+        #: fell back for the documented environmental reason" apart from
+        #: genuine corruption.
+        self.symbol_errors = 0
         self._preloaded: dict[str, Any] = {}
         self._warned_cold = False
 
@@ -235,6 +246,7 @@ class AotCache:
             "hits": self.hits,
             "misses": self.misses,
             "errors": self.errors,
+            "symbol_errors": self.symbol_errors,
             "stored": self.stored,
             "live_compiles": self.live_compiles,
         }
@@ -268,15 +280,42 @@ class AotCache:
                 continue
             try:
                 self._preloaded[name] = self._deserialize(name, self._file(name))
-            except Exception:  # noqa: BLE001 - one bad file must not kill boot
-                self.errors += 1
-                self._incr("aot_cache_error")
-                log.warning(
-                    "AOT cache entry %r unreadable during preload; it will "
-                    "compile live and be re-stored", name, exc_info=True,
-                )
-                self._remove(name)
+            except Exception as exc:  # noqa: BLE001 - one bad file must not kill boot
+                self._note_deserialize_error(name, exc, stage="preload")
         return len(self._preloaded)
+
+    def _note_deserialize_error(
+        self, name: str, exc: BaseException, *, stage: str
+    ) -> None:
+        """Classify one deserialize failure, count it, discard the file.
+
+        ``Symbols not found`` is the documented environmental mode (see
+        ``symbol_errors``): a host whose shared XLA persistent
+        compilation cache was already warm at STORE time serialized an
+        executable without its jitted symbol definitions.  It gets a
+        LOUD, named discard (``podmortem_aot_cache_symbols_lost_total``)
+        and the live-compile lane re-stores a sound entry; anything else
+        is generic corruption."""
+        self.errors += 1
+        self._incr("aot_cache_error")
+        if "Symbols not found" in str(exc):
+            self.symbol_errors += 1
+            self._incr("aot_cache_symbols_lost")
+            log.error(
+                "AOT cache entry %r is missing its jitted symbol "
+                "definitions (%s-time XLA 'Symbols not found'): it was "
+                "serialized from a WARM shared XLA compilation cache, so "
+                "the stored executable never contained its own code. "
+                "Discarding it and compiling live; the re-stored entry "
+                "will be self-contained.", name, stage,
+            )
+        else:
+            log.warning(
+                "AOT cache entry %r failed to deserialize during %s; "
+                "falling back to live compile and discarding the file",
+                name, stage, exc_info=True,
+            )
+        self._remove(name)
 
     def get(self, name: str) -> Optional[Any]:
         """The loaded executable for ``name``, or None (miss/corrupt —
@@ -303,14 +342,8 @@ class AotCache:
             return None
         try:
             loaded = self._deserialize(name, path)
-        except Exception:  # noqa: BLE001 - corrupt entry: loud live-compile fallback
-            self.errors += 1
-            self._incr("aot_cache_error")
-            log.warning(
-                "AOT cache entry %r failed to deserialize; falling back to "
-                "live compile and discarding the file", name, exc_info=True,
-            )
-            self._remove(name)
+        except Exception as exc:  # noqa: BLE001 - corrupt entry: loud live-compile fallback
+            self._note_deserialize_error(name, exc, stage="load")
             return None
         self.hits += 1
         self._incr("aot_cache_hit")

@@ -334,6 +334,37 @@ class OperatorConfig:
     # evicts the lowest-value non-protected request (0 = unbounded)
     sched_queue_limit: int = 0
 
+    # --- serverless fleet (router/discovery.py, operator/autoscale.py,
+    # docs/SCALING.md) -----------------------------------------------------
+    # endpoint-watch fleet membership: list+watch the headless serving
+    # Service's Endpoints and mutate the router's consistent-hash ring
+    # live — joins pre-warmed via a health probe before taking traffic,
+    # departures drain through the breaker/failover path
+    discovery_enabled: bool = False
+    discovery_service: str = "podmortem-serving"
+    discovery_namespace: str = ""  # "" = the api's namespace (or "default")
+    discovery_port: str = "http"  # EndpointPort NAME to route to
+    discovery_scheme: str = "http"
+    # gate joins on a successful /healthz probe (which also primes the
+    # replica's KV prefix store with a load report) before ring insertion
+    discovery_prewarm: bool = True
+    # SLO-judged autoscaler (leader-only control loop): scales the serving
+    # Deployment via the scale subresource on router fleet pressure +
+    # per-class SLO attainment — including to ZERO when idle
+    autoscale_enabled: bool = False
+    autoscale_interval_s: float = 15.0
+    autoscale_min_replicas: int = 0
+    autoscale_max_replicas: int = 8
+    # least-loaded healthy replica's queue pressure past which the fleet
+    # bursts out (OverloadPolicy's fleet_pressure is the same signal the
+    # degradation ladder keys on — scale-up is the rung ABOVE degrade)
+    autoscale_target_pressure: float = 4.0
+    autoscale_deployment: str = "podmortem-serving"
+    autoscale_namespace: str = ""  # "" = the api's namespace (or "default")
+    # idle window before the fleet scales to zero (only when
+    # autoscale_min_replicas == 0); pending arrivals wake it back up
+    scale_to_zero_idle_s: float = 600.0
+
     @classmethod
     def from_env(cls, env: Optional[dict[str, str]] = None) -> "OperatorConfig":
         env = dict(os.environ if env is None else env)

@@ -1,0 +1,558 @@
+"""Serverless fleet (docs/SCALING.md): the Deployment ``scale``
+subresource on the fake apiserver, the SLO-judged autoscale policy
+(wake-from-zero, pressure/attainment burst, idle-window scale-to-zero),
+the tick/run actuation path with its blocked-patch degradation, and
+endpoint-watch ring membership (list+watch, pre-warm gate, 410 relist).
+
+Everything runs against FakeKubeApi — the same watch/notify semantics the
+chaos suite exercises — with injectable clocks so no test sleeps out a
+real idle window.
+"""
+
+import asyncio
+
+import pytest
+
+from operator_tpu.operator.autoscale import AutoscaleController
+from operator_tpu.operator.kubeapi import (
+    ApiError,
+    ConflictError,
+    FakeKubeApi,
+    NotFoundError,
+)
+from operator_tpu.router import EndpointDiscovery, EngineRouter, endpoint_urls
+from operator_tpu.router.core import Replica
+from operator_tpu.schema import (
+    Deployment,
+    DeploymentSpec,
+    EndpointAddress,
+    EndpointPort,
+    Endpoints,
+    EndpointSubset,
+    ObjectMeta,
+)
+from operator_tpu.utils.timing import MetricsRegistry
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+SERVICE = "podmortem-serving"
+
+
+def _deployment(replicas=0, name=SERVICE, namespace="default"):
+    return Deployment(
+        metadata=ObjectMeta(name=name, namespace=namespace),
+        spec=DeploymentSpec(replicas=replicas),
+    )
+
+
+def _endpoints(ips, name=SERVICE, namespace="default", port=8000):
+    subsets = []
+    if ips:
+        subsets = [
+            EndpointSubset(
+                addresses=[EndpointAddress(ip=ip) for ip in ips],
+                ports=[EndpointPort(name="http", port=port)],
+            )
+        ]
+    return Endpoints(
+        metadata=ObjectMeta(name=name, namespace=namespace), subsets=subsets
+    )
+
+
+def _controller(api=None, **kw):
+    defaults = dict(
+        deployment=SERVICE,
+        namespace="default",
+        min_replicas=0,
+        max_replicas=4,
+        target_pressure=4.0,
+        idle_s=10.0,
+        interval_s=0.01,
+        kube_timeout_s=5.0,
+        metrics=MetricsRegistry(),
+    )
+    defaults.update(kw)
+    return AutoscaleController(api if api is not None else FakeKubeApi(), **defaults)
+
+
+# ------------------------------------------------------- scale subresource
+class TestScaleSubresource:
+    def test_get_scale_round_trip(self):
+        async def scenario():
+            api = FakeKubeApi()
+            await api.create_obj(_deployment(replicas=3))
+            scale = await api.get_scale("Deployment", SERVICE, "default")
+            assert scale["kind"] == "Scale"
+            assert scale["apiVersion"] == "autoscaling/v1"
+            assert scale["spec"]["replicas"] == 3
+            assert scale["metadata"]["resourceVersion"]
+
+        run(scenario())
+
+    def test_get_scale_missing_deployment_is_not_found(self):
+        async def scenario():
+            api = FakeKubeApi()
+            with pytest.raises(NotFoundError):
+                await api.get_scale("Deployment", "absent", "default")
+            with pytest.raises(NotFoundError):
+                await api.patch_scale("Deployment", "absent", "default", 1)
+
+        run(scenario())
+
+    def test_patch_scale_writes_spec_and_notifies_watchers(self):
+        """A scale write IS a Deployment modification: kind watchers see
+        MODIFIED exactly as they would from the real apiserver."""
+
+        async def scenario():
+            api = FakeKubeApi()
+            await api.create_obj(_deployment(replicas=1))
+            _, rv = await api.list_rv("Deployment", "default")
+            seen = []
+
+            async def consume():
+                async for event in api.watch(
+                    "Deployment", "default", resource_version=rv
+                ):
+                    seen.append(event)
+                    return
+
+            task = asyncio.create_task(consume())
+            await asyncio.sleep(0.01)
+            result = await api.patch_scale("Deployment", SERVICE, "default", 4)
+            await asyncio.wait_for(task, 2)
+            assert result["spec"]["replicas"] == 4
+            assert seen[0].type == "MODIFIED"
+            assert seen[0].object["spec"]["replicas"] == 4
+            scale = await api.get_scale("Deployment", SERVICE, "default")
+            assert scale["spec"]["replicas"] == 4
+
+        run(scenario())
+
+    def test_patch_scale_stale_resource_version_conflicts(self):
+        async def scenario():
+            api = FakeKubeApi()
+            await api.create_obj(_deployment(replicas=1))
+            stale = await api.get_scale("Deployment", SERVICE, "default")
+            await api.patch_scale("Deployment", SERVICE, "default", 2)
+            with pytest.raises(ConflictError):
+                await api.patch_scale(
+                    "Deployment", SERVICE, "default", 5,
+                    resource_version=stale["metadata"]["resourceVersion"],
+                )
+
+        run(scenario())
+
+    def test_inject_errors_kind_filter_scopes_the_partition(self):
+        """``kind="Endpoints"`` must not break Deployment scale traffic —
+        the narrowing the partition-during-scale-up chaos test relies on."""
+
+        async def scenario():
+            api = FakeKubeApi()
+            await api.create_obj(_deployment(replicas=1))
+            await api.create_obj(_endpoints(["10.0.0.1"]))
+            api.inject_errors(
+                "patch_scale", lambda: ApiError("partitioned"), times=1,
+                kind="Endpoints",
+            )
+            await api.patch_scale("Deployment", SERVICE, "default", 2)
+            api.inject_errors(
+                "patch_scale", lambda: ApiError("partitioned"), times=1,
+                kind="Deployment",
+            )
+            with pytest.raises(ApiError):
+                await api.patch_scale("Deployment", SERVICE, "default", 3)
+            # the fault budget is consumed: the retry goes through
+            await api.patch_scale("Deployment", SERVICE, "default", 3)
+            scale = await api.get_scale("Deployment", SERVICE, "default")
+            assert scale["spec"]["replicas"] == 3
+
+        run(scenario())
+
+
+# ------------------------------------------------------------ decide policy
+class TestDecidePolicy:
+    def test_pending_work_wakes_a_zero_fleet(self):
+        ctl = _controller(pending=lambda: 3, fleet=lambda: {})
+        decision = ctl.decide(0, now=0.0)
+        assert decision.desired == 1 and decision.action == "up"
+        assert "wake-from-zero" in decision.reason
+
+    def test_idle_at_zero_holds(self):
+        ctl = _controller(pending=lambda: 0, fleet=lambda: {})
+        decision = ctl.decide(0, now=0.0)
+        assert decision.desired == 0 and decision.action == "hold"
+
+    def test_fleet_pressure_bursts_one_replica(self):
+        ctl = _controller(
+            fleet=lambda: {"queueDepth": 6, "inflight": 2, "pressure": 5.0}
+        )
+        decision = ctl.decide(2, now=0.0)
+        assert decision.desired == 3 and decision.action == "up"
+        assert "fleet_pressure" in decision.reason
+
+    def test_burst_at_max_replicas_is_blocked(self):
+        ctl = _controller(
+            fleet=lambda: {"queueDepth": 6, "pressure": 9.0}, max_replicas=4
+        )
+        decision = ctl.decide(4, now=0.0)
+        assert decision.desired == 4 and decision.action == "blocked"
+        assert "max_replicas" in decision.reason
+
+    def test_lagging_slo_class_bursts_even_at_low_pressure(self):
+        """The autoscaler is judged on attainment, not utilisation: a
+        protected class under target with work pending scales out even
+        when raw pressure looks tolerable."""
+        ctl = _controller(
+            fleet=lambda: {"queueDepth": 1, "pressure": 1.0},
+            pending=lambda: 2,
+            attainment=lambda: {"batch": 0.99, "interactive": 0.5},
+        )
+        decision = ctl.decide(2, now=0.0)
+        assert decision.desired == 3 and decision.action == "up"
+        assert "interactive" in decision.reason
+
+    def test_full_idle_window_scales_to_zero(self):
+        ctl = _controller(fleet=lambda: {}, pending=lambda: 0, idle_s=10.0)
+        assert ctl.decide(2, now=100.0).action == "hold"
+        assert ctl.decide(2, now=105.0).action == "hold"
+        decision = ctl.decide(2, now=110.0)
+        assert decision.action == "to_zero" and decision.desired == 0
+
+    def test_busy_interval_resets_the_idle_window(self):
+        state = {"queue": 0}
+        ctl = _controller(
+            fleet=lambda: {"queueDepth": state["queue"]},
+            pending=lambda: 0,
+            idle_s=10.0,
+        )
+        ctl.decide(2, now=0.0)
+        state["queue"] = 1
+        assert ctl.decide(2, now=9.0).reason == "busy"
+        state["queue"] = 0
+        # the window restarted at t=12, so t=12..21 still holds
+        assert ctl.decide(2, now=12.0).action == "hold"
+        assert ctl.decide(2, now=21.0).action == "hold"
+        assert ctl.decide(2, now=23.0).action == "to_zero"
+
+    def test_nonzero_floor_scales_down_not_to_zero(self):
+        ctl = _controller(
+            fleet=lambda: {}, pending=lambda: 0, min_replicas=1, idle_s=5.0
+        )
+        ctl.decide(3, now=0.0)
+        decision = ctl.decide(3, now=6.0)
+        assert decision.action == "down" and decision.desired == 1
+
+
+# ------------------------------------------------------------ tick actuation
+class TestTickActuation:
+    def test_wake_tick_patches_and_counts(self):
+        async def scenario():
+            api = FakeKubeApi()
+            await api.create_obj(_deployment(replicas=0))
+            metrics = MetricsRegistry()
+            ctl = _controller(
+                api, pending=lambda: 2, fleet=lambda: {}, metrics=metrics
+            )
+            decision = await ctl.tick()
+            assert decision.action == "up" and decision.desired == 1
+            scale = await api.get_scale("Deployment", SERVICE, "default")
+            assert scale["spec"]["replicas"] == 1
+            assert metrics.snapshot()["counters"].get("autoscale_up") == 1
+            view = ctl.view()
+            assert view["desiredReplicas"] == 1
+            assert "wake-from-zero" in view["lastScaleReason"]
+
+        run(scenario())
+
+    def test_partitioned_patch_degrades_to_blocked_then_retries(self):
+        async def scenario():
+            api = FakeKubeApi()
+            await api.create_obj(_deployment(replicas=0))
+            metrics = MetricsRegistry()
+            ctl = _controller(
+                api, pending=lambda: 1, fleet=lambda: {}, metrics=metrics
+            )
+            api.inject_errors(
+                "patch_scale", lambda: ApiError("partitioned"), times=1,
+                kind="Deployment",
+            )
+            blocked = await ctl.tick()
+            assert blocked.action == "blocked"
+            assert "patch failed" in blocked.reason
+            assert metrics.snapshot()["counters"].get("autoscale_blocked") == 1
+            # the signal feeds are live: next tick re-derives and lands
+            retried = await ctl.tick()
+            assert retried.action == "up"
+            scale = await api.get_scale("Deployment", SERVICE, "default")
+            assert scale["spec"]["replicas"] == 1
+
+        run(scenario())
+
+    def test_hold_tick_never_patches(self):
+        async def scenario():
+            api = FakeKubeApi()
+            await api.create_obj(_deployment(replicas=2))
+            metrics = MetricsRegistry()
+            ctl = _controller(
+                api,
+                fleet=lambda: {"queueDepth": 1, "pressure": 1.0},
+                metrics=metrics,
+            )
+            before = await api.get_scale("Deployment", SERVICE, "default")
+            decision = await ctl.tick()
+            assert decision.action == "hold"
+            after = await api.get_scale("Deployment", SERVICE, "default")
+            assert (
+                after["metadata"]["resourceVersion"]
+                == before["metadata"]["resourceVersion"]
+            ), "a hold must not write the apiserver"
+            counters = metrics.snapshot()["counters"]
+            assert not any(k.startswith("autoscale_") for k in counters)
+
+        run(scenario())
+
+    def test_run_loop_reaches_zero_and_counts_it(self):
+        async def scenario():
+            api = FakeKubeApi()
+            await api.create_obj(_deployment(replicas=2))
+            metrics = MetricsRegistry()
+            ctl = _controller(
+                api, fleet=lambda: {}, pending=lambda: 0,
+                idle_s=0.05, interval_s=0.01, metrics=metrics,
+            )
+            stop = asyncio.Event()
+            task = asyncio.create_task(ctl.run(stop))
+            scale = None
+            for _ in range(300):
+                await asyncio.sleep(0.01)
+                scale = await api.get_scale("Deployment", SERVICE, "default")
+                if scale["spec"]["replicas"] == 0:
+                    break
+            stop.set()
+            await asyncio.wait_for(task, 2)
+            assert scale is not None and scale["spec"]["replicas"] == 0
+            assert metrics.snapshot()["counters"].get("autoscale_to_zero") == 1
+
+        run(scenario())
+
+
+# ------------------------------------------------------------ endpoint urls
+class TestEndpointUrls:
+    def test_ready_addresses_cross_named_port(self):
+        obj = _endpoints(["10.0.0.1", "10.0.0.2"]).to_dict()
+        assert sorted(endpoint_urls(obj)) == [
+            "http://10.0.0.1:8000",
+            "http://10.0.0.2:8000",
+        ]
+
+    def test_not_ready_addresses_are_excluded(self):
+        ep = Endpoints(
+            metadata=ObjectMeta(name=SERVICE, namespace="default"),
+            subsets=[
+                EndpointSubset(
+                    addresses=[EndpointAddress(ip="10.0.0.1")],
+                    not_ready_addresses=[EndpointAddress(ip="10.0.0.9")],
+                    ports=[EndpointPort(name="http", port=8000)],
+                )
+            ],
+        )
+        assert list(endpoint_urls(ep.to_dict())) == ["http://10.0.0.1:8000"]
+
+    def test_unnamed_single_port_falls_back_to_first(self):
+        ep = Endpoints(
+            metadata=ObjectMeta(name=SERVICE, namespace="default"),
+            subsets=[
+                EndpointSubset(
+                    addresses=[EndpointAddress(ip="10.0.0.1")],
+                    ports=[EndpointPort(port=9090)],
+                )
+            ],
+        )
+        assert list(endpoint_urls(ep.to_dict())) == ["http://10.0.0.1:9090"]
+
+    def test_ipv6_addresses_are_bracketed(self):
+        ep = Endpoints(
+            metadata=ObjectMeta(name=SERVICE, namespace="default"),
+            subsets=[
+                EndpointSubset(
+                    addresses=[EndpointAddress(ip="fd00::1")],
+                    ports=[EndpointPort(name="http", port=8000)],
+                )
+            ],
+        )
+        assert list(endpoint_urls(ep.to_dict())) == ["http://[fd00::1]:8000"]
+
+    def test_portless_subset_contributes_nothing(self):
+        ep = Endpoints(
+            metadata=ObjectMeta(name=SERVICE, namespace="default"),
+            subsets=[
+                EndpointSubset(addresses=[EndpointAddress(ip="10.0.0.1")])
+            ],
+        )
+        assert endpoint_urls(ep.to_dict()) == {}
+
+
+# ------------------------------------------------------- endpoint discovery
+def _discovery(api, router, **kw):
+    defaults = dict(
+        service=SERVICE, namespace="default",
+        kube_timeout_s=5.0, restart_delay_s=0.01,
+    )
+    defaults.update(kw)
+    return EndpointDiscovery(api, router, **defaults)
+
+
+class TestEndpointDiscovery:
+    def test_membership_follows_the_endpoints_object(self):
+        """list → join; MODIFIED scale-in → leave; DELETED → full drain —
+        all while the counters the metrics doc promises tick."""
+
+        async def scenario():
+            api = FakeKubeApi()
+            metrics = MetricsRegistry()
+            router = EngineRouter([], metrics=metrics)
+            await api.create_obj(_endpoints(["10.0.0.1", "10.0.0.2"]))
+            disc = _discovery(api, router)
+            stop = asyncio.Event()
+            task = asyncio.create_task(disc.run(stop))
+            assert await disc.wait_synced(2.0)
+            assert len(router) == 2
+            assert disc.members() == [
+                "http://10.0.0.1:8000", "http://10.0.0.2:8000",
+            ]
+
+            await api.patch(
+                "Endpoints", SERVICE, "default",
+                {"subsets": _endpoints(["10.0.0.1"]).to_dict()["subsets"]},
+            )
+            for _ in range(200):
+                if len(router) == 1:
+                    break
+                await asyncio.sleep(0.01)
+            assert disc.members() == ["http://10.0.0.1:8000"]
+
+            await api.delete("Endpoints", SERVICE, "default")
+            for _ in range(200):
+                if len(router) == 0:
+                    break
+                await asyncio.sleep(0.01)
+            assert len(router) == 0 and disc.members() == []
+
+            counters = metrics.snapshot()["counters"]
+            assert counters.get("ring_member_added") == 2
+            assert counters.get("ring_member_removed") == 2
+            assert counters.get("ring_resize") == 4
+
+            stop.set()
+            api.close_watches()
+            await asyncio.wait_for(task, 2)
+
+        run(scenario())
+
+    def test_prewarm_gate_defers_the_join(self):
+        """A False or raising pre-warm probe keeps the replica OFF the
+        ring; the next sync retries — a pod is never routable before it
+        answers its health probe."""
+
+        async def scenario():
+            api = FakeKubeApi()
+            router = EngineRouter([], metrics=MetricsRegistry())
+            ready = {"ok": False}
+            probed = []
+
+            async def prewarm(replica):
+                probed.append(replica.id)
+                return ready["ok"]
+
+            disc = _discovery(api, router, prewarm=prewarm)
+            obj = _endpoints(["10.0.0.1"]).to_dict()
+            await disc._sync(obj)
+            assert len(router) == 0 and probed == ["http://10.0.0.1:8000"]
+            ready["ok"] = True
+            await disc._sync(obj)
+            assert len(router) == 1 and disc.members() == ["http://10.0.0.1:8000"]
+
+            async def exploding(replica):
+                raise RuntimeError("probe refused")
+
+            disc.prewarm = exploding
+            await disc._sync(_endpoints(["10.0.0.1", "10.0.0.2"]).to_dict())
+            # the raising probe deferred .2's join and left .1 alone
+            assert disc.members() == ["http://10.0.0.1:8000"]
+
+        run(scenario())
+
+    def test_never_removes_members_it_did_not_add(self):
+        async def scenario():
+            api = FakeKubeApi()
+            router = EngineRouter(
+                [Replica(id="static-seed", url="http://static:8000")],
+                metrics=MetricsRegistry(),
+            )
+            disc = _discovery(api, router)
+            await disc._sync(_endpoints(["10.0.0.1"]).to_dict())
+            assert len(router) == 2
+            await disc._sync(None)
+            # the discovered member drained; the static seed survived
+            assert len(router) == 1 and disc.members() == []
+
+        run(scenario())
+
+    def test_watch_compaction_forces_a_relist(self):
+        """Membership written while the stream was down AND the cursor
+        compacted (410) must be recovered by the relist path."""
+
+        async def scenario():
+            api = FakeKubeApi()
+            router = EngineRouter([], metrics=MetricsRegistry())
+            await api.create_obj(_endpoints([]))
+            disc = _discovery(api, router, restart_delay_s=0.05)
+            stop = asyncio.Event()
+            task = asyncio.create_task(disc.run(stop))
+            assert await disc.wait_synced(2.0)
+            assert len(router) == 0
+
+            api.close_watches()
+            await api.patch(
+                "Endpoints", SERVICE, "default",
+                {"subsets": _endpoints(["10.0.0.1", "10.0.0.2"]).to_dict()["subsets"]},
+            )
+            api.compact_watch_history("Endpoints")
+            for _ in range(200):
+                if len(router) == 2:
+                    break
+                await asyncio.sleep(0.01)
+            assert len(router) == 2
+
+            stop.set()
+            api.close_watches()
+            await asyncio.wait_for(task, 2)
+
+        run(scenario())
+
+    def test_created_after_start_is_picked_up_by_the_watch(self):
+        async def scenario():
+            api = FakeKubeApi()
+            router = EngineRouter([], metrics=MetricsRegistry())
+            disc = _discovery(api, router)
+            stop = asyncio.Event()
+            task = asyncio.create_task(disc.run(stop))
+            assert await disc.wait_synced(2.0)
+            assert len(router) == 0
+            await api.create_obj(_endpoints(["10.0.0.1"]))
+            # a different service's Endpoints must be ignored
+            await api.create_obj(_endpoints(["10.9.9.9"], name="other-svc"))
+            for _ in range(200):
+                if len(router) == 1:
+                    break
+                await asyncio.sleep(0.01)
+            assert disc.members() == ["http://10.0.0.1:8000"]
+
+            stop.set()
+            api.close_watches()
+            await asyncio.wait_for(task, 2)
+
+        run(scenario())

@@ -762,3 +762,76 @@ def test_circuit_breaker_trips_opens_and_half_open_recovers():
         assert all(e["analysisStatus"] == "Failed" for e in entries[1:])
 
     run(scenario())
+
+
+def test_autoscaler_partitioned_from_scale_subresource_mid_scale_up():
+    """Chaos: the leader loses the Deployment ``scale`` subresource EXACTLY
+    while storm pressure demands a scale-up — but not its Endpoints traffic
+    (``inject_errors(kind="Deployment")`` narrows the partition), so
+    membership churn keeps landing on the ring throughout.  The autoscaler
+    degrades each failed patch to a counted ``blocked`` decision, never
+    crashes, and actuates on the first tick after the partition heals."""
+    from operator_tpu.operator.autoscale import AutoscaleController
+    from operator_tpu.operator.kubeapi import ApiError
+    from operator_tpu.router import EndpointDiscovery, EngineRouter
+    from operator_tpu.schema import (
+        Deployment,
+        DeploymentSpec,
+        EndpointAddress,
+        EndpointPort,
+        Endpoints,
+        EndpointSubset,
+    )
+
+    async def scenario():
+        api = FakeKubeApi()
+        metrics = MetricsRegistry()
+        await api.create("Deployment", Deployment(
+            metadata=ObjectMeta(name="podmortem-serving", namespace="ns"),
+            spec=DeploymentSpec(replicas=1),
+        ).to_dict())
+        controller = AutoscaleController(
+            api, deployment="podmortem-serving", namespace="ns",
+            min_replicas=0, max_replicas=4, target_pressure=4.0,
+            idle_s=60.0, kube_timeout_s=5.0,
+            fleet=lambda: {"queueDepth": 9, "inflight": 2, "pressure": 9.0},
+            metrics=metrics,
+        )
+        api.inject_errors(
+            "patch_scale", lambda: ApiError("apiserver partitioned"),
+            times=2, kind="Deployment",
+        )
+
+        first = await controller.tick()
+
+        # mid-partition, Endpoints traffic is untouched: a replica turning
+        # Ready during the storm still joins the consistent-hash ring
+        router = EngineRouter([], metrics=metrics)
+        discovery = EndpointDiscovery(
+            api, router, service="podmortem-serving", namespace="ns",
+            kube_timeout_s=5.0,
+        )
+        await api.create("Endpoints", Endpoints(
+            metadata=ObjectMeta(name="podmortem-serving", namespace="ns"),
+            subsets=[EndpointSubset(
+                addresses=[EndpointAddress(ip="10.0.0.1")],
+                ports=[EndpointPort(name="http", port=8000)],
+            )],
+        ).to_dict())
+        await discovery._relist()
+        assert len(router) == 1
+
+        second = await controller.tick()
+        assert first.action == "blocked" and second.action == "blocked"
+        assert "patch failed" in first.reason
+        counters = metrics.snapshot()["counters"]
+        assert counters.get("autoscale_blocked") == 2
+        assert counters.get("ring_member_added") == 1
+
+        healed = await controller.tick()
+        assert healed.action == "up" and healed.desired == 2
+        scale = await api.get_scale("Deployment", "podmortem-serving", "ns")
+        assert scale["spec"]["replicas"] == 2
+        assert metrics.snapshot()["counters"].get("autoscale_up") == 1
+
+    run(scenario())
