@@ -37,6 +37,75 @@ def _github_line(finding) -> str:
     )
 
 
+def _sarif_report(findings, rules) -> dict:
+    """SARIF 2.1.0 document for GitHub code scanning: one run, one result
+    per finding, rule metadata from the catalogue.  Deterministic field
+    order so artifact diffs are meaningful."""
+    known = {rule.id for rule in rules}
+    extra = sorted({f.rule for f in findings} - known)  # GL000 pragma/parse
+    driver_rules = [
+        {
+            "id": rule.id,
+            "name": rule.name,
+            "shortDescription": {"text": rule.description or rule.name},
+            "helpUri": "https://example.invalid/docs/ANALYSIS.md",
+        }
+        for rule in rules
+    ] + [
+        {
+            "id": rule_id,
+            "name": "framework",
+            "shortDescription": {
+                "text": "parse error or malformed graftlint pragma"
+            },
+        }
+        for rule_id in extra
+    ]
+    results = [
+        {
+            "ruleId": finding.rule,
+            "level": "error",
+            "message": {
+                "text": finding.message
+                + (f" [{finding.symbol}]" if finding.symbol else "")
+            },
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": finding.path,
+                            "uriBaseId": "%SRCROOT%",
+                        },
+                        "region": {"startLine": max(finding.line, 1)},
+                    }
+                }
+            ],
+        }
+        for finding in findings
+    ]
+    return {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftlint",
+                        "informationUri": (
+                            "https://example.invalid/docs/ANALYSIS.md"
+                        ),
+                        "rules": driver_rules,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+
+
 def _detect_root(start: Path) -> Path:
     """Nearest ancestor containing the package (or pyproject) — the repo
     root all finding paths are relative to."""
@@ -77,9 +146,27 @@ def main(argv: list[str] | None = None) -> int:
         help="comma-separated rule ids to run (default: all)",
     )
     parser.add_argument(
-        "--format", choices=("text", "json", "github"), default="text",
+        "--format", choices=("text", "json", "github", "sarif"),
+        default="text",
         help="github = workflow-command annotations (::error file=...) so "
-        "CI findings land inline on the PR diff",
+        "CI findings land inline on the PR diff; sarif = SARIF 2.1.0 on "
+        "stdout for the code-scanning upload",
+    )
+    parser.add_argument(
+        "--jobs", type=int, default=1, metavar="N",
+        help="run rules concurrently on N threads (parsed ASTs, symbol "
+        "tables and the callgraph are shared through the per-run context "
+        "memo; output is byte-identical to a serial run)",
+    )
+    parser.add_argument(
+        "--seam-coverage", type=Path, default=None, metavar="FILE",
+        help="write GL012's deterministic seam-coverage audit map (JSON) "
+        "to FILE — requires GL012 in the run",
+    )
+    parser.add_argument(
+        "--timings-budget", type=float, default=None, metavar="SECONDS",
+        help="fail (exit 1) when total rule wall time exceeds SECONDS — "
+        "CI asserts the full gate stays within budget",
     )
     parser.add_argument(
         "--changed-only", metavar="REF", default=None,
@@ -133,7 +220,9 @@ def main(argv: list[str] | None = None) -> int:
         print(exc, file=sys.stderr)
         return 2
     timings: dict = {}
-    findings, pragma_errors = run_analysis(ctx, rules, timings=timings)
+    findings, pragma_errors = run_analysis(
+        ctx, rules, timings=timings, jobs=max(1, args.jobs)
+    )
 
     if args.write_baseline:
         if args.baseline is None:
@@ -175,58 +264,93 @@ def main(argv: list[str] | None = None) -> int:
         stale = [key for key in stale if key[1] in analyzed]
     new = pragma_errors + new
 
-    if args.timings and args.format != "json":
+    if args.timings and args.format not in ("json", "sarif"):
         for rule in rules:
             print(f"timing: {rule.id}  {timings.get(rule.id, 0.0) * 1e3:8.1f} ms")
 
-    if args.format == "github":
+    if args.seam_coverage is not None:
+        coverage = ctx.caches.get("seam_coverage")
+        if coverage is None:
+            print("--seam-coverage requires rule GL012 in the run",
+                  file=sys.stderr)
+            return 2
+        args.seam_coverage.write_text(
+            json.dumps(coverage, indent=2, sort_keys=True) + "\n",
+            encoding="utf-8",
+        )
+
+    def emit() -> int:
+        if args.format == "github":
+            for finding in new:
+                print(_github_line(finding))
+            if new:
+                print(
+                    f"graftlint: {len(new)} finding(s) not in the baseline "
+                    "(docs/ANALYSIS.md)"
+                )
+                return 1
+            print(
+                f"graftlint: clean — {len(ctx.modules)} file(s), "
+                f"{len(rules)} rule(s)"
+            )
+            return 0
+
+        if args.format == "sarif":
+            # pure JSON on stdout (the upload artifact); the human
+            # summary rides stderr
+            print(json.dumps(_sarif_report(new, rules), indent=2))
+            print(
+                f"graftlint: {len(new)} finding(s) ({len(ctx.modules)} "
+                f"file(s), {len(rules)} rule(s))",
+                file=sys.stderr,
+            )
+            return 1 if new else 0
+
+        if args.format == "json":
+            print(json.dumps(
+                {
+                    "findings": [f.__dict__ for f in new],
+                    "baselined": len(findings) - (len(new) - len(pragma_errors)),
+                    "stale_baseline": [list(k) for k in stale],
+                },
+                indent=2,
+            ))
+            return 1 if new else 0
+
         for finding in new:
-            print(_github_line(finding))
+            print(finding.render())
+        for rule, path, symbol, message in stale:
+            sym = f" [{symbol}]" if symbol else ""
+            print(
+                f"note: stale baseline entry {rule} {path}{sym}: {message!r} "
+                "no longer matches — remove it from the baseline"
+            )
         if new:
             print(
-                f"graftlint: {len(new)} finding(s) not in the baseline "
-                "(docs/ANALYSIS.md)"
+                f"\ngraftlint: {len(new)} finding(s) not in the baseline "
+                "(see docs/ANALYSIS.md; suppress deliberate exceptions with "
+                "`# graftlint: disable=GLxxx reason=...`)"
             )
             return 1
+        suppressed = len(findings) - len(new) + len(pragma_errors)
         print(
             f"graftlint: clean — {len(ctx.modules)} file(s), "
-            f"{len(rules)} rule(s)"
+            f"{len(ALL_RULES) if not args.rules else len(rules)} rule(s), "
+            f"{suppressed} baselined finding(s)"
         )
         return 0
 
-    if args.format == "json":
-        print(json.dumps(
-            {
-                "findings": [f.__dict__ for f in new],
-                "baselined": len(findings) - (len(new) - len(pragma_errors)),
-                "stale_baseline": [list(k) for k in stale],
-            },
-            indent=2,
-        ))
-        return 1 if new else 0
-
-    for finding in new:
-        print(finding.render())
-    for rule, path, symbol, message in stale:
-        sym = f" [{symbol}]" if symbol else ""
+    code = emit()
+    total_wall = sum(timings.values())
+    if args.timings_budget is not None and total_wall > args.timings_budget:
         print(
-            f"note: stale baseline entry {rule} {path}{sym}: {message!r} "
-            "no longer matches — remove it from the baseline"
+            f"graftlint: rule wall time {total_wall:.2f}s exceeds "
+            f"--timings-budget {args.timings_budget:.2f}s — a rule grew "
+            "quadratic pain; see the per-rule --timings breakdown",
+            file=sys.stderr,
         )
-    if new:
-        print(
-            f"\ngraftlint: {len(new)} finding(s) not in the baseline "
-            "(see docs/ANALYSIS.md; suppress deliberate exceptions with "
-            "`# graftlint: disable=GLxxx reason=...`)"
-        )
-        return 1
-    suppressed = len(findings) - len(new) + len(pragma_errors)
-    print(
-        f"graftlint: clean — {len(ctx.modules)} file(s), "
-        f"{len(ALL_RULES) if not args.rules else len(rules)} rule(s), "
-        f"{suppressed} baselined finding(s)"
-    )
-    return 0
+        code = max(code, 1)
+    return code
 
 
 if __name__ == "__main__":

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import ast
 import re
+import threading
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Iterator, Optional
@@ -175,14 +176,25 @@ class AnalysisContext:
     """Everything a rule may look at: the repo root and every parsed module
     under the analysed trees.  ``module(relpath)`` is the per-file lookup;
     rules with generated-artifact checks also read non-Python files through
-    ``root``."""
+    ``root``.
+
+    The context is also the per-run memo: parsed ASTs live in ``modules``
+    (one parse per file per run, however many rules look at it), and
+    :meth:`memo` / :meth:`symbol_tables` share expensive derived structures
+    — symbol/callgraph tables, the jit reachability graph, auxiliary
+    out-of-scope parses — across rules.  Both are thread-safe so rules can
+    run concurrently under ``--jobs``."""
 
     root: Path
     modules: list[ModuleSource] = field(default_factory=list)
     _by_path: dict[str, ModuleSource] = field(default_factory=dict)
     #: scratch space for cross-rule shared computations (e.g. the jit
-    #: reachability graph GL001 and GL002 both need)
+    #: reachability graph GL001 and GL002 both need, the callgraph tables
+    #: GL006/GL011/GL012 share) — access through :meth:`memo`
     caches: dict = field(default_factory=dict)
+    #: reentrant: a memoized builder may itself read other memo entries
+    #: (GL012's package enumeration parses aux modules)
+    _memo_lock: "threading.RLock" = field(default_factory=threading.RLock)
 
     def add(self, module: ModuleSource) -> None:
         self.modules.append(module)
@@ -197,6 +209,45 @@ class AnalysisContext:
             if any(re.match(pattern, module.relpath) for pattern in patterns):
                 out.append(module)
         return out
+
+    def memo(self, key, builder):
+        """``caches[key]``, built once under the lock.  Rules running in
+        parallel (``--jobs``) must reach every shared computation through
+        here — two threads racing the same build would each pay the cost
+        and the loser's result would be silently dropped."""
+        with self._memo_lock:
+            value = self.caches.get(key)
+            if value is None:
+                value = builder()
+                self.caches[key] = value
+        return value
+
+    def symbol_tables(self, modules: list["ModuleSource"]):
+        """Shared :class:`~.callgraph.SymbolTables` over ``modules``,
+        memoized by the module set — rules with the same scope (GL011 and
+        GL012 both walk the control plane) build the tables once per run
+        instead of once per rule."""
+        from .callgraph import SymbolTables
+
+        key = ("symbol_tables", tuple(sorted(m.relpath for m in modules)))
+        return self.memo(key, lambda: SymbolTables(modules))
+
+    def aux_module(self, relpath: str) -> Optional["ModuleSource"]:
+        """Parse a repo file OUTSIDE the collected set (e.g. ``tests/``
+        under ``--changed-only``), memoized.  Returns the in-context module when
+        the path was collected normally.  None when the file is missing."""
+        hit = self._by_path.get(relpath)
+        if hit is not None:
+            return hit
+
+        def build():
+            path = self.root / relpath
+            if not path.is_file():
+                return ()
+            return ModuleSource(self.root, path)
+
+        built = self.memo(("aux_module", relpath), build)
+        return None if built == () else built
 
 
 class Rule:

@@ -13,6 +13,9 @@ from .gl007_replay import ReplayDeterminismRule
 from .gl008_mosaic import MosaicLowerabilityRule
 from .gl009_release import ResourceReleaseRule
 from .gl010_config import ConfigDriftRule
+from .gl011_await_atomicity import AwaitAtomicityRule
+from .gl012_seam_coverage import ChaosSeamCoverage
+from .gl013_mesh_axes import MeshAxisConsistency
 
 ALL_RULES: list[Rule] = [
     HostSyncInHotPath(),
@@ -25,6 +28,9 @@ ALL_RULES: list[Rule] = [
     MosaicLowerabilityRule(),
     ResourceReleaseRule(),
     ConfigDriftRule(),
+    AwaitAtomicityRule(),
+    ChaosSeamCoverage(),
+    MeshAxisConsistency(),
 ]
 
 

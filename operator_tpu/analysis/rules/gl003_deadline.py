@@ -62,6 +62,25 @@ def _terminal_name(func: ast.AST) -> str:
     return ""
 
 
+def external_call_label(call: ast.Call) -> Optional[str]:
+    """Label a blocking-external-call site, or None.  Shared with GL012:
+    the set of side-effecting sites the deadline rule budgets is exactly
+    the set the chaos-seam auditor must prove faultable."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        if func.attr in _KUBE_OPS and _is_api_handle(func.value):
+            return f"{ast.unparse(func)}(...)"
+        if func.attr == "generate":
+            return f"{ast.unparse(func)}(...)"
+        if func.attr == "communicate":
+            return f"{ast.unparse(func)}(...)"
+        if func.attr in ("urlopen", "_opener"):
+            return f"{ast.unparse(func)}(...)"
+    elif isinstance(func, ast.Name) and func.id in ("urlopen", "_opener"):
+        return f"{func.id}(...)"
+    return None
+
+
 class DeadlinePropagation(Rule):
     id = "GL003"
     name = "deadline-propagation"
@@ -126,19 +145,7 @@ class DeadlinePropagation(Rule):
 
     # -- matchers ------------------------------------------------------
     def _external_call(self, call: ast.Call) -> Optional[str]:
-        func = call.func
-        if isinstance(func, ast.Attribute):
-            if func.attr in _KUBE_OPS and _is_api_handle(func.value):
-                return f"{ast.unparse(func)}(...)"
-            if func.attr == "generate":
-                return f"{ast.unparse(func)}(...)"
-            if func.attr == "communicate":
-                return f"{ast.unparse(func)}(...)"
-            if func.attr in ("urlopen", "_opener"):
-                return f"{ast.unparse(func)}(...)"
-        elif isinstance(func, ast.Name) and func.id in ("urlopen", "_opener"):
-            return f"{func.id}(...)"
-        return None
+        return external_call_label(call)
 
     # -- guards --------------------------------------------------------
     @staticmethod

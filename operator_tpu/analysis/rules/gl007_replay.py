@@ -60,6 +60,11 @@ class ReplayDeterminismRule(Rule):
         r"operator_tpu/serving/sched/.*\.py$",
         r"operator_tpu/router/.*\.py$",
         r"operator_tpu/obs/sloledger\.py$",
+        # serverless-fleet arc (PR 17): the autoscaler's decide() is pure
+        # against an injected clock — a bare time.time()/random there
+        # would fork chaos replays of scale decisions (discovery.py rides
+        # the router/ glob above)
+        r"operator_tpu/operator/autoscale\.py$",
     )
 
     def check(self, ctx: AnalysisContext) -> list[Finding]:

@@ -54,6 +54,10 @@ _CFG_SCOPE = (
     "operator_tpu/serving/kvstore.py",
     "operator_tpu/serving/engine.py",
     "operator_tpu/ops/kv_transfer.py",
+    # serverless-fleet arc (PR 17): ring membership and scale ticks hold
+    # leases/guards whose early-return paths must discharge them too
+    "operator_tpu/router/discovery.py",
+    "operator_tpu/operator/autoscale.py",
 )
 
 

@@ -1,0 +1,285 @@
+"""GL013 — mesh-axis consistency for collectives and PartitionSpecs.
+
+A collective that names an axis the mesh never declared
+(``lax.psum(x, "model")`` under ``Mesh(..., ("dp", "fsdp", "tp"))``) and
+a ``PartitionSpec`` naming a nonexistent mesh axis both pass every
+single-device test and fail only at trace time on real multichip
+hardware — the exact failure mode the mixed-program sharding port
+(ROADMAP wave-deletion item) cannot afford to discover on a TPU pod.
+
+Scope model (``ops/`` + ``parallel/`` + ``serving/``):
+
+- **axis environment** — the set of axis names declared by the governing
+  mesh context.  The nearest lexically-enclosing ``with Mesh(...)``
+  (directly, or through a local ``m = Mesh(...)`` binding) shadows; with
+  no enclosing mesh the module environment applies: the union of every
+  ``Mesh(...)`` declaration in the module.  Axis tuples resolve through
+  literals and module-level constants (``AXES``), following ``from x
+  import y`` across the analysed set.  A module that declares NO mesh
+  has an empty environment and is skipped — its specs are checked where
+  a mesh is in scope (the rule verifies consistency, it does not demand
+  a mesh).
+- **collectives** — the ``lax.p*`` family plus ``all_gather`` /
+  ``all_to_all`` / ``axis_index``, reached as ``lax.<f>`` /
+  ``jax.lax.<f>`` or imported bare.  The checked axis comes from
+  ``axis_name=`` or its positional slot; unresolvable (dynamic) axis
+  expressions are skipped, not guessed.
+- **PartitionSpec** — every string axis in a ``PartitionSpec``/``P``
+  call (including inside tuple entries for multi-axis sharding) must be
+  declared by the governing environment.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..callgraph import attr_chain
+from ..core import AnalysisContext, Finding, ModuleSource, Rule
+
+#: lax collectives that are NOT spelled ``p*``
+_COLLECTIVES_EXTRA = {"all_gather", "all_to_all", "axis_index", "axis_size"}
+
+#: lax ``p*`` family members (explicit list: ``lax.pad``/``lax.pow`` are
+#: not collectives, so a bare ``p`` prefix match would flood)
+_P_FAMILY = {
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle",
+    "psum_scatter", "pbroadcast", "pdot", "pgather",
+}
+
+_COLLECTIVES = _P_FAMILY | _COLLECTIVES_EXTRA
+
+#: collectives whose axis_name is the FIRST positional argument
+_AXIS_FIRST = {"axis_index", "axis_size"}
+
+
+def _is_lax_root(chain: list[str]) -> bool:
+    return chain[:-1] in (["lax"], ["jax", "lax"])
+
+
+class MeshAxisConsistency(Rule):
+    id = "GL013"
+    name = "mesh-axis-consistency"
+    description = (
+        "every collective (lax.p* family, all_gather, axis_index) must name "
+        "an axis declared by the governing Mesh/shard_map context, and every "
+        "PartitionSpec axis must exist in that mesh — mistyped axes "
+        "otherwise fail only on multichip hardware"
+    )
+    scope = (
+        r"operator_tpu/ops/.*\.py$",
+        r"operator_tpu/parallel/.*\.py$",
+        r"operator_tpu/serving/.*\.py$",
+    )
+
+    def check(self, ctx: AnalysisContext) -> list[Finding]:
+        modules = ctx.in_scope(self.scope)
+        tables = ctx.symbol_tables(modules)
+        findings: list[Finding] = []
+        for module in modules:
+            if module.tree is None:
+                continue
+            consts = self._module_constants(module)
+            pspec_names = self._pspec_aliases(module)
+            lax_imports = self._lax_imports(module)
+
+            def resolve_axes(node: ast.AST) -> Optional[set[str]]:
+                """Axis-name set denoted by an expression, None when not
+                statically resolvable.  Follows module constants in this
+                module and, through the import table, in siblings."""
+                if isinstance(node, ast.Constant):
+                    return {node.value} if isinstance(node.value, str) else None
+                if isinstance(node, (ast.Tuple, ast.List)):
+                    out: set[str] = set()
+                    for element in node.elts:
+                        got = resolve_axes(element)
+                        if got is None:
+                            return None
+                        out |= got
+                    return out
+                if isinstance(node, ast.Name):
+                    if node.id in consts:
+                        return consts[node.id]
+                    imported = tables.imports.get(module.relpath, {}).get(node.id)
+                    if imported is not None:
+                        rel, orig = imported
+                        other = ctx.module(rel)
+                        if other is not None:
+                            return self._module_constants(other).get(orig)
+                return None
+
+            def mesh_axes(call: ast.Call) -> Optional[set[str]]:
+                """Axes a ``Mesh(devices, axis_names)`` call declares."""
+                chain = attr_chain(call.func)
+                if not chain or chain[-1] != "Mesh":
+                    return None
+                axis_arg: Optional[ast.AST] = None
+                if len(call.args) > 1:
+                    axis_arg = call.args[1]
+                for kw in call.keywords:
+                    if kw.arg == "axis_names":
+                        axis_arg = kw.value
+                if axis_arg is None:
+                    return None
+                return resolve_axes(axis_arg)
+
+            # module environment: union of every Mesh declaration
+            module_env: set[str] = set()
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.Call):
+                    got = mesh_axes(node)
+                    if got:
+                        module_env |= got
+
+            def local_mesh_binding(name: str, site: ast.AST) -> Optional[set[str]]:
+                """Axes of ``name`` when bound by ``name = Mesh(...)`` in
+                an enclosing def or at module level."""
+                scope = getattr(site, "_graftlint_parent", None)
+                while scope is not None:
+                    if isinstance(
+                        scope,
+                        (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module),
+                    ):
+                        for child in ast.walk(scope):
+                            if not isinstance(child, ast.Assign):
+                                continue
+                            if not any(
+                                isinstance(t, ast.Name) and t.id == name
+                                for t in child.targets
+                            ):
+                                continue
+                            if isinstance(child.value, ast.Call):
+                                got = mesh_axes(child.value)
+                                if got:
+                                    return got
+                    scope = getattr(scope, "_graftlint_parent", None)
+                return None
+
+            def check_axis_names(call, names, env, what):
+                for axis in sorted(names):
+                    if axis not in env:
+                        declared = ", ".join(sorted(env))
+                        findings.append(
+                            self.finding(
+                                module, call,
+                                f"{what} names axis '{axis}' not declared "
+                                f"by the governing mesh (axes: {declared}) "
+                                "— a mistyped axis fails only at trace "
+                                "time on multichip hardware",
+                            )
+                        )
+
+            def walk(node: ast.AST, env: set[str]) -> None:
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        expr = item.context_expr
+                        got = None
+                        if isinstance(expr, ast.Call):
+                            got = mesh_axes(expr)
+                        elif isinstance(expr, ast.Name):
+                            got = local_mesh_binding(expr.id, node)
+                        if got:
+                            # nearest mesh context SHADOWS — an inner
+                            # with Mesh(...) redefines the axis world
+                            env = got
+                if isinstance(node, ast.Call):
+                    self._check_call(
+                        node, env, pspec_names, lax_imports,
+                        resolve_axes, check_axis_names,
+                    )
+                for child in ast.iter_child_nodes(node):
+                    walk(child, env)
+
+            walk(module.tree, module_env)
+        return findings
+
+    # -- per-call check -------------------------------------------------
+    def _check_call(
+        self, call, env, pspec_names, lax_imports, resolve_axes, report
+    ) -> None:
+        chain = attr_chain(call.func)
+        name = chain[-1] if chain else ""
+        # collective?
+        is_collective = name in _COLLECTIVES and (
+            (len(chain) == 1 and name in lax_imports) or _is_lax_root(chain)
+        )
+        if is_collective and env:
+            slot = 0 if name in _AXIS_FIRST else 1
+            axis_arg = call.args[slot] if len(call.args) > slot else None
+            for kw in call.keywords:
+                if kw.arg == "axis_name":
+                    axis_arg = kw.value
+            axes = resolve_axes(axis_arg) if axis_arg is not None else None
+            if axes:
+                report(call, axes, env, f"collective {name}(...)")
+            return
+        # PartitionSpec?
+        if env and (
+            name in ("PartitionSpec",)
+            or (len(chain) == 1 and name in pspec_names)
+        ):
+            axes: set[str] = set()
+            for arg in call.args:
+                got = resolve_axes(arg)
+                if got:
+                    axes |= got
+            if axes:
+                report(call, axes, env, "PartitionSpec")
+
+    # -- per-module tables ----------------------------------------------
+    @staticmethod
+    def _module_constants(module: ModuleSource) -> dict[str, set[str]]:
+        """Module-level ``NAME = ("a", "b")`` / ``NAME = "a"`` string
+        constants — how ``AXES`` reaches ``Mesh(array, AXES)``."""
+        out: dict[str, set[str]] = {}
+        if module.tree is None:
+            return out
+        for node in module.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            axes: Optional[set[str]] = None
+            if isinstance(value, ast.Constant) and isinstance(value.value, str):
+                axes = {value.value}
+            elif isinstance(value, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in value.elts
+            ):
+                axes = {e.value for e in value.elts}
+            if axes is None:
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    out[target.id] = axes
+        return out
+
+    @staticmethod
+    def _pspec_aliases(module: ModuleSource) -> set[str]:
+        """Local names bound to ``jax.sharding.PartitionSpec`` (the repo
+        convention is ``... import PartitionSpec as P``)."""
+        names: set[str] = set()
+        if module.tree is None:
+            return names
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ImportFrom) and node.module and (
+                node.module.endswith("sharding") or node.module == "jax"
+            ):
+                for alias in node.names:
+                    if alias.name == "PartitionSpec":
+                        names.add(alias.asname or alias.name)
+        return names
+
+    @staticmethod
+    def _lax_imports(module: ModuleSource) -> set[str]:
+        """Collective names imported bare from ``jax.lax``."""
+        names: set[str] = set()
+        if module.tree is None:
+            return names
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ImportFrom) and node.module and (
+                node.module == "jax.lax" or node.module.endswith(".lax")
+            ):
+                for alias in node.names:
+                    if alias.name in _COLLECTIVES:
+                        names.add(alias.asname or alias.name)
+        return names

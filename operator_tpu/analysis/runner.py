@@ -81,6 +81,7 @@ def run_analysis(
     ctx: AnalysisContext,
     rules: Sequence[Rule],
     timings: Optional[dict] = None,
+    jobs: int = 1,
 ) -> tuple[list[Finding], list[Finding]]:
     """Run ``rules`` over ``ctx``.
 
@@ -91,17 +92,37 @@ def run_analysis(
     filled with per-rule wall seconds (rule id -> float) — the lint job
     prints these so a rule that grows quadratic pain is caught in review,
     not discovered as a slow CI mystery later.
+
+    ``jobs > 1`` runs rules concurrently on a thread pool.  Rules are
+    independent by contract — everything shared (parsed ASTs, symbol
+    tables, the jit graph) is read through the context's thread-safe memo
+    — and results are merged back in catalogue order, so the output is
+    byte-identical to a serial run.  Per-rule ``timings`` remain wall
+    times of each rule's own check, not of the pool.
     """
-    findings: list[Finding] = []
-    for rule in rules:
+
+    def run_one(rule: Rule) -> tuple[list[Finding], float]:
         started = time.perf_counter()
+        kept = []
         for finding in rule.check(ctx):
             module = ctx.module(finding.path)
             if module is not None and module.suppressed(finding.rule, finding.line):
                 continue
-            findings.append(finding)
+            kept.append(finding)
+        return kept, time.perf_counter() - started
+
+    findings: list[Finding] = []
+    if jobs > 1 and len(rules) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=jobs) as pool:
+            results = list(pool.map(run_one, rules))
+    else:
+        results = [run_one(rule) for rule in rules]
+    for rule, (kept, wall) in zip(rules, results):
+        findings.extend(kept)
         if timings is not None:
-            timings[rule.id] = time.perf_counter() - started
+            timings[rule.id] = wall
     pragma_errors: list[Finding] = []
     for module in ctx.modules:
         if module.parse_error:

@@ -698,10 +698,13 @@ class Operator:
             self.completion_task.cancel()  # stop mid-weight-load
             await asyncio.gather(self.completion_task, return_exceptions=True)
         self.completion_task = None
-        if self.completion_server is not None:
-            await self.completion_server.stop()
-            await self.completion_server.engine.close()
-            self.completion_server = None
+        # swap-then-act: detach the server reference BEFORE the awaits so a
+        # concurrent stop() (double SIGTERM) can't re-enter stop/close on a
+        # half-torn-down server
+        completion_server, self.completion_server = self.completion_server, None
+        if completion_server is not None:
+            await completion_server.stop()
+            await completion_server.engine.close()
         # graceful drain: in-flight analyses finish (their own deadlines
         # usually end them sooner) or are cancelled at the grace boundary —
         # a wedged analysis must not hold SIGTERM past the pod's

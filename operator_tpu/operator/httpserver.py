@@ -115,10 +115,12 @@ class HealthServer:
         log.info("health server listening on %s:%s", self.host, self.bound_port)
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
-            self._server = None
+        # swap-then-act: clear the attribute before awaiting so a concurrent
+        # stop() can't close the same server twice across the suspension
+        server, self._server = self._server, None
+        if server is not None:
+            server.close()
+            await server.wait_closed()
 
     # ------------------------------------------------------------------
     async def _handle(

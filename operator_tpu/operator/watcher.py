@@ -357,6 +357,7 @@ class PodFailureWatcher:
             except Exception:  # noqa: BLE001 - skip malformed objects
                 log.exception("unparseable Pod watch event; skipping")
                 if version:
+                    # graftlint: disable=GL011 reason=cursor advance is single-writer (one _watch_one task per namespace key); monotonic resourceVersion overwrite is the informer discipline
                     self._cursors[namespace] = version
                 continue
             await self.handle_pod_event(event.type, pod)
@@ -365,6 +366,7 @@ class PodFailureWatcher:
             # replays it (there is no per-restart sweep to catch a
             # skipped failure anymore)
             if version:
+                # graftlint: disable=GL011 reason=cursor advance is single-writer (one _watch_one task per namespace key); monotonic resourceVersion overwrite is the informer discipline
                 self._cursors[namespace] = version
             if stop.is_set():
                 return

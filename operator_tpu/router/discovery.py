@@ -152,6 +152,11 @@ class EndpointDiscovery:
                     log.info("discovery: %s not ready yet; join deferred",
                              replica_id)
                     continue
+                if replica_id in self._managed:
+                    # revalidate after the probe await: a concurrent sync
+                    # (watch event racing the relist) already admitted it —
+                    # adding again would double-register with the router
+                    continue
             self._managed.add(replica_id)
             self.router.add(replica)
             log.info("discovery: %s joined the serving fleet (pre-warmed, "
@@ -197,6 +202,7 @@ class EndpointDiscovery:
                             None if event.type == "DELETED" else event.object
                         )
                     if version:
+                        # graftlint: disable=GL011 reason=cursor advance is single-writer (one run() task per discovery); monotonic resourceVersion overwrite is the informer discipline
                         self._cursor = version
                     if stop.is_set():
                         return

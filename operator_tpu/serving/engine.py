@@ -2159,7 +2159,10 @@ class ServingEngine:
             if len(in_window) < self.MAX_RESETS_PER_WINDOW and not self._closed:
                 if self._error is not None:
                     await self._try_recover()
-                if self._error is None:
+                # revalidate after the recovery await: a concurrent failure
+                # may have re-armed _error/_gave_up while we suspended —
+                # only revive from a state observed AFTER the await
+                if self._gave_up and self._error is None:
                     self._gave_up = False
             if self._gave_up:
                 raise RuntimeError(
@@ -2409,15 +2412,21 @@ class ServingEngine:
                 # reclaim rows whose callers are gone (disconnects):
                 # per-token recycling frees their slot + pages THIS step
                 cancelled = [
-                    req_id for req_id, request in self._pending.items()
+                    (req_id, request)
+                    for req_id, request in self._pending.items()
                     if request.future.cancelled()
                 ]
                 if cancelled:
                     await loop.run_in_executor(
                         self._executor,
-                        lambda: [sched.cancel(r) for r in cancelled],
+                        lambda: [sched.cancel(r) for r, _ in cancelled],
                     )
-                    for req_id in cancelled:
+                    for req_id, request in cancelled:
+                        # identity revalidation after the executor await:
+                        # only reap the entry we observed — the id may have
+                        # been reaped elsewhere while the cancel ran
+                        if self._pending.get(req_id) is not request:
+                            continue
                         self._pending.pop(req_id, None)
                         self._partial_cbs.pop(req_id, None)
                         self._partial_sent.pop(req_id, None)
@@ -2485,6 +2494,7 @@ class ServingEngine:
                 admitted = await self._admit(batch)
                 # paged backpressure: requests beyond the KV free list stay
                 # in _inflight and retry as decode frees pages
+                # graftlint: disable=GL011 reason=_serve is the engine's sole consumer task; _inflight is its working set and the cleanup paths (close/crash) only run after this loop has exited
                 self._inflight = batch[admitted:]
                 allocator = getattr(self.generator, "allocator", None)
                 # record a stall only while active sequences hold pages —
@@ -2502,19 +2512,24 @@ class ServingEngine:
                 # timeouts): an abandoned request must not decode to
                 # max_tokens holding a slot and its KV pages
                 cancelled = [
-                    slot_id for slot_id, request in self._pending.items()
+                    (slot_id, request)
+                    for slot_id, request in self._pending.items()
                     if request.future.cancelled()
                 ]
                 if cancelled:
                     freed = await loop.run_in_executor(
                         self._executor,
-                        lambda: [self.generator.cancel(s) for s in cancelled],
+                        lambda: [self.generator.cancel(s) for s, _ in cancelled],
                     )
-                    for slot_id, reclaimed in zip(cancelled, freed):
+                    for (slot_id, request), reclaimed in zip(cancelled, freed):
                         # a chunk-prefilling (reserved) slot can't be
                         # cancelled mid-job: KEEP its future so the sweep
-                        # catches it once the wave activates
-                        if reclaimed:
+                        # catches it once the wave activates.  Identity
+                        # revalidation after the executor await: slots are
+                        # reused, so only reap the entry we observed — a
+                        # freed slot re-admitted while cancel ran must not
+                        # lose its fresh future
+                        if reclaimed and self._pending.get(slot_id) is request:
                             self._pending.pop(slot_id, None)
                             self._partial_cbs.pop(slot_id, None)
                             self._partial_sent.pop(slot_id, None)

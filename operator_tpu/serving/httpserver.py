@@ -238,18 +238,20 @@ class CompletionServer:
         and the engine waves they are riding — complete within the drain
         grace.  Requests still running at the boundary are abandoned to
         the engine close that follows (operator/app.py stop ordering)."""
-        if self._server is not None:
-            self._server.close()
+        # swap-then-act: detach the listener before awaiting so a concurrent
+        # stop() can't close the same server twice across the suspension
+        server, self._server = self._server, None
+        if server is not None:
+            server.close()
             try:
                 # 3.12.1+ wait_closed() ALSO waits for every connection
                 # handler — unbounded, a wedged streaming handler would
                 # hold shutdown here forever.  close() has already stopped
                 # the listener; the _drained wait below is the real
                 # (grace-bounded) drain, so bound this to a beat.
-                await asyncio.wait_for(self._server.wait_closed(), timeout=1.0)
+                await asyncio.wait_for(server.wait_closed(), timeout=1.0)
             except asyncio.TimeoutError:
                 pass
-            self._server = None
         if self._active_handlers:
             try:
                 await asyncio.wait_for(

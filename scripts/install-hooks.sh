@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Install the repo's git hooks: a pre-commit graftlint pass over exactly
+# the files you changed (`--changed-only HEAD`), so findings surface in
+# seconds at commit time instead of minutes later in CI.
+#
+#   bash scripts/install-hooks.sh
+#
+# The hook runs the full 13-rule catalogue but parses only the changed
+# files (repo-level artifact rules still check the whole tree — see
+# docs/ANALYSIS.md "Running locally").  Bypass for a work-in-progress
+# commit with `git commit --no-verify`; CI remains the hard gate.
+set -euo pipefail
+
+root="$(git rev-parse --show-toplevel)"
+hooks_dir="$(git -C "$root" rev-parse --git-path hooks)"
+mkdir -p "$hooks_dir"
+
+hook="$hooks_dir/pre-commit"
+if [ -e "$hook" ] && ! grep -q "operator_tpu.analysis" "$hook"; then
+    echo "refusing to overwrite existing non-graftlint hook: $hook" >&2
+    echo "append 'python -m operator_tpu.analysis --changed-only HEAD' to it yourself" >&2
+    exit 1
+fi
+
+cat > "$hook" <<'HOOK'
+#!/usr/bin/env bash
+# graftlint pre-commit (installed by scripts/install-hooks.sh):
+# lint the changed files against the committed baseline before CI does.
+set -euo pipefail
+cd "$(git rev-parse --show-toplevel)"
+exec python -m operator_tpu.analysis \
+    --baseline analysis-baseline.json \
+    --changed-only HEAD
+HOOK
+chmod +x "$hook"
+echo "installed graftlint pre-commit hook: $hook"

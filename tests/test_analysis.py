@@ -997,6 +997,430 @@ def test_gl010_negative_round_trip(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# GL011 await-atomicity
+# ---------------------------------------------------------------------------
+
+
+def test_gl011_positive_check_then_act_across_await(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL011", {
+        "operator_tpu/router/pool.py": """
+            class Pool:
+                async def evict(self, replica):
+                    if replica in self._members:        # read
+                        await self.probe(replica)       # world moves on
+                        self._members.discard(replica)  # act on the stale check
+
+                async def probe(self, replica):
+                    return replica
+        """,
+    })
+    assert len(findings) == 1
+    assert "self._members" in findings[0].message
+    assert "check-then-act" in findings[0].message
+    assert findings[0].symbol == "Pool.evict"
+
+
+def test_gl011_positive_async_for_step_taints_derived_write(tmp_path):
+    """An ``async for`` step suspends before each body run; a write
+    derived (through a local) from a pre-loop read is stale."""
+    findings, _ = run_rule(tmp_path, "GL011", {
+        "operator_tpu/operator/watchpump.py": """
+            class Pump:
+                async def run(self, stream):
+                    cursor = self._cursor
+                    async for event in stream:
+                        self._cursor = cursor + 1
+        """,
+    })
+    assert len(findings) == 1
+    assert "self._cursor" in findings[0].message
+
+
+def test_gl011_negative_revalidation_after_await(tmp_path):
+    """Re-reading the state after the await clears staleness — the write
+    is then based on the current world (the sanctioned membership
+    revalidation idiom, router/discovery.py's shape)."""
+    findings, _ = run_rule(tmp_path, "GL011", {
+        "operator_tpu/router/pool.py": """
+            class Pool:
+                async def evict(self, replica):
+                    if replica in self._members:
+                        await self.probe(replica)
+                        if replica in self._members:   # revalidate
+                            self._members.discard(replica)
+
+                async def probe(self, replica):
+                    return replica
+        """,
+    })
+    assert findings == []
+
+
+def test_gl011_negative_write_under_held_lock(tmp_path):
+    """A write inside ``async with`` on an inferred lock attribute is
+    serialized against competing coroutines (GL004's guard discipline)."""
+    findings, _ = run_rule(tmp_path, "GL011", {
+        "operator_tpu/router/pool.py": """
+            import asyncio
+
+            class Pool:
+                def __init__(self):
+                    self._lock = asyncio.Lock()
+                    self._members = set()
+
+                async def evict(self, replica):
+                    async with self._lock:
+                        if replica in self._members:
+                            await self.probe(replica)
+                            self._members.discard(replica)
+
+                async def probe(self, replica):
+                    return replica
+        """,
+    })
+    assert findings == []
+
+
+def test_gl011_negative_atomic_rmw_re_reads_at_the_write(tmp_path):
+    """``self.n += 1`` re-reads the location at the write with no
+    interleaving point between — not a TOCTOU even after an await."""
+    findings, _ = run_rule(tmp_path, "GL011", {
+        "operator_tpu/operator/counterd.py": """
+            class Counter:
+                async def bump(self):
+                    if self._count < self._limit:
+                        await self.flush()
+                        self._count += 1
+
+                async def flush(self):
+                    return None
+        """,
+    })
+    assert findings == []
+
+
+def test_gl011_pragma_with_reason_suppresses(tmp_path):
+    findings, pragma_errors = run_rule(tmp_path, "GL011", {
+        "operator_tpu/operator/cursord.py": """
+            class Watcher:
+                async def advance(self, stream):
+                    version = self._cursor
+                    await self.drain(stream)
+                    # graftlint: disable=GL011 reason=cursor advance is single-writer; monotonic overwrite is the informer discipline
+                    self._cursor = version + 1
+
+                async def drain(self, stream):
+                    return stream
+        """,
+    })
+    assert findings == []
+    assert pragma_errors == []
+
+
+# ---------------------------------------------------------------------------
+# GL012 chaos-seam coverage
+# ---------------------------------------------------------------------------
+
+
+def test_gl012_positive_uncovered_external_call(tmp_path):
+    """An external call no registered seam governs: chaos tests cannot
+    inject its failure."""
+    findings, _ = run_rule(tmp_path, "GL012", {
+        "operator_tpu/operator/pipeline.py": """
+            class P:
+                async def fetch(self, name):
+                    return await self.api.get("Pod", name, "ns")
+        """,
+    })
+    assert len(findings) == 1
+    assert "reachable from no registered fault seam" in findings[0].message
+    assert findings[0].path == "operator_tpu/operator/pipeline.py"
+
+
+def test_gl012_positive_seam_named_by_no_test(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL012", {
+        "operator_tpu/operator/gitops.py": """
+            class Git:
+                def push(self):
+                    self.fault_plan.apply("git.push")
+        """,
+    })
+    assert len(findings) == 1
+    assert "named by no chaos/loadgen test" in findings[0].message
+    assert "`git.push`" in findings[0].message
+
+
+def test_gl012_covered_round_trip_emits_clean_map(tmp_path):
+    """Seam on the call path (f-string widened to a glob) + a test
+    naming a concrete site under the glob -> no findings, and the
+    audit map records full coverage."""
+    ctx = make_ctx(tmp_path, {
+        "operator_tpu/operator/pipeline.py": """
+            class P:
+                async def fetch(self, op, name):
+                    self.fault_plan.apply(f"kube.{op}")
+                    return await self.api.get("Pod", name, "ns")
+        """,
+        "tests/test_chaos_fixture.py": """
+            SEAMS = ["kube.patch_status"]
+        """,
+    })
+    findings, _ = run_analysis(ctx, rules_by_id(["GL012"]))
+    assert findings == []
+    coverage = ctx.caches["seam_coverage"]
+    assert coverage["schema"] == 1
+    assert coverage["uncovered_sites"] == 0
+    assert coverage["unnamed_seams"] == 0
+    [seam] = coverage["seams"]
+    assert seam["pattern"] == "kube.*"
+    assert seam["tests"] == ["tests/test_chaos_fixture.py"]
+    [site] = coverage["external_call_sites"]
+    assert site["path"] == "operator_tpu/operator/pipeline.py"
+    assert site["seams"] == ["kube.*"]
+
+
+def test_gl012_seam_in_caller_governs_helper_site(tmp_path):
+    """Reachability runs the callgraph in both directions: a seam firing
+    in the caller governs the raw call inside the helper it descends
+    into."""
+    findings, _ = run_rule(tmp_path, "GL012", {
+        "operator_tpu/operator/pipeline.py": """
+            class P:
+                async def fetch(self, name):
+                    self.fault_plan.apply("kube.get")
+                    return await self._raw(name)
+
+                async def _raw(self, name):
+                    return await self.api.get("Pod", name, "ns")
+        """,
+        "tests/test_chaos_fixture.py": """
+            SEAM = "kube.get"
+        """,
+    })
+    assert findings == []
+
+
+def test_gl012_map_is_byte_deterministic_across_runs(tmp_path):
+    """The seam-coverage artifact must diff meaningfully in CI: two runs
+    over an unchanged tree serialize to identical bytes."""
+    files = {
+        "operator_tpu/operator/pipeline.py": """
+            class P:
+                async def fetch(self, op, name):
+                    self.fault_plan.apply(f"kube.{op}")
+                    return await self.api.get("Pod", name, "ns")
+
+                async def push(self):
+                    self.fault_plan.apply("git.push")
+        """,
+        "tests/test_chaos_fixture.py": """
+            SEAMS = ["kube.patch_status", "git.push"]
+        """,
+    }
+
+    def run_once():
+        ctx = make_ctx(tmp_path, files)
+        run_analysis(ctx, rules_by_id(["GL012"]))
+        return json.dumps(
+            ctx.caches["seam_coverage"], indent=2, sort_keys=True
+        )
+
+    assert run_once() == run_once()
+
+
+# ---------------------------------------------------------------------------
+# GL013 mesh-axis consistency
+# ---------------------------------------------------------------------------
+
+
+def test_gl013_positive_undeclared_collective_axis(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL013", {
+        "operator_tpu/parallel/comm.py": """
+            import jax
+            from jax.sharding import Mesh
+
+            def build(devices):
+                return Mesh(devices, ("dp", "tp"))
+
+            def allreduce(x):
+                return jax.lax.psum(x, "model")
+        """,
+    })
+    assert len(findings) == 1
+    assert "axis 'model'" in findings[0].message
+    assert "dp" in findings[0].message and "tp" in findings[0].message
+
+
+def test_gl013_positive_partitionspec_axis_not_in_mesh(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL013", {
+        "operator_tpu/parallel/shard.py": """
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            AXES = ("dp", "tp")
+
+            def specs(devices):
+                mesh = Mesh(devices, AXES)
+                return mesh, P(None, "model")
+        """,
+    })
+    assert len(findings) == 1
+    assert "PartitionSpec" in findings[0].message
+    assert "axis 'model'" in findings[0].message
+
+
+def test_gl013_nested_mesh_shadowing(tmp_path):
+    """The nearest enclosing ``with Mesh(...)`` SHADOWS the module
+    environment: an inner pipeline mesh redefines the axis world, so an
+    outer-mesh axis name inside it is a finding."""
+    findings, _ = run_rule(tmp_path, "GL013", {
+        "operator_tpu/parallel/pipe.py": """
+            import jax
+            from jax.sharding import Mesh
+
+            def run(devices, stage_devices, x):
+                mesh = Mesh(devices, ("dp", "tp"))
+                with Mesh(stage_devices, ("stage",)):
+                    y = jax.lax.ppermute(x, "tp", [(0, 1)])
+                return jax.lax.psum(x, "dp")
+        """,
+    })
+    assert len(findings) == 1
+    assert "ppermute" in findings[0].message
+    assert "axis 'tp'" in findings[0].message
+    assert "stage" in findings[0].message
+
+
+def test_gl013_negative_declared_axes_and_meshless_module(tmp_path):
+    """axis_name= keyword, bare lax imports, AXES constants resolved
+    cross-module, and a module that declares NO mesh (empty environment:
+    skipped, its specs are checked where a mesh is in scope)."""
+    findings, _ = run_rule(tmp_path, "GL013", {
+        "operator_tpu/parallel/mesh.py": """
+            AXES = ("dp", "tp")
+        """,
+        "operator_tpu/parallel/good.py": """
+            from jax.lax import psum
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            from operator_tpu.parallel.mesh import AXES
+
+            def reduce(devices, x):
+                with Mesh(devices, AXES):
+                    return psum(x, axis_name="dp"), P("dp", None)
+        """,
+        "operator_tpu/serving/layout.py": """
+            from jax.sharding import PartitionSpec as P
+
+            def spec():
+                return P(None, "model")
+        """,
+    })
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# widened scopes (PR 18): router/discovery.py + operator/autoscale.py
+# ---------------------------------------------------------------------------
+
+
+def test_gl006_widened_scope_discovery_positive(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL006", {
+        "operator_tpu/router/discovery.py": """
+            import time
+
+            class Discovery:
+                async def _sync(self):
+                    time.sleep(0.1)
+        """,
+    })
+    assert len(findings) == 1
+    assert "time.sleep" in findings[0].message
+
+
+def test_gl006_widened_scope_autoscale_negative_offload(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL006", {
+        "operator_tpu/operator/autoscale.py": """
+            import asyncio
+            import time
+
+            class Autoscaler:
+                async def tick(self):
+                    await asyncio.to_thread(self._measure)
+
+                def _measure(self):
+                    time.sleep(0.1)
+        """,
+    })
+    assert findings == []
+
+
+def test_gl007_widened_scope_autoscale_positive(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL007", {
+        "operator_tpu/operator/autoscale.py": """
+            import random
+            import time
+
+            def decide(depth):
+                return time.time() + random.random() * depth
+        """,
+    })
+    messages = [f.message for f in findings]
+    assert len(findings) == 2
+    assert any("time.time()" in m for m in messages)
+    assert any("random.random" in m for m in messages)
+
+
+def test_gl007_widened_scope_autoscale_negative_injected_clock(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL007", {
+        "operator_tpu/operator/autoscale.py": """
+            import random
+            import time
+
+            class Autoscaler:
+                def __init__(self, seed, clock=None):
+                    self._clock = clock or time.monotonic  # seam: uncalled
+                    self._rng = random.Random(seed)
+
+                def decide(self):
+                    return self._clock() + self._rng.random()
+        """,
+    })
+    assert findings == []
+
+
+def test_gl009_widened_scope_discovery_positive(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL009", {
+        "operator_tpu/router/discovery.py": """
+            class Ring:
+                def join(self, n):
+                    lease = self.leases.acquire()
+                    if n > 8:
+                        return None       # lease dropped: leak
+                    self.members.append(lease)
+                    return lease
+        """,
+    })
+    assert len(findings) == 1
+    assert "early return" in findings[0].message
+    assert "`lease`" in findings[0].message
+
+
+def test_gl009_widened_scope_autoscale_negative_finally(tmp_path):
+    findings, _ = run_rule(tmp_path, "GL009", {
+        "operator_tpu/operator/autoscale.py": """
+            class Autoscaler:
+                def scale(self, n):
+                    lease = self.leases.acquire()
+                    try:
+                        self.commit(n)
+                    finally:
+                        self.leases.free(lease)
+        """,
+    })
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # pragmas
 # ---------------------------------------------------------------------------
 
@@ -1144,6 +1568,7 @@ def test_cli_list_rules(capsys):
     for rule_id in (
         "GL001", "GL002", "GL003", "GL004", "GL005",
         "GL006", "GL007", "GL008", "GL009", "GL010",
+        "GL011", "GL012", "GL013",
     ):
         assert rule_id in out
 
@@ -1335,3 +1760,207 @@ def test_cli_changed_only_excludes_explicit_paths(tmp_path, capsys):
     ])
     assert rc == 2
     assert "mutually exclusive" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip for the v3 rule ids
+# ---------------------------------------------------------------------------
+
+_V3_POSITIVES = {
+    "operator_tpu/router/pool.py": """
+        class Pool:
+            async def evict(self, replica):
+                if replica in self._members:
+                    await self.probe(replica)
+                    self._members.discard(replica)
+
+            async def probe(self, replica):
+                return replica
+    """,
+    "operator_tpu/operator/pipeline.py": """
+        class P:
+            async def fetch(self, name):
+                return await self.api.get("Pod", name, "ns")
+    """,
+    "operator_tpu/parallel/comm.py": """
+        import jax
+        from jax.sharding import Mesh
+
+        def build(devices):
+            return Mesh(devices, ("dp", "tp"))
+
+        def allreduce(x):
+            return jax.lax.psum(x, "model")
+    """,
+}
+
+
+def test_baseline_round_trip_new_rule_ids(tmp_path):
+    """GL011/GL012/GL013 findings absorb, survive line drift in identity,
+    and turn stale (not new) when the debt is paid — same contract as the
+    original ten rules."""
+    ctx = make_ctx(tmp_path, dict(_V3_POSITIVES))
+    findings, _ = run_analysis(
+        ctx, rules_by_id(["GL011", "GL012", "GL013"])
+    )
+    assert {f.rule for f in findings} == {"GL011", "GL012", "GL013"}
+
+    baseline_path = tmp_path / "bl.json"
+    write_baseline(baseline_path, findings)
+    baseline = load_baseline(baseline_path)
+    new, stale = baseline.filter(findings)
+    assert new == [] and stale == []
+
+    new2, stale2 = baseline.filter([])
+    assert new2 == [] and len(stale2) == len(findings)
+
+
+# ---------------------------------------------------------------------------
+# SARIF output
+# ---------------------------------------------------------------------------
+
+
+def test_cli_sarif_schema_and_findings(tmp_path, capsys):
+    """--format sarif prints a SARIF 2.1.0 document on stdout: driver
+    metadata for the full catalogue, one result per finding with a
+    %SRCROOT%-relative physical location."""
+    (tmp_path / "operator_tpu/operator").mkdir(parents=True)
+    (tmp_path / "operator_tpu/operator/pipeline.py").write_text(
+        "class P:\n"
+        "    async def fetch(self, name):\n"
+        "        return await self.api.get('Pod', name, 'ns')\n"
+    )
+    rc = cli_main(["--root", str(tmp_path), "--format", "sarif"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+    driver = doc["runs"][0]["tool"]["driver"]
+    assert driver["name"] == "graftlint"
+    rule_ids = [r["id"] for r in driver["rules"]]
+    assert len(rule_ids) == len(set(rule_ids))
+    for rule_id in (f"GL{i:03d}" for i in range(1, 14)):
+        assert rule_id in rule_ids
+    assert all(r["shortDescription"]["text"] for r in driver["rules"])
+    results = doc["runs"][0]["results"]
+    assert results, "expected at least the GL003 finding"
+    for result in results:
+        assert result["level"] == "error"
+        assert result["message"]["text"]
+        location = result["locations"][0]["physicalLocation"]
+        assert location["artifactLocation"]["uriBaseId"] == "%SRCROOT%"
+        assert location["region"]["startLine"] >= 1
+    assert any(
+        r["ruleId"] == "GL003"
+        and r["locations"][0]["physicalLocation"]["artifactLocation"]["uri"]
+        == "operator_tpu/operator/pipeline.py"
+        for r in results
+    )
+
+
+def test_cli_sarif_clean_run_exits_zero(tmp_path, capsys):
+    (tmp_path / "operator_tpu").mkdir()
+    (tmp_path / "operator_tpu/mod.py").write_text("X = 1\n")
+    rc = cli_main(["--root", str(tmp_path), "--format", "sarif"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["runs"][0]["results"] == []
+
+
+def test_cli_sarif_gl000_gets_a_driver_rule_entry(tmp_path, capsys):
+    """Framework findings (malformed pragma) are outside the catalogue —
+    the SARIF driver must still declare their ruleId."""
+    (tmp_path / "operator_tpu").mkdir()
+    (tmp_path / "operator_tpu/mod.py").write_text(
+        "X = 1  # graftlint: disable=GL003\n"  # missing reason=
+    )
+    rc = cli_main(["--root", str(tmp_path), "--format", "sarif"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    driver_ids = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    result_ids = {r["ruleId"] for r in doc["runs"][0]["results"]}
+    assert result_ids <= driver_ids
+
+
+# ---------------------------------------------------------------------------
+# --jobs / --seam-coverage / --timings-budget
+# ---------------------------------------------------------------------------
+
+
+def test_cli_jobs_output_is_byte_identical_to_serial(tmp_path, capsys):
+    """--jobs N shares the context memo across threads and merges results
+    in catalogue order: stdout must match the serial run exactly."""
+    ctx_files = dict(_V3_POSITIVES)
+    for rel, text in ctx_files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(text), encoding="utf-8")
+    rc_serial = cli_main(["--root", str(tmp_path)])
+    out_serial = capsys.readouterr().out
+    rc_parallel = cli_main(["--root", str(tmp_path), "--jobs", "4"])
+    out_parallel = capsys.readouterr().out
+    assert rc_serial == rc_parallel == 1
+    assert out_serial == out_parallel
+
+
+def test_cli_seam_coverage_writes_deterministic_map(tmp_path, capsys):
+    (tmp_path / "operator_tpu/operator").mkdir(parents=True)
+    (tmp_path / "operator_tpu/operator/pipeline.py").write_text(
+        textwrap.dedent("""
+            class P:
+                async def fetch(self, op, name):
+                    self.fault_plan.apply(f"kube.{op}")
+                    return await self.api.get("Pod", name, "ns")
+        """),
+    )
+    (tmp_path / "tests").mkdir()
+    (tmp_path / "tests/test_chaos_fixture.py").write_text(
+        'SEAM = "kube.get"\n'
+    )
+    out_path = tmp_path / "seam-coverage.json"
+    rc = cli_main([
+        "--root", str(tmp_path), "--rules", "GL012",
+        "--seam-coverage", str(out_path),
+    ])
+    capsys.readouterr()
+    assert rc == 0
+    first = out_path.read_bytes()
+    payload = json.loads(first)
+    assert payload["schema"] == 1
+    assert payload["uncovered_sites"] == 0
+    assert payload["unnamed_seams"] == 0
+    rc = cli_main([
+        "--root", str(tmp_path), "--rules", "GL012",
+        "--seam-coverage", str(out_path),
+    ])
+    capsys.readouterr()
+    assert rc == 0
+    assert out_path.read_bytes() == first
+
+
+def test_cli_seam_coverage_requires_gl012(tmp_path, capsys):
+    (tmp_path / "operator_tpu").mkdir()
+    (tmp_path / "operator_tpu/mod.py").write_text("X = 1\n")
+    rc = cli_main([
+        "--root", str(tmp_path), "--rules", "GL001",
+        "--seam-coverage", str(tmp_path / "map.json"),
+    ])
+    assert rc == 2
+    assert "GL012" in capsys.readouterr().err
+    assert not (tmp_path / "map.json").exists()
+
+
+def test_cli_timings_budget_gate(tmp_path, capsys):
+    """--timings-budget folds a wall-time ceiling into the exit code —
+    the CI guard that a rule has not grown quadratic."""
+    (tmp_path / "operator_tpu").mkdir()
+    (tmp_path / "operator_tpu/mod.py").write_text("X = 1\n")
+    assert cli_main([
+        "--root", str(tmp_path), "--timings-budget", "3600",
+    ]) == 0
+    capsys.readouterr()
+    rc = cli_main(["--root", str(tmp_path), "--timings-budget", "0"])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "exceeds" in captured.err
+    assert "clean" in captured.out  # findings-wise the run is still clean

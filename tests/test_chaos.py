@@ -835,3 +835,54 @@ def test_autoscaler_partitioned_from_scale_subresource_mid_scale_up():
         assert metrics.snapshot()["counters"].get("autoscale_up") == 1
 
     run(scenario())
+
+
+def test_healthz_partition_trips_probe_and_recovers_when_plan_drains():
+    """Chaos at the ``http.healthz`` seam: an injected partition (the
+    transport itself stays healthy) fails the poll sweep's probe — the
+    replica leaves the routable set — and fails the discovery join gate
+    (``prewarm_replica`` raises, deferring the join).  Once the plan's
+    rule is exhausted the next sweep readmits the replica, and the whole
+    scenario replays byte-identically under equal seeds."""
+    import io
+    import json as _json
+
+    from operator_tpu.router.core import Replica
+
+    class _Resp(io.BytesIO):
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    def opener(req, timeout=None):
+        return _Resp(_json.dumps(
+            {"status": "ok", "load": {"queueDepth": 0}}
+        ).encode())
+
+    def scenario(seed: int):
+        plan = FaultPlan(seed=seed)
+        plan.rule(
+            "http.healthz",
+            times(2, raise_(lambda: OSError("partitioned"), label="partition")),
+        )
+        provider = OpenAICompatProvider(opener, metrics=MetricsRegistry())
+        provider.fault_plan = plan
+        replica = Replica(id="http://r1:8000", url="http://r1:8000")
+        router = provider.router_for([replica])
+
+        # fault 1: the background sweep's probe dies at the seam
+        run(provider.poll_replica_health(timeout_s=1.0))
+        assert not router.health.can_route("http://r1:8000")
+        # fault 2: the join gate rides the same seam — the probe raises
+        # and the discovery loop (which catches it) would defer the join
+        with pytest.raises(OSError):
+            run(provider.prewarm_replica(replica))
+        # plan drained: the next sweep's probe passes and readmits
+        run(provider.poll_replica_health(timeout_s=1.0))
+        assert router.health.can_route("http://r1:8000")
+        assert plan.pending() == {}  # every declared fault actually fired
+        return plan.trace()
+
+    assert scenario(11) == scenario(11)
