@@ -461,6 +461,191 @@ def bench_kv_economy(params, config, tokenizer, *, slots: int, max_seq: int,
     return out
 
 
+def bench_kv_fabric(params, config, tokenizer, *, slots: int, max_seq: int,
+                    page_size: int) -> dict:
+    """Price the fleet KV fabric (operator_tpu/fabric/, docs/FABRIC.md)
+    on CPU smoke:
+
+    - **fetch vs recompute TTFT**: replica A computes a >=8-block prompt
+      and mirrors its pages; replica B's cold lane prefills the same
+      prompt from scratch, then (cache reset) its warm-peer lane pulls
+      A's pages through the real wire format + fetch client and restores
+      them by DMA.  The warm-peer time INCLUDES the fetch itself — the
+      honest arrival-to-token-one comparison — and both lanes must stay
+      greedy byte-identical;
+    - **disaggregated vs mixed storm goodput**: the same seeded arrival
+      schedule against a 3-mixed fleet and a 1-prefill + 2-decode fleet
+      in disaggregated dispatch, goodput-under-SLO each.
+    """
+    from operator_tpu.fabric import FabricFetcher, FabricIndex, encode_block
+    from operator_tpu.loadgen import ArrivalProcess, ArrivalSpec
+    from operator_tpu.loadgen.storm import (
+        SyntheticReplica, build_storm_stack, run_storm,
+    )
+    from operator_tpu.ops.kv_transfer import HostKVPool
+    from operator_tpu.serving.engine import BatchedGenerator, SamplingParams
+    from operator_tpu.serving.kvstore import PrefixKVStore, block_hashes
+    from operator_tpu.serving.sched import Scheduler
+    from operator_tpu.serving.types import prompt_budget
+    from operator_tpu.utils.timing import MetricsRegistry
+
+    # The warm-peer claim is judged on an >=8-block prompt, and the
+    # prompt must FIT the truncation budget — or enqueue tail-truncates
+    # it and every block hash changes out from under the mirror.  Two
+    # traps: the generator clamps max_seq to config.max_seq_len (256
+    # for tiny-test), and at the default page_size=64 with max_seq=512
+    # the two constraints cannot both hold (8 blocks = 512 tokens > 511
+    # budget).  So size the lane's OWN page off the effective budget.
+    eff_seq = min(max_seq, config.max_seq_len)
+    budget = prompt_budget(eff_seq, 2)
+    fabric_page = min(page_size, 32)
+    while fabric_page > 8 and 9 * fabric_page > budget:
+        fabric_page //= 2
+
+    def make_replica(*, mirror):
+        metrics = MetricsRegistry()
+        generator = BatchedGenerator(
+            params, config, tokenizer, max_slots=slots, max_seq=max_seq,
+            paged=True, page_size=fabric_page, metrics=metrics,
+        )
+        store = PrefixKVStore(
+            generator.page_size, host_pool=HostKVPool(64), metrics=metrics,
+        )
+        return Scheduler(generator, kvstore=store, fabric_mirror=mirror), \
+            generator, store
+
+    def drain(sched, req_id, limit=2000):
+        for _ in range(limit):
+            for outcome in sched.step():
+                if outcome.req_id == req_id:
+                    return outcome
+        raise RuntimeError("kv fabric bench request never finished")
+
+    # two tokens, not one: mirroring piggybacks on the NEXT commit
+    # window's host sync (scheduler._drain_mirror), so a 1-token request
+    # would finish with its blocks still queued; token two opens exactly
+    # one more window.  All three lanes use the same params, so the
+    # cold/warm comparison stays equal-footing.
+    one_tok = SamplingParams(max_tokens=2, temperature=0.0, stop_on_eos=False)
+    template = ("analyse this pod failure: the container was OOMKilled "
+                "after exceeding its memory limit; ")
+    # grow the prompt in token space, not char space: stop once it spans
+    # >8 full blocks, and never cross the truncation budget
+    prompt = template
+    while (len(tokenizer.encode(prompt)) < 9 * fabric_page
+           and len(tokenizer.encode(prompt + template)) <= budget):
+        prompt += template
+    tokens = tokenizer.encode(prompt)
+    hashes = block_hashes(tokens, fabric_page)
+    assert len(hashes) >= 8, (
+        f"fabric bench prompt spans only {len(hashes)} blocks "
+        f"({len(tokens)} tokens at page {fabric_page}, budget {budget}); "
+        "the warm-peer claim is judged on >= 8"
+    )
+
+    # replica A: the holder — compute + mirror (compile outside the lane)
+    sched_a, _gen_a, store_a = make_replica(mirror=True)
+    drain(sched_a, sched_a.enqueue("warmup " + prompt[: len(prompt) // 2],
+                                   one_tok))
+    ref = drain(sched_a, sched_a.enqueue(prompt, one_tok))
+    assert all(store_a.host_pool.has(h) for h in hashes), \
+        "holder failed to mirror the prompt's blocks"
+
+    index = FabricIndex()
+    index.update("bench-a", [h.hex() for h in hashes], url="http://bench-a")
+
+    async def transport(url, budget_s):
+        hash_hex = url.rsplit("/", 1)[-1]
+        page = store_a.host_pool.get(bytes.fromhex(hash_hex))
+        if page is None:
+            return 404, b""
+        return 200, encode_block(bytes.fromhex(hash_hex), *page)
+
+    # replica B: cold lane (full prefill), then warm-peer lane (fetch +
+    # adopt + DMA restore) after a cache reset — same compiled programs
+    sched_b, gen_b, store_b = make_replica(mirror=False)
+    drain(sched_b, sched_b.enqueue("warmup " + prompt[: len(prompt) // 2],
+                                   one_tok))
+    started = time.perf_counter()
+    cold = drain(sched_b, sched_b.enqueue(prompt, one_tok))
+    cold_s = time.perf_counter() - started
+    sched_b.reset()
+
+    fetcher = FabricFetcher(
+        index, transport=transport, self_id="bench-b",
+        metrics=gen_b.metrics,
+    )
+    started = time.perf_counter()
+    adopted = asyncio.run(fetcher.prefetch(tokens, store=store_b))
+    warm = drain(sched_b, sched_b.enqueue(prompt, one_tok))
+    warm_s = time.perf_counter() - started
+    assert adopted == len(hashes), \
+        f"adopted {adopted}/{len(hashes)} fetched blocks"
+    assert (list(cold.result.token_ids) == list(warm.result.token_ids)
+            == list(ref.result.token_ids)), "fabric lanes diverged"
+
+    # disagg vs mixed: one seeded schedule, two fleet shapes
+    async def storm_goodput(fleet, disaggregate):
+        spec = ArrivalSpec(
+            name="fabric-storm",
+            rate_per_min=float(os.environ.get(
+                "BENCH_FABRIC_RATE_PER_MIN", "240")),
+            duration_s=float(os.environ.get(
+                "BENCH_FABRIC_DURATION_S", "3")),
+        )
+        process = ArrivalProcess(spec, seed=11)
+        stack = await build_storm_stack(
+            replicas=fleet, time_scale=0.2, disaggregate=disaggregate,
+        )
+        report = await run_storm(stack, process, drain_s=20.0)
+        stack.close()
+        total = report["slo"]["total"]
+        return {
+            "goodput_per_min": total["goodput_analyses_per_min"],
+            "attainment": total["attainment"],
+            "handoffs": stack.metrics.counter("fabric_disagg_handoff"),
+        }
+
+    mixed = asyncio.run(storm_goodput(
+        [SyntheticReplica(f"fabric-mixed-{i}", concurrency=2,
+                          time_scale=0.2) for i in range(3)],
+        False,
+    ))
+    disagg = asyncio.run(storm_goodput(
+        [SyntheticReplica("fabric-prefill-0", concurrency=2,
+                          time_scale=0.2, role="prefill"),
+         SyntheticReplica("fabric-decode-0", concurrency=2,
+                          time_scale=0.2, role="decode"),
+         SyntheticReplica("fabric-decode-1", concurrency=2,
+                          time_scale=0.2, role="decode")],
+        True,
+    ))
+
+    out = {
+        "prompt_blocks": len(hashes),
+        "ttft_cold_s": round(cold_s, 4),
+        "ttft_warm_peer_s": round(warm_s, 4),
+        "warm_peer_speedup": round(cold_s / warm_s, 2) if warm_s > 0 else None,
+        "warm_peer_faster": bool(warm_s < cold_s),
+        "fetched_ok": gen_b.metrics.counter("fabric_fetch_ok"),
+        "adopted": adopted,
+        "restores": gen_b.metrics.counter("kv_restore"),
+        "byte_identical": True,  # asserted above; a divergence raises
+        "storm_mixed": mixed,
+        "storm_disagg": disagg,
+        "disagg_vs_mixed_goodput": (
+            round(disagg["goodput_per_min"] / mixed["goodput_per_min"], 3)
+            if mixed["goodput_per_min"] else None
+        ),
+    }
+    log(f"kv_fabric: ttft cold={out['ttft_cold_s']}s "
+        f"warm-peer={out['ttft_warm_peer_s']}s "
+        f"(x{out['warm_peer_speedup']}, {len(hashes)} blocks) "
+        f"goodput mixed={mixed['goodput_per_min']:.0f}/min "
+        f"disagg={disagg['goodput_per_min']:.0f}/min")
+    return out
+
+
 def bench_cold_start(params, config, tokenizer, *, slots: int, max_seq: int,
                      page_size: int, decode_block: int) -> dict:
     """Token-one latency from replica-does-not-exist (docs/SCALING.md):
@@ -984,6 +1169,17 @@ def main() -> None:
             page_size=page_size,
         )
 
+    # fleet KV fabric: peer fetch vs recompute TTFT + disaggregated vs
+    # mixed storm goodput (docs/FABRIC.md), CPU-measurable like kv/mixed
+    kv_fabric = None
+    if os.environ.get("BENCH_KV_FABRIC", "1") == "1":
+        log("kv-fabric scenario (peer fetch vs recompute / disagg vs mixed)")
+        kv_fabric = bench_kv_fabric(
+            params, config, tokenizer,
+            slots=min(slots, 8), max_seq=min(max_seq, 512),
+            page_size=page_size,
+        )
+
     # cold-start: token-one from replica-does-not-exist — the serverless
     # wake the autoscaler's scale-to-zero bets on (docs/SCALING.md)
     cold_start = None
@@ -1047,6 +1243,32 @@ def main() -> None:
                 "(p50 null in every judged storm)"
             )
         log(f"open-loop SLO headline is null: {slo_gate_reason}")
+    # every per-rate record carries its own judging verdict, so a reader
+    # of ONE record knows whether (and why not) it fed the SLO headline
+    for result in open_results:
+        if "error" in result:
+            result["gate"] = {"judged": False, "reason": result["error"]}
+        elif result["rate_per_min"] < 100:
+            result["gate"] = {
+                "judged": False,
+                "reason": "rate below the 100/min SLO judging floor",
+            }
+        elif result.get("p50_s") is None:
+            result["gate"] = {
+                "judged": False,
+                "reason": "zero completed analyses (p50 null)",
+            }
+        else:
+            result["gate"] = {"judged": True, "reason": None}
+    # a lane that was ENABLED but produced neither records nor a gate
+    # reason is the silently-dead shape BENCH_r04/r05 shipped — refuse to
+    # publish it at all
+    if open_enabled and not open_results and slo_gate_reason is None:
+        raise SystemExit(
+            "bench: open-loop lane enabled but open_loop is empty with a "
+            "null open_loop_gate.reason — a silently-dead storm lane; "
+            "fix the lane or disable it explicitly with BENCH_OPEN=0"
+        )
     print(json.dumps({
         "metric": "explanations_per_min",
         "value": round(per_min, 1),
@@ -1075,6 +1297,7 @@ def main() -> None:
         ),
         "mixed": mixed,
         "kv_economy": kv_economy,
+        "kv_fabric": kv_fabric,
         # token-one-from-zero, AOT-warm vs AOT-cold split — the number
         # SCALE_TO_ZERO_IDLE_S trades against (docs/SCALING.md)
         "cold_start": cold_start,

@@ -118,6 +118,10 @@ class DeadlinePropagation(Rule):
         # external call it ever grows must be budget-bound too
         r"operator_tpu/router/.*\.py$",
         r"operator_tpu/utils/journal\.py$",
+        # fleet KV fabric (ISSUE 19): every peer page fetch must spend its
+        # residual budget AT the transport call — a wedged holder must
+        # never cost more than the recompute the fetch was replacing
+        r"operator_tpu/fabric/.*\.py$",
     )
 
     def check(self, ctx: AnalysisContext) -> list[Finding]:

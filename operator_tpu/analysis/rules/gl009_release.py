@@ -58,6 +58,9 @@ _CFG_SCOPE = (
     # leases/guards whose early-return paths must discharge them too
     "operator_tpu/router/discovery.py",
     "operator_tpu/operator/autoscale.py",
+    # fleet KV fabric (ISSUE 19): host-pool page adoption and fetch
+    # bookkeeping must discharge what they acquire on every exit path
+    "operator_tpu/fabric/",
 )
 
 

@@ -588,6 +588,10 @@ class AwaitAtomicityRule(Rule):
         r"operator_tpu/router/.*\.py$",
         r"operator_tpu/serving/.*\.py$",
         r"operator_tpu/obs/.*\.py$",
+        # fleet KV fabric (ISSUE 19): the fetch client interleaves index
+        # reads with awaited transport calls — stale-read check-then-act
+        # here silently adopts pages a peer already dropped
+        r"operator_tpu/fabric/.*\.py$",
     )
 
     def check(self, ctx: AnalysisContext) -> list[Finding]:

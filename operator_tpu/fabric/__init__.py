@@ -1,0 +1,43 @@
+"""Fleet KV fabric: peer-to-peer page transfer + prefill/decode roles.
+
+PR 14 built the single-replica KV economy and a *passive* fleet layer:
+replicas advertise block-hash inventory on ``/healthz`` and the router
+merely prefers holders (``dispatch(kv_hint=...)``).  This package makes
+the fleet tier *active* — three composing planes:
+
+- :mod:`.wire` — the one-block wire format served by the replica's
+  ``GET /kv/blocks/{hash}`` endpoint (JSON header + raw numpy payload,
+  sha256 checksummed end to end).
+- :mod:`.index` — the fabric block index: replica -> advertised block
+  set, replace-on-report semantics (staleness tombstones for free) plus
+  fetch-outcome feedback (a 404 from a supposed holder evicts that
+  entry immediately).
+- :mod:`.fetch` — the bounded-concurrency fetch client: admission-time
+  prefix misses consult the index and pull pages from a holder's host
+  pool instead of recomputing, under a per-fetch deadline clamped to
+  the request's residual budget.  A failed fetch must never be slower
+  than the recompute it replaced.
+- :mod:`.disagg` — prefill/decode disaggregation: replica roles, the
+  role-aware candidate ordering the router uses, and the two-leg
+  prefill->decode dispatch helper built on token-level resume.
+
+See docs/FABRIC.md for the protocol, deadline policy, and knobs.
+"""
+
+from .disagg import DECODE, MIXED, PREFILL, VALID_ROLES, disaggregated_dispatch
+from .fetch import FabricFetcher
+from .index import FabricIndex
+from .wire import CorruptBlock, decode_block, encode_block
+
+__all__ = [
+    "CorruptBlock",
+    "DECODE",
+    "FabricFetcher",
+    "FabricIndex",
+    "MIXED",
+    "PREFILL",
+    "VALID_ROLES",
+    "decode_block",
+    "disaggregated_dispatch",
+    "encode_block",
+]

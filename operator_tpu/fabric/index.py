@@ -1,0 +1,89 @@
+"""Fabric block index: which replica holds which KV blocks.
+
+This promotes the router's passive ``HealthBoard.holders()`` scan into a
+first-class index with two freshness mechanisms the scan could not
+express:
+
+- **Replace-on-report (staleness tombstones).**  Each health poll
+  replaces a replica's advertised set wholesale, so a holder that
+  stopped advertising a block is dropped the moment its next report
+  lands — not after some TTL.  A replica that leaves the ring (or whose
+  breaker opens) is removed outright, taking its whole inventory with
+  it before the next poll round trips.
+- **Fetch-outcome feedback.**  A 404 from a supposed holder evicts that
+  single (replica, block) entry immediately; the rest of the replica's
+  inventory stays matchable until its next report.
+
+The index is plain in-process state fed by the router's health poll —
+no clock, no background task.  Entries carry the replica's URL so the
+fetch client can hit ``GET /kv/blocks/{hash}`` without a second lookup.
+Block hashes are the 32-hex digest strings from the ``/healthz``
+``kvBlocks`` inventory (see serving/kvstore.py ``block_hashes``).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+
+class FabricIndex:
+    """replica_id -> (advertised block set, base URL)."""
+
+    def __init__(self) -> None:
+        self._blocks: dict[str, frozenset[str]] = {}
+        self._urls: dict[str, str] = {}
+        #: fetch-feedback evictions since construction (stats only)
+        self.evictions = 0
+
+    def update(
+        self, replica_id: str, blocks: Optional[Iterable[str]], *, url: str = ""
+    ) -> None:
+        """Replace ``replica_id``'s advertised set (staleness tombstone:
+        anything it stopped advertising is gone as of this call)."""
+        self._blocks[replica_id] = frozenset(blocks or ())
+        if url:
+            self._urls[replica_id] = url
+
+    def remove(self, replica_id: str) -> None:
+        """Drop the replica and its whole inventory (ring leave, breaker
+        open, scale-down)."""
+        self._blocks.pop(replica_id, None)
+        self._urls.pop(replica_id, None)
+
+    def evict(self, replica_id: str, block_hash: str) -> bool:
+        """Fetch-outcome feedback: the holder 404'd this block.  Returns
+        True when an entry was actually dropped."""
+        held = self._blocks.get(replica_id)
+        if held is None or block_hash not in held:
+            return False
+        self._blocks[replica_id] = held - {block_hash}
+        self.evictions += 1
+        return True
+
+    def holders(self, block_hash: str) -> list[str]:
+        """Replica ids currently advertising ``block_hash``, sorted for
+        deterministic fetch ordering."""
+        return sorted(
+            rid for rid, held in self._blocks.items() if block_hash in held
+        )
+
+    def holder_urls(self, block_hash: str) -> list[tuple[str, str]]:
+        """``(replica_id, url)`` pairs for holders with a known URL."""
+        return [
+            (rid, self._urls[rid])
+            for rid in self.holders(block_hash)
+            if self._urls.get(rid)
+        ]
+
+    def blocks(self, replica_id: str) -> frozenset[str]:
+        return self._blocks.get(replica_id, frozenset())
+
+    def replicas(self) -> list[str]:
+        return sorted(self._blocks)
+
+    def stats(self) -> dict:
+        return {
+            "replicas": len(self._blocks),
+            "entries": sum(len(held) for held in self._blocks.values()),
+            "evictions": self.evictions,
+        }

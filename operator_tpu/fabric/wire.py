@@ -1,0 +1,102 @@
+"""Wire format for one KV block on the fabric.
+
+A block travels as::
+
+    PMKV1\\n
+    {"hash": <32 hex>, "k": {...}, "v": {...}, "sha256": <payload hex>}\\n
+    <raw k bytes><raw v bytes>
+
+The header is a single JSON line so a reader can split on the first
+newline after the magic without framing state; the payload is the two
+arrays' contiguous bytes back to back.  The checksum covers the payload
+only — the header is self-validating (shape/dtype must reconstruct to
+exactly the payload length).  Pages live in the holder's HostKVPool as
+host numpy, so encoding is two ``tobytes()`` calls and decoding is two
+zero-copy ``frombuffer`` views.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+import numpy as np
+
+MAGIC = b"PMKV1\n"
+
+
+class CorruptBlock(ValueError):
+    """The bytes on the wire do not reconstruct the advertised block."""
+
+
+def _spec(arr: np.ndarray) -> dict:
+    return {"dtype": str(arr.dtype), "shape": list(arr.shape)}
+
+
+def encode_block(block_hash: bytes, k: np.ndarray, v: np.ndarray) -> bytes:
+    """Serialize one block's (k, v) page pair for the wire."""
+    k = np.ascontiguousarray(k)
+    v = np.ascontiguousarray(v)
+    payload = k.tobytes() + v.tobytes()
+    header = {
+        "hash": block_hash.hex(),
+        "k": _spec(k),
+        "v": _spec(v),
+        "sha256": hashlib.sha256(payload).hexdigest(),
+    }
+    return MAGIC + json.dumps(header, sort_keys=True).encode("utf-8") + b"\n" + payload
+
+
+def _dtype(name) -> np.dtype:
+    # plain numpy does not know the accelerator dtypes (bfloat16,
+    # float8_*) by name — ml_dtypes registers them, and the serving KV
+    # cache is bfloat16 by default, so the production page dtype MUST
+    # resolve here or every real fetch dies as "corrupt"
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, str(name)))
+
+
+def _reconstruct(spec: dict, payload: bytes, offset: int) -> tuple[np.ndarray, int]:
+    try:
+        dtype = _dtype(spec["dtype"])
+        shape = tuple(int(d) for d in spec["shape"])
+    except (KeyError, TypeError, ValueError, AttributeError) as exc:
+        raise CorruptBlock(f"bad array spec: {exc}") from exc
+    count = int(np.prod(shape)) if shape else 1
+    nbytes = count * dtype.itemsize
+    if offset + nbytes > len(payload):
+        raise CorruptBlock("payload shorter than header claims")
+    arr = np.frombuffer(payload, dtype=dtype, count=count, offset=offset)
+    return arr.reshape(shape), offset + nbytes
+
+
+def decode_block(data: bytes) -> tuple[bytes, np.ndarray, np.ndarray]:
+    """Parse wire bytes back into ``(block_hash, k, v)``.
+
+    Raises :class:`CorruptBlock` on any mismatch — magic, header shape,
+    payload length, or checksum.  Callers treat that exactly like a
+    fetch miss and fall back to recompute.
+    """
+    if not data.startswith(MAGIC):
+        raise CorruptBlock("bad magic")
+    newline = data.find(b"\n", len(MAGIC))
+    if newline < 0:
+        raise CorruptBlock("truncated header")
+    try:
+        header = json.loads(data[len(MAGIC):newline].decode("utf-8"))
+        block_hash = bytes.fromhex(header["hash"])
+        advertised = header["sha256"]
+    except (KeyError, TypeError, ValueError) as exc:
+        raise CorruptBlock(f"bad header: {exc}") from exc
+    payload = data[newline + 1:]
+    if hashlib.sha256(payload).hexdigest() != advertised:
+        raise CorruptBlock("payload checksum mismatch")
+    k, offset = _reconstruct(header.get("k", {}), payload, 0)
+    v, offset = _reconstruct(header.get("v", {}), payload, offset)
+    if offset != len(payload):
+        raise CorruptBlock("trailing bytes after payload")
+    return block_hash, k, v

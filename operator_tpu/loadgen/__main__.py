@@ -138,6 +138,124 @@ async def _main() -> None:
     print("loadgen smoke: OK")
 
 
+async def _disagg_main() -> None:
+    """The prefill/decode disaggregation smoke (LOADGEN_DISAGG=1,
+    docs/FABRIC.md).
+
+    A seeded storm runs against a 1-prefill + 2-decode synthetic fleet
+    with the backend in disaggregated dispatch: every analysis is a
+    prefill leg routed role=prefill plus a decode leg routed
+    role=decode (fabric/disagg.py).  Gates: byte-identical arrival
+    replay (two independent materialisations), every arrival settled
+    with nothing leaked pending and zero torn ledger lines, the
+    disaggregation actually happened (handoff counter fired, the
+    prefill replica served prefill legs, the decode replicas served
+    decode legs), and the fleet rollup carries the per-role tiers the
+    autoscaler keys on."""
+    seed = int(os.environ.get("LOADGEN_SEED", "0") or 0)
+    time_scale = 0.2
+    spec = ArrivalSpec(
+        name="disagg",
+        rate_per_min=float(
+            os.environ.get("LOADGEN_DISAGG_RATE_PER_MIN", "240")
+        ),
+        duration_s=float(os.environ.get("LOADGEN_DISAGG_DURATION_S", "4")),
+        burst_factor=3.0,
+        burst_every_s=2.0,
+        burst_len_s=0.5,
+    )
+    process = ArrivalProcess(spec, seed=seed)
+
+    # replay gate first: two independent materialisations of the same
+    # (spec, seed) must be byte-identical
+    replay = ArrivalProcess(spec, seed=seed)
+    if process.fingerprint() != replay.fingerprint():
+        _fail("disagg arrival schedule is not replay-identical")
+    if [e.to_dict() for e in process.materialize()] != [
+        e.to_dict() for e in replay.materialize()
+    ]:
+        _fail("fingerprints matched but materialised events differ")
+
+    with tempfile.TemporaryDirectory(prefix="loadgen-disagg-") as tmp:
+        ledger_path = os.path.join(tmp, "slo-ledger.jsonl")
+        fleet = [
+            SyntheticReplica("disagg-prefill-0", concurrency=2,
+                             time_scale=time_scale, role="prefill"),
+            SyntheticReplica("disagg-decode-0", concurrency=2,
+                             time_scale=time_scale, role="decode"),
+            SyntheticReplica("disagg-decode-1", concurrency=2,
+                             time_scale=time_scale, role="decode"),
+        ]
+        stack = await build_storm_stack(
+            replicas=fleet, time_scale=time_scale,
+            ledger_path=ledger_path, disaggregate=True,
+        )
+        report = await run_storm(stack, process, drain_s=20.0)
+        stack.close()
+
+        # gate: populated record, every arrival settled, nothing pending
+        if report["arrivals"] <= 0:
+            _fail("disagg storm produced no arrivals")
+        total = report["slo"]["total"]
+        if total["admitted"] != report["arrivals"] - report["cancelled_at_drain"]:
+            _fail(
+                f"ledger admitted {total['admitted']} != "
+                f"{report['arrivals']} arrivals - "
+                f"{report['cancelled_at_drain']} cancelled"
+            )
+        if report["slo"]["pending"] != 0:
+            _fail(f"{report['slo']['pending']} records leaked pending")
+        if total["attainment"] is None:
+            _fail("disagg storm record has null attainment")
+
+        # gate: zero torn ledger lines
+        with open(ledger_path) as fh:
+            for line in fh:
+                if not line.strip():
+                    continue
+                try:
+                    json.loads(line)
+                except ValueError:
+                    _fail(f"torn ledger line: {line[:80]!r}")
+
+        # gate: disaggregation actually happened, on the right tiers
+        handoffs = stack.metrics.counter("fabric_disagg_handoff")
+        if handoffs <= 0:
+            _fail("no fabric_disagg_handoff recorded — the backend never "
+                  "split a request into prefill+decode legs")
+        prefill_replica, *decode_replicas = fleet
+        if prefill_replica.served_by_phase.get("prefill", 0) <= 0:
+            _fail("the prefill replica served no prefill legs")
+        if sum(r.served_by_phase.get("decode", 0)
+               for r in decode_replicas) <= 0:
+            _fail("the decode replicas served no decode legs")
+        # role preference, not filter: prefill legs stay OFF the decode
+        # tier while the prefill replica is healthy (and vice versa)
+        if any(r.served_by_phase.get("prefill", 0) > 0
+               for r in decode_replicas) and \
+                prefill_replica.served_by_phase.get("decode", 0) > 0:
+            _fail("both tiers crossed roles despite healthy exact-role "
+                  "candidates — role preference is not being applied")
+
+        # gate: the fleet rollup carries per-role tiers
+        roles = (report["fleet"].get("fleet") or {}).get("roles") or {}
+        if "prefill" not in roles or "decode" not in roles:
+            _fail(f"fleet rollup missing role tiers: {sorted(roles)}")
+        if roles["prefill"]["replicas"] != 1 or roles["decode"]["replicas"] != 2:
+            _fail(f"role tier shape wrong: {roles}")
+
+    print(json.dumps({
+        "arrivals": report["arrivals"],
+        "attainment": total["attainment"],
+        "goodput_analyses_per_min": total["goodput_analyses_per_min"],
+        "handoffs": handoffs,
+        "prefill_legs": prefill_replica.served_by_phase,
+        "decode_legs": [r.served_by_phase for r in decode_replicas],
+        "fingerprint": report["fingerprint"][:16],
+    }, indent=2))
+    print("loadgen disagg: OK")
+
+
 async def _elastic_main() -> None:
     """The scale-to-zero-and-back elastic smoke (LOADGEN_ELASTIC=1).
 
@@ -419,5 +537,7 @@ if __name__ == "__main__":
         _overload_main()
     elif os.environ.get("LOADGEN_ELASTIC", "0") == "1":
         asyncio.run(_elastic_main())
+    elif os.environ.get("LOADGEN_DISAGG", "0") == "1":
+        asyncio.run(_disagg_main())
     else:
         asyncio.run(_main())

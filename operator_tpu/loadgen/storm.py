@@ -153,6 +153,12 @@ class SyntheticReplica:
     milliseconds, not minutes.  ``time_scale`` compresses service times
     by the same factor the driver compresses arrivals."""
 
+    #: disaggregated service-time split (fabric/disagg.py): the prefill
+    #: leg is the prompt-heavy share of one analysis, the decode leg the
+    #: rest — a prefill replica serving only prefill legs models the
+    #: prompt-bound tier, symmetric for decode
+    PHASE_COST = {"full": 1.0, "prefill": 0.6, "decode": 0.4}
+
     def __init__(
         self,
         replica_id: str,
@@ -161,22 +167,27 @@ class SyntheticReplica:
         base_ms: float = 5.0,
         per_kb_ms: float = 4.0,
         time_scale: float = 1.0,
+        role: str = "mixed",
     ) -> None:
         self.id = replica_id
         self.concurrency = max(1, concurrency)
         self.base_ms = base_ms
         self.per_kb_ms = per_kb_ms
         self.time_scale = time_scale
+        self.role = role
         self._gate = asyncio.Semaphore(self.concurrency)
         self.inflight = 0
         self.waiting = 0
         self.served = 0
+        #: per-phase serve counts — the disagg smoke's role-honesty gate
+        self.served_by_phase: "dict[str, int]" = {}
 
     def load(self) -> ReplicaLoad:
         return ReplicaLoad(
             queue_depth=self.waiting,
             inflight=self.inflight,
             occupancy=min(1.0, self.inflight / self.concurrency),
+            role=self.role,
         )
 
     def service_ms(self, request: AnalysisRequest) -> float:
@@ -190,8 +201,10 @@ class SyntheticReplica:
         request: AnalysisRequest,
         budget_s: Optional[float],
         degrade_frac: float = 1.0,
+        phase: str = "full",
     ) -> AIResponse:
         cost_s = self.service_ms(request) * self.time_scale / 1000.0
+        cost_s *= self.PHASE_COST.get(phase, 1.0)
         if degrade_frac < 1.0:
             # overload ladder truncated the analysis depth: a shallower
             # answer costs proportionally less service time
@@ -211,6 +224,7 @@ class SyntheticReplica:
                 self.waiting -= 1
             raise
         self.served += 1
+        self.served_by_phase[phase] = self.served_by_phase.get(phase, 0) + 1
         fingerprint = request.fingerprint or "cold"
         return AIResponse(
             explanation=(
@@ -242,6 +256,7 @@ class EngineReplica:
         request: AnalysisRequest,
         budget_s: Optional[float],
         degrade_frac: float = 1.0,
+        phase: str = "full",
     ) -> AIResponse:
         from ..serving.types import SamplingParams
 
@@ -259,6 +274,10 @@ class EngineReplica:
             else None
         )
         max_tokens = self.max_tokens
+        if phase == "prefill":
+            # disaggregated prefill leg: run the full prompt for exactly
+            # one token — the decode leg picks up over the fabric
+            max_tokens = 1
         if degrade_frac < 1.0:
             max_tokens = max(1, int(max_tokens * degrade_frac))
         params = SamplingParams(
@@ -309,11 +328,15 @@ class InProcessServingBackend:
         shed_pressure: int = 8,
         max_failover: int = 1,
         allow_empty: bool = False,
+        disaggregate: bool = False,
     ) -> None:
         if not replicas and not allow_empty:
             raise ValueError("storm backend needs at least one replica")
         self.replicas = {r.id: r for r in replicas}
         self.metrics = metrics
+        #: fabric disaggregation (fabric/disagg.py): every analysis runs
+        #: as a prefill leg + a decode leg, role-preferred routing each
+        self.disaggregate = disaggregate
         self.router = EngineRouter(
             [Replica(id=r.id, url=f"inproc://{r.id}") for r in replicas],
             shed_pressure=shed_pressure,
@@ -413,16 +436,39 @@ class InProcessServingBackend:
             target = self.replicas[replica.id]
             return await target.serve(request, budget_s, degrade_frac)
 
+        key = EngineRouter.affinity_key(
+            prefix=prompt_basis, fingerprint=request.fingerprint
+        )
+        rid = request_key(prompt_basis)
         try:
-            outcome = await self.router.dispatch(
-                send,
-                key=EngineRouter.affinity_key(
-                    prefix=prompt_basis, fingerprint=request.fingerprint
-                ),
-                request_id=request_key(prompt_basis),
-                deadline=budget,
-                attempts=1,
-            )
+            if self.disaggregate:
+                from ..fabric.disagg import disaggregated_dispatch
+
+                async def prefill_send(replica, attempt, budget_s):
+                    target = self.replicas[replica.id]
+                    return await target.serve(
+                        request, budget_s, degrade_frac, phase="prefill"
+                    )
+
+                async def decode_send(replica, attempt, budget_s, prefix):
+                    target = self.replicas[replica.id]
+                    return await target.serve(
+                        request, budget_s, degrade_frac, phase="decode"
+                    )
+
+                _prefill, outcome = await disaggregated_dispatch(
+                    self.router, prefill_send, decode_send,
+                    key=key, request_id=rid, deadline=budget,
+                    metrics=self.metrics,
+                )
+            else:
+                outcome = await self.router.dispatch(
+                    send,
+                    key=key,
+                    request_id=rid,
+                    deadline=budget,
+                    attempts=1,
+                )
         except RouterError as exc:
             deadline_spent = budget is not None and budget.remaining() <= 0.0
             if not deadline_spent:
@@ -507,6 +553,7 @@ async def build_storm_stack(
     deadline_factor: float = 4.0,
     namespace: str = "storm",
     fault_plan: Any = None,
+    disaggregate: bool = False,
 ) -> StormStack:
     """Wire the full storm stack.  Defaults give the CI smoke shape: two
     synthetic replicas, in-memory pattern cache, ledger journaled to
@@ -535,7 +582,8 @@ async def build_storm_stack(
             for i in range(2)
         ]
     backend = InProcessServingBackend(
-        replicas, metrics=metrics, allow_empty=allow_empty
+        replicas, metrics=metrics, allow_empty=allow_empty,
+        disaggregate=disaggregate,
     )
     registry = default_registry()
     registry.register("storm", backend)

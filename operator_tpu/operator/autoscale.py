@@ -184,7 +184,25 @@ class AutoscaleController:
                 f"fleet_pressure {float(pressure):.1f} >= "
                 f"target {self.target_pressure:.1f}"
             )
-        elif pending > 0 and self.attainment is not None:
+        if burst_reason is None:
+            # role-aware signal (fabric/disagg.py): a disaggregated
+            # fleet can starve ONE role behind a calm aggregate — all
+            # prefill replicas saturated while decode sits idle keeps
+            # fleet_pressure (the best offer anywhere) low.  Judge each
+            # role tier by its own mean pressure per ready replica.
+            for role, tier in sorted((rollup.get("roles") or {}).items()):
+                if role == "mixed":
+                    continue  # the aggregate signal already covers mixed
+                ready = max(1, int(tier.get("ready") or 0))
+                tier_pressure = float(tier.get("pressure") or 0) / ready
+                if tier_pressure >= self.target_pressure:
+                    burst_reason = (
+                        f"role {role!r} pressure {tier_pressure:.1f}/replica"
+                        f" >= target {self.target_pressure:.1f} "
+                        f"({tier.get('ready')}/{tier.get('replicas')} ready)"
+                    )
+                    break
+        if burst_reason is None and pending > 0 and self.attainment is not None:
             lagging = [
                 (cls, att)
                 for cls, att in sorted((self.attainment() or {}).items())

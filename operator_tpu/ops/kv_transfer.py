@@ -21,6 +21,7 @@ path clean:
 from __future__ import annotations
 
 import functools
+import threading
 from collections import OrderedDict
 from typing import Optional
 
@@ -37,6 +38,11 @@ class HostKVPool:
     the pool; inserting past it drops least-recently-used blocks first.
     ``capacity_mb=0`` disables the pool (has() is always False), which
     turns eviction into plain forgetting.
+
+    Thread-safe: with the KV fabric enabled the pool is read by the
+    HTTP handler thread (``GET /kv/blocks/{hash}``) and the event loop's
+    prefetch adoption while the decode worker drains offloads/mirrors
+    into it, so every entry mutation happens under one lock.
     """
 
     def __init__(self, capacity_mb: int = 0) -> None:
@@ -44,20 +50,24 @@ class HostKVPool:
         self._entries: "OrderedDict[bytes, tuple[np.ndarray, np.ndarray]]" = (
             OrderedDict()
         )
+        self._lock = threading.Lock()
         self.bytes_used = 0
         self.dropped = 0  # blocks LRU-dropped to make room
 
     def __len__(self) -> int:
-        return len(self._entries)
+        with self._lock:
+            return len(self._entries)
 
     def has(self, h: bytes) -> bool:
-        return h in self._entries
+        with self._lock:
+            return h in self._entries
 
     def get(self, h: bytes) -> Optional[tuple[np.ndarray, np.ndarray]]:
-        entry = self._entries.get(h)
-        if entry is not None:
-            self._entries.move_to_end(h)
-        return entry
+        with self._lock:
+            entry = self._entries.get(h)
+            if entry is not None:
+                self._entries.move_to_end(h)
+            return entry
 
     def put(
         self, h: bytes, k: np.ndarray, v: np.ndarray
@@ -69,23 +79,27 @@ class HostKVPool:
         size = k.nbytes + v.nbytes
         if self.capacity_bytes <= 0 or size > self.capacity_bytes:
             return None
-        if h in self._entries:
-            self._entries.move_to_end(h)
-            return []
-        evicted: list[bytes] = []
-        while self.bytes_used + size > self.capacity_bytes and self._entries:
-            old, (ok, ov) = self._entries.popitem(last=False)
-            self.bytes_used -= ok.nbytes + ov.nbytes
-            self.dropped += 1
-            evicted.append(old)
-        self._entries[h] = (k, v)
-        self.bytes_used += size
-        return evicted
+        with self._lock:
+            if h in self._entries:
+                self._entries.move_to_end(h)
+                return []
+            evicted: list[bytes] = []
+            while (
+                self.bytes_used + size > self.capacity_bytes and self._entries
+            ):
+                old, (ok, ov) = self._entries.popitem(last=False)
+                self.bytes_used -= ok.nbytes + ov.nbytes
+                self.dropped += 1
+                evicted.append(old)
+            self._entries[h] = (k, v)
+            self.bytes_used += size
+            return evicted
 
     def drop(self, h: bytes) -> None:
-        entry = self._entries.pop(h, None)
-        if entry is not None:
-            self.bytes_used -= entry[0].nbytes + entry[1].nbytes
+        with self._lock:
+            entry = self._entries.pop(h, None)
+            if entry is not None:
+                self.bytes_used -= entry[0].nbytes + entry[1].nbytes
 
 
 def gather_page(paged, page: int) -> tuple[jax.Array, jax.Array]:

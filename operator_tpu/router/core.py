@@ -164,6 +164,10 @@ class EngineRouter:
         left = self._replicas.pop(replica_id, None) is not None
         self._ring.remove(replica_id)
         if left:
+            # age the health/breaker/fabric-index state with the ring: a
+            # departed replica's KV inventory must never match again (a
+            # rejoin re-reports and starts clean)
+            self.health.remove(replica_id)
             self.metrics.incr("ring_member_removed")
             self.metrics.incr("ring_resize")
 
@@ -176,8 +180,13 @@ class EngineRouter:
     # -- feedback ------------------------------------------------------
     def report_load(self, replica_id: str, load: ReplicaLoad) -> None:
         """Ingest one replica's load report (a ``/healthz`` poll body or
-        an in-process ``ServingEngine.load_report()``)."""
-        self.health.for_replica(replica_id).report_load(load)
+        an in-process ``ServingEngine.load_report()``) — and refresh the
+        fabric block index with the replica's URL so the fetch client
+        can hit its /kv/blocks endpoint without a second lookup."""
+        replica = self._replicas.get(replica_id)
+        self.health.report_load(
+            replica_id, load, url=replica.url if replica is not None else ""
+        )
 
     def mark_probe(self, replica_id: str, ready: bool) -> None:
         self.health.for_replica(replica_id).mark_probe(ready)
@@ -204,6 +213,7 @@ class EngineRouter:
         deadline_s: Optional[float] = None,
         tokens: int = 256,
         kv_hint: Optional["list[str]"] = None,
+        role: Optional[str] = None,
     ) -> Optional[RouteDecision]:
         """Pick one replica for a request.
 
@@ -222,8 +232,12 @@ class EngineRouter:
         blocks each replica's last KV inventory advertises — a failover
         lands on the survivor that can re-prefill from cache instead of
         recomputing; the inventory is advisory, so a zero-holder fleet
-        falls back to plain affinity order.  Returns None only when NO
-        replica is healthy."""
+        falls back to plain affinity order.  ``role`` (fabric/disagg.py)
+        partitions candidates by advertised replica role — exact match
+        first, then mixed/unknown, then the opposite role — a stable
+        PREFERENCE, never a filter: a fleet with no replica of the
+        wanted role degrades to mixed rather than rejecting.  Returns
+        None only when NO replica is healthy."""
         order = self._ring.preference(key) if key else sorted(self._replicas)
         # PURE filter: can_route never mutates breaker state — consuming
         # a recovering replica's half-open probe token here would let
@@ -247,6 +261,21 @@ class EngineRouter:
             candidates = sorted(
                 candidates,
                 key=lambda rid: (-held(rid), candidates.index(rid)),
+            )
+        if role:
+            from ..fabric.disagg import role_preference
+
+            # stable partition AFTER the kv_hint re-rank so the role
+            # tier dominates and inventory breaks ties within it: exact
+            # role, then mixed/unknown, then the opposite role
+            candidates = sorted(
+                candidates,
+                key=lambda rid: (
+                    role_preference(
+                        self.health.for_replica(rid).load.role, role
+                    ),
+                    candidates.index(rid),
+                ),
             )
         owner = candidates[0]
         chosen = owner
@@ -327,6 +356,7 @@ class EngineRouter:
         backoff_s: float = 0.2,
         resume_log: Optional[Any] = None,  # router.resume.ResumeLog
         kv_hint: Optional["list[str]"] = None,
+        role: Optional[str] = None,
     ) -> RouteOutcome:
         """Run ``send(replica, attempt, budget_s)`` against the routed
         replica, failing over across the set.
@@ -352,6 +382,9 @@ class EngineRouter:
         router completes the log entry once the dispatch settles.
         ``kv_hint`` is forwarded to :meth:`route` on every attempt so a
         failover prefers survivors already holding the prompt's blocks.
+        ``role`` (fabric/disagg.py) is forwarded the same way — a
+        disaggregated leg keeps preferring its role across failovers,
+        degrading to mixed replicas rather than failing.
         """
         tried: list[str] = []  # distinct replicas that failed, in order
         requeues = 0
@@ -366,7 +399,7 @@ class EngineRouter:
                 )
             decision = self.route(
                 key, exclude=set(tried), deadline_s=budget, tokens=tokens,
-                kv_hint=kv_hint,
+                kv_hint=kv_hint, role=role,
             )
             if decision is None:
                 self.metrics.incr("router_no_replica")

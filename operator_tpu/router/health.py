@@ -64,9 +64,22 @@ def fleet_rollup(replicas: dict) -> dict:
     # overload-ladder totals (router/value.py): plain sums — shed and
     # degraded counts add across replicas
     shed = degraded = 0
+    # disaggregation (fabric/disagg.py): per-role replica counts and
+    # queue pressure so the autoscaler can see ONE starved role behind a
+    # calm aggregate (all prefill replicas saturated, decode idle)
+    roles: dict = {}
     for row in replicas.values():
         queue_depth += int(row.get("queueDepth") or 0)
         inflight += int(row.get("inflight") or 0)
+        role = str(row.get("role") or "mixed")
+        tier = roles.setdefault(
+            role, {"replicas": 0, "ready": 0, "pressure": 0}
+        )
+        tier["replicas"] += 1
+        tier["ready"] += 1 if row.get("ready") else 0
+        tier["pressure"] += int(row.get("queueDepth") or 0) + int(
+            row.get("inflight") or 0
+        )
         shed += int(row.get("shedTotal") or 0)
         degraded += int(row.get("degradedTotal") or 0)
         weight = max(1, int(row.get("steps") or 0))
@@ -109,6 +122,7 @@ def fleet_rollup(replicas: dict) -> dict:
         ),
         "shedTotal": shed,
         "degradedTotal": degraded,
+        "roles": {role: roles[role] for role in sorted(roles)},
     }
 
 
@@ -218,6 +232,11 @@ class BreakerBoard:
         self._clock = clock
         self._breakers: dict[str, CircuitBreaker] = {}
 
+    def remove(self, key: str) -> None:
+        """Drop a key's breaker outright (replica left the ring); a
+        rejoin under the same id starts closed, like any new replica."""
+        self._breakers.pop(key, None)
+
     def for_key(self, key: str) -> CircuitBreaker:
         breaker = self._breakers.get(key)
         if breaker is None:
@@ -283,6 +302,10 @@ class ReplicaLoad:
     prefix_hit_rate: Optional[float] = None
     prefix_lookups: int = 0
     kv_blocks: Optional[list] = None
+    #: prefill/decode disaggregation role (fabric/disagg.py): "prefill",
+    #: "decode", or "mixed".  A routing PREFERENCE, never a filter —
+    #: unknown/legacy replicas read as mixed and serve everything.
+    role: str = "mixed"
     #: value-aware overload ladder totals (router/value.py): requests
     #: this replica shed (dropped by value) and served degraded
     #: (depth-truncated) — rolled up fleet-wide by ``fleet_rollup``
@@ -339,6 +362,7 @@ class ReplicaLoad:
             ),
             "kvLookups": self.prefix_lookups,
             "kvBlocks": self.kv_blocks,
+            "role": self.role,
             "shedTotal": self.shed,
             "degradedTotal": self.degraded,
         }
@@ -378,6 +402,7 @@ class ReplicaLoad:
                 [str(h) for h in data["kvBlocks"]]
                 if isinstance(data.get("kvBlocks"), list) else None
             ),
+            role=str(data.get("role") or "mixed"),
             shed=int(data.get("shedTotal") or 0),
             degraded=int(data.get("degradedTotal") or 0),
         )
@@ -465,6 +490,13 @@ class HealthBoard:
         self._clock = clock or time.monotonic
         self.breakers = BreakerBoard(failure_threshold, reset_s, clock=clock)
         self._health: dict[str, ReplicaHealth] = {}
+        # fabric block index (operator_tpu/fabric/index.py): the active
+        # form of the kvBlocks inventory — replace-on-report staleness
+        # tombstones, fed by report_load() below, aged by remove() and
+        # breaker opens, and evicted entry-by-entry on fetch 404s
+        from ..fabric.index import FabricIndex
+
+        self.kv_index = FabricIndex()
 
     def for_replica(self, replica_id: str) -> ReplicaHealth:
         health = self._health.get(replica_id)
@@ -497,8 +529,33 @@ class HealthBoard:
     def observe_failure(self, replica_id: str) -> bool:
         """Returns True when this failure OPENED the replica's breaker
         (the caller's cue to count the exclusion once)."""
-        self.for_replica(replica_id).observe(ok=False)
-        return self.breakers.for_key(replica_id).record_failure()
+        health = self.for_replica(replica_id)
+        health.observe(ok=False)
+        opened = self.breakers.for_key(replica_id).record_failure()
+        if opened:
+            # age the KV inventory with the breaker: an unreachable
+            # replica's blocks must stop matching immediately, not
+            # linger until its (never-arriving) next load report
+            health.load.kv_blocks = None
+            self.kv_index.remove(replica_id)
+        return opened
+
+    def report_load(
+        self, replica_id: str, load: ReplicaLoad, *, url: str = ""
+    ) -> None:
+        """Land a load report AND refresh the fabric index in one step —
+        the replace semantics ARE the staleness tombstone (anything the
+        replica stopped advertising is unmatchable as of this report)."""
+        self.for_replica(replica_id).report_load(load)
+        self.kv_index.update(replica_id, load.kv_blocks, url=url)
+
+    def remove(self, replica_id: str) -> None:
+        """Forget a replica that left the ring (discovery leave, scale
+        down): health entry, breaker, and its whole fabric inventory —
+        a removed replica's blocks must never match again."""
+        self._health.pop(replica_id, None)
+        self.breakers.remove(replica_id)
+        self.kv_index.remove(replica_id)
 
     def states(self) -> dict[str, dict]:
         return {
@@ -534,6 +591,7 @@ class HealthBoard:
                 "kvPagesTotal": load.kv_pages_total,
                 "prefixHitRate": load.prefix_hit_rate,
                 "kvLookups": load.prefix_lookups,
+                "role": load.role,
                 "shedTotal": load.shed,
                 "degradedTotal": load.degraded,
             }
@@ -545,10 +603,13 @@ class HealthBoard:
         to resume onto a survivor that can re-prefill from cache instead
         of recomputing.  Reports are advisory (bounded MRU snapshot, may
         be stale): an empty answer means "no known holder", never "no
-        holder"."""
-        found = []
-        for replica_id, health in sorted(self._health.items()):
+        holder".  The union of the fabric index (fed via
+        :meth:`report_load`, aged by :meth:`remove`/breaker opens) and
+        the legacy per-health scan, so direct ``ReplicaHealth``
+        report_load callers stay visible."""
+        found = set(self.kv_index.holders(block_hash))
+        for replica_id, health in self._health.items():
             blocks = health.load.kv_blocks
             if blocks and block_hash in blocks:
-                found.append(replica_id)
-        return found
+                found.add(replica_id)
+        return sorted(found)

@@ -1543,6 +1543,15 @@ class ServingEngine:
         from ..obs.sloledger import SLOBoard
 
         self._slo_board = SLOBoard()
+        #: fleet KV fabric (operator_tpu/fabric/): a FabricFetcher wired
+        #: post-construction when KV_FABRIC=1; admission-time prefix
+        #: misses then consult the fleet index and pull pages from a
+        #: holder's host pool instead of recomputing.  None = local-only
+        #: (the pre-fabric behaviour, and the default).
+        self.fabric: Optional[Any] = None
+        #: prefill/decode disaggregation role advertised on /healthz
+        #: (fabric/disagg.py): "prefill" | "decode" | "mixed"
+        self.replica_role: str = "mixed"
 
     def _unwrap(self, item: tuple) -> "_Request":
         """Pop bookkeeping for a queue entry: low-lane slots free on pop.
@@ -1974,6 +1983,7 @@ class ServingEngine:
             prefix_hit_rate=prefix_hit_rate,
             prefix_lookups=prefix_lookups,
             kv_blocks=kv_blocks,
+            role=self.replica_role,
             shed=(
                 self.generator.metrics.labeled_total("shed")
                 if hasattr(self.generator.metrics, "labeled_total") else 0
@@ -1983,6 +1993,69 @@ class ServingEngine:
                 if hasattr(self.generator.metrics, "labeled_total") else 0
             ),
         )
+
+    async def _fabric_prefetch(
+        self,
+        prompt: str,
+        params: Optional[SamplingParams],
+        resume_tokens: Optional[list],
+    ) -> None:
+        """Admission-time fabric prefetch (operator_tpu/fabric/fetch.py).
+
+        Tokenizes exactly the way the scheduler's enqueue will (same
+        truncation budget, same resume suffix) so the probed block
+        hashes line up with the prefix match that follows.  Never
+        raises — every failure mode is a silent fall-through to the
+        recompute the request was going to do anyway."""
+        from .types import prompt_budget
+
+        store = getattr(self._sched, "_kvstore", None)
+        if store is None:
+            return
+        try:
+            g = self.generator
+            p = params or SamplingParams()
+            ids = g.tokenizer.encode(prompt)
+            budget = prompt_budget(g.max_seq, p.max_tokens)
+            if resume_tokens:
+                if len(resume_tokens) >= budget:
+                    return  # enqueue will reject it; nothing to prefetch
+                tokens = g._truncate_prompt(
+                    ids, budget - len(resume_tokens)
+                ) + list(resume_tokens)
+            else:
+                tokens = g._truncate_prompt(ids, budget)
+            residual = None
+            if p.deadline is not None:
+                residual = p.deadline - g._clock()
+            await self.fabric.prefetch(tokens, store=store, budget_s=residual)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.debug("fabric prefetch failed; recompute covers it",
+                      exc_info=True)
+
+    def kv_block_bytes(self, hash_hex: str) -> Optional[bytes]:
+        """Serve one KV block out of the host pool for a fabric peer
+        (``GET /kv/blocks/{hash}`` — serving/httpserver.py).  Host numpy
+        in, wire bytes out: no device touch, no scheduler involvement.
+        Returns None when the block is not pooled here (the peer treats
+        that 404 as index-eviction feedback)."""
+        from ..fabric.wire import encode_block
+
+        metrics = self.generator.metrics
+        store = getattr(self._sched, "_kvstore", None)
+        pool = getattr(store, "host_pool", None)
+        try:
+            block_hash = bytes.fromhex(hash_hex)
+        except ValueError:
+            return None
+        entry = pool.get(block_hash) if pool is not None else None
+        if entry is None:
+            metrics.incr("fabric_serve_miss", exemplar=hash_hex)
+            return None
+        metrics.incr("fabric_serve_hit", exemplar=hash_hex)
+        return encode_block(block_hash, entry[0], entry[1])
 
     async def start(self) -> None:
         if self._task is None:
@@ -2224,6 +2297,14 @@ class ServingEngine:
             # builds+caches the automaton; raises ValueError here (to THIS
             # caller) on bad specs or unsupported engine configs
             await self.ensure_guided(guided_spec)
+        if self.fabric is not None and self._sched is not None:
+            # fleet KV fabric: pull the prompt's missing prefix blocks
+            # from a peer's host pool BEFORE admission so the scheduler's
+            # prefix match restores them instead of recomputing.  Best
+            # effort, residual-budget clamped — a failed fetch degrades
+            # to the ordinary recompute with at most the fetch budget
+            # spent, never an error to this caller.
+            await self._fabric_prefetch(prompt, params, resume_tokens)
         if self._task is None:
             await self.start()
         future: asyncio.Future = asyncio.get_running_loop().create_future()

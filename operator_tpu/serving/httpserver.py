@@ -74,6 +74,12 @@ _STREAMED = object()
 _PEEK_TIMED_OUT = object()
 
 
+class _Binary(bytes):
+    """Route payload that must go out as application/octet-stream (the
+    fabric's /kv/blocks wire bytes), distinct from the plain ``bytes``
+    the /metrics exposition path emits as text."""
+
+
 def _content_text(content: Any) -> str:
     """Flatten OpenAI message content: plain string or content-parts list
     (``[{"type": "text", "text": ...}, ...]``; non-text parts rejected)."""
@@ -342,7 +348,10 @@ class CompletionServer:
                 pass
             return
         try:
-            if isinstance(payload, bytes):  # /metrics Prometheus exposition
+            if isinstance(payload, _Binary):  # /kv/blocks wire payload
+                data = bytes(payload)
+                ctype = "application/octet-stream"
+            elif isinstance(payload, bytes):  # /metrics Prometheus exposition
                 data = payload
                 ctype = (
                     "application/openmetrics-text; version=1.0.0; charset=utf-8"
@@ -475,7 +484,25 @@ class CompletionServer:
             return await self._completions(self._parse_json(body), chat=False, writer=writer)
         if method == "POST" and path == "/v1/chat/completions":
             return await self._completions(self._parse_json(body), chat=True, writer=writer)
+        if method == "GET" and path.startswith("/kv/blocks/"):
+            return self._kv_block(path)
         raise ApiError(404, f"no route for {method} {path}")
+
+    def _kv_block(self, path: str):
+        """Fleet KV fabric peer endpoint (docs/FABRIC.md): serve one KV
+        block straight out of the host pool.  Token-gated like every
+        non-probe route (the generic auth check already ran); pure host
+        numpy + checksum, so serving a page never touches the device or
+        the scheduler."""
+        hash_hex = path.rsplit("/", 1)[-1].lower()
+        if len(hash_hex) != 32 or any(
+            c not in "0123456789abcdef" for c in hash_hex
+        ):
+            raise ApiError(400, f"malformed block hash {hash_hex!r}")
+        data = self.engine.kv_block_bytes(hash_hex)
+        if data is None:
+            raise ApiError(404, f"block {hash_hex} is not pooled here")
+        return 200, _Binary(data)
 
     @staticmethod
     def _parse_json(body: bytes) -> dict:

@@ -183,6 +183,26 @@ class PrefixKVStore:
                 self.metrics.incr("kv_miss", matchable - len(chain))
         return chain
 
+    def probe(self, tokens: Sequence[int]) -> list[tuple[bytes, bool]]:
+        """Pure residency probe over the matchable prefix — ``(hash,
+        resident)`` per full block, no accounting, no LRU bump.
+
+        The fabric prefetch path uses this to find which prefix blocks
+        are worth fetching from a peer before admission runs the real
+        ``match``.  ``resident`` means the block would count as cached:
+        device-resident or restorable from the host side.
+        """
+        ps = self.page_size
+        matchable = max(0, (len(tokens) - 1) // ps)
+        out: list[tuple[bytes, bool]] = []
+        for h in block_hashes(tokens[: matchable * ps], ps):
+            entry = self._blocks.get(h)
+            resident = entry is not None and (
+                entry.page >= 0 or self.restorable(h)
+            )
+            out.append((h, resident))
+        return out
+
     # -- refcounts --------------------------------------------------------
 
     def acquire(self, blocks: Sequence[CachedBlock]) -> None:
@@ -228,6 +248,31 @@ class PrefixKVStore:
             tokens=tuple(tokens),
             page=page,
             refs=refs,
+            last_used=self._clock,
+        )
+        self._blocks[h] = entry
+        return entry
+
+    def adopt_host(
+        self, h: bytes, parent: Optional[bytes], tokens: Sequence[int]
+    ) -> CachedBlock:
+        """Register a host-pool-resident block fetched over the fabric.
+
+        Unlike :meth:`insert` there is no device page to transfer — the
+        entry lands restorable (``page = -1``) and the ordinary one-DMA
+        restore path revives it when a match acquires it.  Idempotent:
+        an existing entry (any residency) is returned untouched.
+        """
+        entry = self._blocks.get(h)
+        if entry is not None:
+            return entry
+        self._clock += 1
+        entry = CachedBlock(
+            hash=h,
+            parent=parent,
+            tokens=tuple(tokens),
+            page=-1,
+            refs=0,
             last_used=self._clock,
         )
         self._blocks[h] = entry

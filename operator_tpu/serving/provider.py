@@ -472,6 +472,15 @@ def build_serving_engine(
                 spec_decode=config.spec_decode,
                 spec_lookup_k=config.spec_lookup_k,
                 kvstore=kvstore,
+                # fleet KV fabric (operator_tpu/fabric/): mirror newly
+                # registered prompt blocks into the host pool so peers
+                # can fetch them over GET /kv/blocks/{hash}
+                fabric_mirror=(
+                    config.kv_fabric
+                    and config.kv_fabric_mirror
+                    and kvstore is not None
+                    and kvstore.host_pool is not None
+                ),
             )
     elif config.sched_mode != "wave":
         raise ValueError(
@@ -519,10 +528,40 @@ def build_serving_engine(
             stall_timeout_s=config.supervisor_stall_s,
             join_grace_s=config.supervisor_join_grace_s,
         )
-    return (
-        ServingEngine(generator, supervisor=supervisor, scheduler=scheduler),
-        model_id,
+    engine = ServingEngine(
+        generator, supervisor=supervisor, scheduler=scheduler
     )
+    # fleet KV fabric + disaggregation role (operator_tpu/fabric/,
+    # docs/FABRIC.md).  The fetcher starts with a private empty index —
+    # a no-op until something feeds it holders: in-process fleets
+    # (loadgen storm, bench, tests) point it at the router's
+    # health.kv_index, which the existing /healthz poll keeps fresh.
+    from ..fabric.disagg import normalize_role
+
+    engine.replica_role = normalize_role(config.replica_role)
+    if config.kv_fabric and scheduler is not None:
+        from ..fabric.fetch import FabricFetcher
+        from ..fabric.index import FabricIndex
+
+        engine.fabric = FabricFetcher(
+            FabricIndex(),
+            api_token=os.environ.get("OPERATOR_TPU_API_TOKEN") or None,
+            timeout_s=config.kv_fabric_fetch_timeout_s,
+            concurrency=config.kv_fabric_concurrency,
+            self_id=(
+                os.environ.get("SERVING_REPLICA_ID")
+                or os.environ.get("POD_NAME")
+                or ""
+            ),
+            metrics=generator.metrics,
+        )
+        log.info(
+            "fleet KV fabric: fetch timeout %.2fs concurrency %d role %s "
+            "mirror %s",
+            config.kv_fabric_fetch_timeout_s, config.kv_fabric_concurrency,
+            engine.replica_role, config.kv_fabric_mirror,
+        )
+    return engine, model_id
 
 
 def build_tpu_native_provider(

@@ -139,6 +139,7 @@ class Scheduler:
         kvstore: Optional[Any] = None,
         queue_limit: int = 0,
         overload_policy: Optional[Any] = None,
+        fabric_mirror: bool = False,
     ) -> None:
         if not getattr(generator, "paged", False):
             raise ValueError("the continuous scheduler requires paged KV")
@@ -204,6 +205,14 @@ class Scheduler:
         #: host pool: (hash, k_dev, v_dev) — drained inside the commit
         #: step's existing host-sync window (_drain_offload)
         self._pending_offload: list[tuple[bytes, Any, Any]] = []
+        #: KV fabric mirror (operator_tpu/fabric/): copy newly-donated
+        #: prompt blocks into the host pool at prefill completion so
+        #: peers can fetch them over GET /kv/blocks/{hash} before
+        #: eviction would have spilled them.  Gathers are eager device
+        #: slices at registration; the fetch drains inside the commit
+        #: step's host-sync window next to _drain_offload.
+        self._fabric_mirror = bool(fabric_mirror)
+        self._pending_mirror: list[tuple[bytes, Any, Any]] = []
         self._fn = None
         # host-side stats the bench reads (stats())
         self.steps = 0
@@ -428,6 +437,8 @@ class Scheduler:
                         "kv_prefill_tokens_saved"
                     ),
                     "offload_pending": len(self._pending_offload),
+                    "mirrored": self.metrics.counter("fabric_mirror"),
+                    "mirror_pending": len(self._pending_mirror),
                 }
                 if self._kvstore is not None else None
             ),
@@ -446,6 +457,7 @@ class Scheduler:
         self._inflight.clear()
         self._latest = None
         self._pending_offload.clear()  # gathered buffers died with the device state
+        self._pending_mirror.clear()
         if self._kvstore is not None:
             # every device page is gone (the generator rebuilds its
             # allocator); host-pool copies survive and stay restorable
@@ -672,17 +684,46 @@ class Scheduler:
                     store.forget(old)
         self._pending_offload.clear()
 
+    def _drain_mirror(self) -> None:
+        """Fetch mirror-gathered prompt blocks to the host pool — same
+        discipline as _drain_offload: called ONLY inside the commit
+        step's host-sync window.  Unlike offload the device page stays
+        resident; a refused put just means peers cannot fetch it."""
+        from ...ops import kv_transfer
+
+        store = self._kvstore
+        pool = store.host_pool
+        for h, k_dev, v_dev in self._pending_mirror:
+            if pool.has(h):
+                continue  # offload drain or a peer fetch beat us to it
+            dropped = pool.put(h, *kv_transfer.fetch_page(k_dev, v_dev))
+            if dropped is None:
+                continue  # pool refused; the block stays device-only
+            self.metrics.incr("fabric_mirror")
+            for old in dropped:
+                entry = store.get(old)
+                if entry is not None and entry.page < 0:
+                    store.forget(old)
+        self._pending_mirror.clear()
+
     def _register_row_blocks(self, row: _Row) -> None:
         """Prefill completed: donate the row's FULL prompt blocks to the
         store (ownership transfer of the device pages — no copy).  Only
         full blocks are immutable by construction (generation writes at
         positions >= prompt_len, past the last full prompt block), and
         the row keeps a reference on each donated block until release."""
+        from ...ops import kv_transfer
         from ..kvstore import block_hashes
 
         g = self.generator
         store = self._kvstore
         ps = g.page_size
+        pool = store.host_pool
+        mirror = (
+            self._fabric_mirror
+            and pool is not None
+            and pool.capacity_bytes > 0
+        )
         k_full = row.prompt_len // ps
         c0 = row.cached_len // ps
         if k_full <= c0:
@@ -707,6 +748,11 @@ class Scheduler:
             store.pending_offload.discard(h)
             transferred.add(j - c0)
             row.cached_hashes.append(h)
+            if mirror and not pool.has(h):
+                # eager device slice now (no sync); the host fetch waits
+                # for the commit window's _drain_mirror
+                k_dev, v_dev = kv_transfer.gather_page(g.paged_cache, page)
+                self._pending_mirror.append((h, k_dev, v_dev))
         if transferred:
             row.pages = [
                 p for i, p in enumerate(row.pages) if i not in transferred
@@ -1192,6 +1238,8 @@ class Scheduler:
             # fetches on it (device→host page copies overlap the token
             # readback window instead of opening a new sync point)
             self._drain_offload()
+        if self._pending_mirror:
+            self._drain_mirror()
         fetch_t = time.perf_counter()
         self._host_syncs += 1
         device_ms = max(0.0, (t_ready - entry.dispatch_t) * 1e3)

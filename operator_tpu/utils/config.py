@@ -365,6 +365,30 @@ class OperatorConfig:
     # autoscale_min_replicas == 0); pending arrivals wake it back up
     scale_to_zero_idle_s: float = 600.0
 
+    # --- fleet KV fabric (operator_tpu/fabric/, docs/FABRIC.md) -----------
+    # peer-to-peer KV page transfer: an admission-time prefix miss
+    # consults the fleet block index and fetches pages from a holder's
+    # host pool over GET /kv/blocks/{hash} instead of recomputing.
+    # Requires kv_prefix_cache and kv_host_pool_mb > 0 (fetched pages
+    # land in the host pool; the existing one-DMA restore path revives
+    # them on match)
+    kv_fabric: bool = False
+    # per-fetch deadline (seconds), clamped to the request's residual
+    # budget at the call — a failed fetch must never cost more than the
+    # recompute it replaced
+    kv_fabric_fetch_timeout_s: float = 2.0
+    # concurrent page fetches in flight per replica (bounded client)
+    kv_fabric_concurrency: int = 4
+    # mirror newly-registered prompt blocks into the host pool at
+    # prefill completion (inside the commit step's host-sync window) so
+    # peers can fetch them without waiting for eviction to spill them
+    kv_fabric_mirror: bool = True
+    # prefill/decode disaggregation role advertised on /healthz
+    # (fabric/disagg.py): "prefill" | "decode" | "mixed".  A routing
+    # preference, never a filter — mixed (the default) serves both
+    # phases and a role-less fleet behaves exactly as before
+    replica_role: str = "mixed"
+
     @classmethod
     def from_env(cls, env: Optional[dict[str, str]] = None) -> "OperatorConfig":
         env = dict(os.environ if env is None else env)

@@ -886,3 +886,104 @@ def test_healthz_partition_trips_probe_and_recovers_when_plan_drains():
         return plan.trace()
 
     assert scenario(11) == scenario(11)
+
+
+def test_fabric_holder_killed_mid_fetch_falls_back_to_recompute():
+    """Chaos at the ``fabric.fetch`` seam (operator_tpu/fabric/fetch.py):
+    the only holder of every wanted block dies mid-page-fetch.  The
+    fetcher degrades to the recompute fallback — greedy output stays
+    byte-identical to the no-fabric run, the page accounting invariant
+    holds on the fetching replica (zero leaked pages), the dead holder's
+    faults all fire, and the scenario replays deterministically."""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from operator_tpu.fabric import FabricFetcher, FabricIndex, encode_block
+    from operator_tpu.models import TINY_TEST, init_params
+    from operator_tpu.models.tokenizer import ByteTokenizer
+    from operator_tpu.ops.kv_transfer import HostKVPool
+    from operator_tpu.serving.engine import BatchedGenerator, SamplingParams
+    from operator_tpu.serving.kvstore import PrefixKVStore, block_hashes
+    from operator_tpu.serving.sched import Scheduler
+
+    params = init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+    prompt = "the quick brown fox jumps over the lazy dog " * 2
+    greedy = SamplingParams(max_tokens=6, temperature=0.0, stop_on_eos=False)
+
+    def make_replica(*, mirror):
+        generator = BatchedGenerator(
+            params, TINY_TEST, ByteTokenizer(), paged=True, max_slots=4,
+            max_seq=128, page_size=16, cache_dtype=jnp.float32,
+            metrics=MetricsRegistry(),
+        )
+        store = PrefixKVStore(
+            generator.page_size, host_pool=HostKVPool(8),
+            metrics=generator.metrics,
+        )
+        return Scheduler(generator, kvstore=store, fabric_mirror=mirror), \
+            generator, store
+
+    def drain(sched, req):
+        for _ in range(500):
+            for outcome in sched.step():
+                if outcome.req_id == req:
+                    return outcome
+        raise AssertionError("request never finished")
+
+    def scenario(seed: int) -> dict:
+        # replica a: the holder — computes the prompt and mirrors its
+        # blocks into the host pool the fabric would serve from
+        sched_a, gen_a, store_a = make_replica(mirror=True)
+        ref = drain(sched_a, sched_a.enqueue(prompt, greedy))
+        tokens = gen_a.tokenizer.encode(prompt)
+        hashes = block_hashes(tokens, gen_a.page_size)
+        index = FabricIndex()
+        index.update("a", [h.hex() for h in hashes], url="http://a")
+
+        async def transport(url, budget_s):
+            hash_hex = url.rsplit("/", 1)[-1]
+            page = store_a.host_pool.get(bytes.fromhex(hash_hex))
+            if page is None:
+                return 404, b""
+            return 200, encode_block(bytes.fromhex(hash_hex), *page)
+
+        plan = FaultPlan(seed=seed)
+        plan.rule(
+            "fabric.fetch",
+            times(len(hashes),
+                  raise_(lambda: ConnectionError("holder killed"), "kill")),
+            match=lambda replica, block: replica == "a",
+        )
+        sched_b, gen_b, store_b = make_replica(mirror=False)
+        fetcher = FabricFetcher(
+            index, transport=transport, fault_plan=plan, self_id="b",
+            metrics=gen_b.metrics,
+        )
+        adopted = asyncio.run(fetcher.prefetch(tokens, store=store_b))
+        out = drain(sched_b, sched_b.enqueue(prompt, greedy))
+
+        # zero page leaks on the fetching replica
+        assert (
+            gen_b.allocator.available + store_b.device_pages_held
+            == gen_b.allocator.num_pages - 1
+        )
+        assert plan.pending() == {}  # every declared kill actually fired
+        return {
+            "adopted": adopted,
+            "tokens": list(out.result.token_ids),
+            "reference": list(ref.result.token_ids),
+            "errors": gen_b.metrics.counter("fabric_fetch_error"),
+            "fallbacks": gen_b.metrics.counter("fabric_fetch_fallback"),
+            "trace": plan.trace(),
+        }
+
+    first = scenario(29)
+    # the holder died on EVERY fetch: nothing adopted, everything fell
+    # back, and the recompute produced byte-identical greedy output
+    assert first["adopted"] == 0
+    assert first["errors"] >= 1 and first["fallbacks"] >= 1
+    assert first["tokens"] == first["reference"]
+    # determinism: equal seeds -> identical fault sequence and output
+    second = scenario(29)
+    assert second["trace"] == first["trace"]
+    assert second["tokens"] == first["tokens"]

@@ -1,0 +1,563 @@
+"""Fleet KV fabric (ISSUE 19): peer-to-peer page transfer, the
+distributed block index, and prefill/decode disaggregation
+(operator_tpu/fabric/, docs/FABRIC.md).
+
+Acceptance surface:
+
+- wire format: encode/decode round trip; corruption (flipped byte,
+  truncation, bad magic, trailing garbage) always raises, never adopts;
+- FabricIndex freshness: replace-on-report staleness tombstones,
+  remove-on-leave, 404 fetch-feedback eviction;
+- the kvBlocks aging fix: HealthBoard clears a replica's advertised
+  inventory on remove() AND on breaker open — a dead replica is never
+  offered as a holder;
+- FabricFetcher outcome ladder with an injected transport: ok / 404
+  (evicts the index entry) / corrupt / timeout / error / no-holder and
+  exhausted-budget fallbacks — every failure mode is a None, and the
+  per-fetch budget is clamped by the residual deadline;
+- the `fabric.fetch` chaos seam (graftlint GL012);
+- prefetch adoption: only the longest contiguous prefix of fetched
+  blocks is adopted, pages land host-resident and restore through the
+  ordinary one-DMA path with byte-identical greedy output, and the page
+  accounting invariant holds (zero leaks);
+- scheduler mirroring: fresh prompt blocks are host-resident after the
+  commit window when fabric_mirror is on;
+- disaggregation: role is a routing preference (exact > mixed > other),
+  applied after the kv-hint re-rank; disaggregated_dispatch hands the
+  prefill tokens to the decode leg byte-identically.
+"""
+
+import asyncio
+
+import pytest
+
+from operator_tpu.fabric import (
+    CorruptBlock,
+    FabricFetcher,
+    FabricIndex,
+    decode_block,
+    encode_block,
+)
+from operator_tpu.fabric.disagg import (
+    DECODE,
+    MIXED,
+    PREFILL,
+    disaggregated_dispatch,
+    normalize_role,
+    role_preference,
+)
+from operator_tpu.router import EngineRouter, ReplicaLoad
+from operator_tpu.router.health import HealthBoard, fleet_rollup
+from operator_tpu.utils.faultinject import FaultPlan, raise_
+from operator_tpu.utils.timing import MetricsRegistry
+
+np = pytest.importorskip("numpy")
+
+HASH = "ab" * 16  # 32-hex block hash
+
+
+def _page(seed: int):
+    rng = np.random.default_rng(seed)
+    k = rng.standard_normal((2, 4, 2, 8), dtype=np.float32)
+    v = rng.standard_normal((2, 4, 2, 8), dtype=np.float32)
+    return k, v
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+
+
+class TestWire:
+    def test_round_trip(self):
+        k, v = _page(0)
+        blob = encode_block(bytes.fromhex(HASH), k, v)
+        h, k2, v2 = decode_block(blob)
+        assert h.hex() == HASH
+        assert np.array_equal(k, k2) and np.array_equal(v, v2)
+        assert k2.dtype == k.dtype and v2.shape == v.shape
+
+    @pytest.mark.parametrize("mutate", [
+        lambda b: b[:-1],                          # truncated payload
+        lambda b: b"XXKV1\n" + b[6:],               # bad magic
+        lambda b: b + b"\x00",                      # trailing garbage
+        lambda b: b[:40] + bytes([b[40] ^ 0xFF]) + b[41:],  # flipped byte
+        lambda b: b"PMKV1\nnot json\n",             # unparseable header
+    ])
+    def test_corruption_always_raises(self, mutate):
+        k, v = _page(1)
+        blob = encode_block(bytes.fromhex(HASH), k, v)
+        with pytest.raises(CorruptBlock):
+            decode_block(bytes(mutate(blob)))
+
+    def test_corruption_simple(self):
+        with pytest.raises(CorruptBlock):
+            decode_block(b"")
+
+    def test_bfloat16_round_trips(self):
+        # the serving KV cache dtype is bfloat16 by default, which plain
+        # np.dtype() cannot resolve by name — the decoder must go
+        # through ml_dtypes or every REAL fetch dies as "corrupt"
+        import ml_dtypes
+
+        k, v = _page(2)
+        k = k.astype(ml_dtypes.bfloat16)
+        v = v.astype(ml_dtypes.bfloat16)
+        h, k2, v2 = decode_block(encode_block(bytes.fromhex(HASH), k, v))
+        assert h.hex() == HASH
+        assert k2.dtype == k.dtype and np.array_equal(k.view(np.uint16), k2.view(np.uint16))
+        assert v2.dtype == v.dtype and np.array_equal(v.view(np.uint16), v2.view(np.uint16))
+
+    def test_unknown_dtype_is_corrupt_not_crash(self):
+        k, v = _page(3)
+        blob = encode_block(bytes.fromhex(HASH), k, v)
+        bad = blob.replace(b'"dtype": "float32"', b'"dtype": "notadtype"', 1)
+        with pytest.raises(CorruptBlock):
+            decode_block(bad)
+
+
+# ---------------------------------------------------------------------------
+# the index
+# ---------------------------------------------------------------------------
+
+
+class TestFabricIndex:
+    def test_replace_on_report_is_a_staleness_tombstone(self):
+        index = FabricIndex()
+        index.update("a", ["h1", "h2"], url="http://a")
+        assert index.holders("h1") == ["a"]
+        # the next report stopped advertising h1: it ages out NOW
+        index.update("a", ["h2", "h3"], url="http://a")
+        assert index.holders("h1") == []
+        assert index.holders("h3") == ["a"]
+
+    def test_remove_drops_whole_inventory(self):
+        index = FabricIndex()
+        index.update("a", ["h1"], url="http://a")
+        index.update("b", ["h1"], url="http://b")
+        index.remove("a")
+        assert index.holders("h1") == ["b"]
+        assert index.replicas() == ["b"]
+
+    def test_404_feedback_evicts_one_entry(self):
+        index = FabricIndex()
+        index.update("a", ["h1", "h2"], url="http://a")
+        assert index.evict("a", "h1") is True
+        assert index.evict("a", "h1") is False  # already gone
+        assert index.holders("h1") == [] and index.holders("h2") == ["a"]
+        assert index.stats()["evictions"] == 1
+
+    def test_holder_urls_requires_a_url(self):
+        index = FabricIndex()
+        index.update("a", ["h1"])          # no URL: unfetchable
+        index.update("b", ["h1"], url="http://b")
+        assert index.holders("h1") == ["a", "b"]
+        assert index.holder_urls("h1") == [("b", "http://b")]
+
+
+# ---------------------------------------------------------------------------
+# the kvBlocks aging fix (HealthBoard)
+# ---------------------------------------------------------------------------
+
+
+class TestHealthBoardAging:
+    def test_remove_clears_advertised_inventory(self):
+        board = HealthBoard()
+        board.report_load("a", ReplicaLoad(kv_blocks=["h1", "h2"]),
+                          url="http://a")
+        assert board.holders("h1") == ["a"]
+        board.remove("a")
+        # the fix: a removed replica's kvBlocks never linger as holders
+        assert board.holders("h1") == []
+        assert board.kv_index.replicas() == []
+
+    def test_breaker_open_clears_advertised_inventory(self):
+        board = HealthBoard(failure_threshold=1)
+        board.report_load("a", ReplicaLoad(kv_blocks=["h1"]), url="http://a")
+        assert board.holders("h1") == ["a"]
+        assert board.observe_failure("a") is True  # breaker opened
+        assert board.holders("h1") == []
+
+    def test_router_remove_rides_the_same_path(self):
+        router = EngineRouter(["a", "b"])
+        router.report_load("a", ReplicaLoad(kv_blocks=["h1"]))
+        assert router.health.holders("h1") == ["a"]
+        router.remove("a")
+        assert router.health.holders("h1") == []
+
+
+# ---------------------------------------------------------------------------
+# the fetch client
+# ---------------------------------------------------------------------------
+
+
+def make_fetcher(index, transport, **kw):
+    kw.setdefault("metrics", MetricsRegistry())
+    kw.setdefault("timeout_s", 2.0)
+    return FabricFetcher(index, transport=transport, **kw)
+
+
+def served(pages):
+    """Transport serving encoded pages from a dict keyed by hash hex."""
+    async def transport(url, budget_s):
+        assert budget_s > 0
+        hash_hex = url.rsplit("/", 1)[-1]
+        page = pages.get(hash_hex)
+        if page is None:
+            return 404, b""
+        return 200, encode_block(bytes.fromhex(hash_hex), *page)
+    return transport
+
+
+class TestFabricFetcher:
+    def test_fetch_ok(self):
+        index = FabricIndex()
+        index.update("a", [HASH], url="http://a")
+        k, v = _page(2)
+        fetcher = make_fetcher(index, served({HASH: (k, v)}))
+        got = asyncio.run(fetcher.fetch_block(HASH))
+        assert got is not None and np.array_equal(got[0], k)
+        assert fetcher.metrics.counter("fabric_fetch_ok") == 1
+
+    def test_404_evicts_the_index_entry_then_falls_back(self):
+        index = FabricIndex()
+        index.update("a", [HASH], url="http://a")
+        fetcher = make_fetcher(index, served({}))
+        assert asyncio.run(fetcher.fetch_block(HASH)) is None
+        assert index.holders(HASH) == []  # fetch feedback evicted it
+        m = fetcher.metrics
+        assert m.counter("fabric_fetch_miss") == 1
+        assert m.counter("fabric_index_evicted") == 1
+        assert m.counter("fabric_fetch_fallback") == 1
+
+    def test_corrupt_payload_is_never_adopted(self):
+        index = FabricIndex()
+        index.update("a", [HASH], url="http://a")
+
+        async def transport(url, budget_s):
+            return 200, b"PMKV1\ngarbage\n"
+
+        fetcher = make_fetcher(index, transport)
+        assert asyncio.run(fetcher.fetch_block(HASH)) is None
+        assert fetcher.metrics.counter("fabric_fetch_corrupt") == 1
+
+    def test_wrong_hash_counts_as_corrupt(self):
+        other = "cd" * 16
+        index = FabricIndex()
+        index.update("a", [HASH], url="http://a")
+        k, v = _page(3)
+
+        async def transport(url, budget_s):
+            return 200, encode_block(bytes.fromhex(other), k, v)
+
+        fetcher = make_fetcher(index, transport)
+        assert asyncio.run(fetcher.fetch_block(HASH)) is None
+        assert fetcher.metrics.counter("fabric_fetch_corrupt") == 1
+
+    def test_timeout_tries_next_holder(self):
+        index = FabricIndex()
+        index.update("a", [HASH], url="http://a")
+        index.update("b", [HASH], url="http://b")
+        k, v = _page(4)
+        calls = []
+
+        async def transport(url, budget_s):
+            calls.append(url)
+            if "//a/" in url:
+                raise asyncio.TimeoutError()
+            return 200, encode_block(bytes.fromhex(HASH), k, v)
+
+        fetcher = make_fetcher(index, transport)
+        got = asyncio.run(fetcher.fetch_block(HASH))
+        assert got is not None and len(calls) == 2
+        m = fetcher.metrics
+        assert m.counter("fabric_fetch_timeout") == 1
+        assert m.counter("fabric_fetch_ok") == 1
+
+    def test_budget_clamp(self):
+        """budget_s <= 0 is an instant fallback — a failed fetch must
+        never be slower than the recompute it replaced."""
+        index = FabricIndex()
+        index.update("a", [HASH], url="http://a")
+
+        async def transport(url, budget_s):  # pragma: no cover
+            raise AssertionError("transport must not be called")
+
+        fetcher = make_fetcher(index, transport)
+        assert asyncio.run(fetcher.fetch_block(HASH, budget_s=0)) is None
+        assert asyncio.run(fetcher.fetch_block(HASH, budget_s=-1)) is None
+        assert fetcher.metrics.counter("fabric_fetch_fallback") == 2
+
+    def test_no_holder_is_a_fallback(self):
+        fetcher = make_fetcher(FabricIndex(), served({}))
+        assert asyncio.run(fetcher.fetch_block(HASH)) is None
+        assert fetcher.metrics.counter("fabric_fetch_fallback") == 1
+
+    def test_self_is_never_a_holder(self):
+        index = FabricIndex()
+        index.update("me", [HASH], url="http://me")
+        fetcher = make_fetcher(index, served({HASH: _page(5)}), self_id="me")
+        assert asyncio.run(fetcher.fetch_block(HASH)) is None
+        assert fetcher.metrics.counter("fabric_fetch_fallback") == 1
+
+    def test_fault_seam_injects_holder_failure(self):
+        """The `fabric.fetch` chaos seam: an injected holder death mid-
+        fetch degrades to the next holder / recompute fallback."""
+        index = FabricIndex()
+        index.update("a", [HASH], url="http://a")
+        index.update("b", [HASH], url="http://b")
+        k, v = _page(6)
+        plan = FaultPlan(seed=7)
+        plan.rule(
+            "fabric.fetch",
+            [raise_(lambda: ConnectionError("holder died"), "kill")],
+            match=lambda replica, block: replica == "a",
+        )
+        fetcher = make_fetcher(
+            index, served({HASH: (k, v)}), fault_plan=plan,
+        )
+        got = asyncio.run(fetcher.fetch_block(HASH))
+        assert got is not None  # holder b saved it
+        m = fetcher.metrics
+        assert m.counter("fabric_fetch_error") == 1
+        assert m.counter("fabric_fetch_ok") == 1
+        assert plan.pending() == {}
+
+
+# ---------------------------------------------------------------------------
+# disaggregation primitives
+# ---------------------------------------------------------------------------
+
+
+class TestRoles:
+    def test_normalize(self):
+        assert normalize_role("") == MIXED and normalize_role(None) == MIXED
+        assert normalize_role("Prefill") == PREFILL
+        with pytest.raises(ValueError):
+            normalize_role("gpu")
+
+    def test_preference_order(self):
+        assert role_preference(PREFILL, PREFILL) == 0
+        assert role_preference(MIXED, PREFILL) == 1
+        assert role_preference(None, PREFILL) == 1
+        assert role_preference(DECODE, PREFILL) == 2
+
+    def test_load_report_round_trip(self):
+        data = ReplicaLoad(role=PREFILL).to_dict()
+        assert data["role"] == PREFILL
+        assert ReplicaLoad.parse(data).role == PREFILL
+        # legacy replicas (no role field) read as mixed
+        assert ReplicaLoad.parse({"queueDepth": 0}).role == MIXED
+
+    def test_rollup_has_role_tiers(self):
+        rows = {
+            "p": {"role": PREFILL, "queueDepth": 6, "inflight": 0},
+            "d1": {"role": DECODE, "queueDepth": 0},
+            "d2": {"role": DECODE, "queueDepth": 1},
+        }
+        fleet = fleet_rollup(rows)
+        tiers = fleet["roles"]
+        assert tiers[PREFILL]["replicas"] == 1
+        assert tiers[DECODE]["replicas"] == 2
+
+
+class TestRoleRouting:
+    def test_role_is_a_preference_not_a_filter(self):
+        router = EngineRouter(["p", "d", "m"])
+        router.report_load("p", ReplicaLoad(role=PREFILL))
+        router.report_load("d", ReplicaLoad(role=DECODE))
+        router.report_load("m", ReplicaLoad(role=MIXED))
+        assert router.route("k", role=PREFILL).replica.id == "p"
+        assert router.route("k", role=DECODE).replica.id == "d"
+        # no decode replica left: mixed serves, the fleet still works
+        router.remove("d")
+        assert router.route("k", role=DECODE).replica.id == "m"
+
+    def test_role_tier_dominates_kv_hint(self):
+        """kv-hint re-ranks WITHIN a role tier: a prefill replica holding
+        every block must not steal the decode leg."""
+        router = EngineRouter(["p", "d"])
+        router.report_load("p", ReplicaLoad(role=PREFILL,
+                                            kv_blocks=["h1", "h2"]))
+        router.report_load("d", ReplicaLoad(role=DECODE))
+        assert (
+            router.route("k", kv_hint=["h1", "h2"], role=DECODE).replica.id
+            == "d"
+        )
+        # and with no role asked, the holder wins as before
+        assert router.route("k", kv_hint=["h1", "h2"]).replica.id == "p"
+
+    def test_disaggregated_dispatch_hands_off_tokens(self):
+        async def run():
+            router = EngineRouter(["p", "d"])
+            router.report_load("p", ReplicaLoad(role=PREFILL))
+            router.report_load("d", ReplicaLoad(role=DECODE))
+            seen = {}
+
+            class Out:
+                def __init__(self, token_ids):
+                    self.token_ids = token_ids
+
+            async def prefill_send(replica, attempt, budget_s):
+                seen["prefill"] = replica.id
+                return Out([1, 2, 3])
+
+            async def decode_send(replica, attempt, budget_s, prefix):
+                seen["decode"] = replica.id
+                seen["prefix"] = list(prefix)
+                return Out([1, 2, 3, 4, 5])
+
+            metrics = MetricsRegistry()
+            pre, dec = await disaggregated_dispatch(
+                router, prefill_send, decode_send,
+                key="k", request_id="r1", metrics=metrics,
+            )
+            assert seen["prefill"] == "p" and seen["decode"] == "d"
+            # the decode leg resumed from the prefill tokens verbatim
+            assert seen["prefix"] == [1, 2, 3]
+            assert list(dec.response.token_ids) == [1, 2, 3, 4, 5]
+            assert metrics.counter("fabric_disagg_handoff") == 1
+
+        asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: mirror on A, fetch+adopt on B, byte-identical decode
+# ---------------------------------------------------------------------------
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from operator_tpu.models import TINY_TEST, init_params  # noqa: E402
+from operator_tpu.models.tokenizer import ByteTokenizer  # noqa: E402
+from operator_tpu.ops.kv_transfer import HostKVPool  # noqa: E402
+from operator_tpu.serving.engine import (  # noqa: E402
+    BatchedGenerator,
+    SamplingParams,
+)
+from operator_tpu.serving.kvstore import PrefixKVStore, block_hashes  # noqa: E402
+from operator_tpu.serving.sched import Scheduler  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def make_replica(params, *, mirror=False, pool_mb=8):
+    generator = BatchedGenerator(
+        params, TINY_TEST, ByteTokenizer(), paged=True, max_slots=4,
+        max_seq=128, page_size=16, cache_dtype=jnp.float32,
+        metrics=MetricsRegistry(),
+    )
+    store = PrefixKVStore(
+        generator.page_size,
+        host_pool=HostKVPool(pool_mb) if pool_mb else None,
+        metrics=generator.metrics,
+    )
+    sched = Scheduler(generator, kvstore=store, fabric_mirror=mirror)
+    return sched, generator, store
+
+
+def drain_one(sched, req_id, limit=500):
+    for _ in range(limit):
+        for outcome in sched.step():
+            if outcome.req_id == req_id:
+                return outcome
+    raise AssertionError(f"request {req_id} never finished")
+
+
+def greedy(max_tokens):
+    return SamplingParams(max_tokens=max_tokens, temperature=0.0,
+                          stop_on_eos=False)
+
+
+def assert_page_accounting(generator, store):
+    assert (
+        generator.allocator.available + store.device_pages_held
+        == generator.allocator.num_pages - 1
+    )
+
+
+# 89 tokens with the byte tokenizer's BOS: 5 full 16-token blocks, and
+# comfortably inside prompt_budget(max_seq=128, max_tokens<=8) so the
+# enqueue never truncates — the hashes we compute below are the hashes
+# the scheduler registers
+PROMPT = "the quick brown fox jumps over the lazy dog " * 2
+
+
+class TestMirrorAndAdopt:
+    def test_mirror_lands_fresh_blocks_in_the_host_pool(self, params):
+        sched, generator, store = make_replica(params, mirror=True)
+        out = drain_one(sched, sched.enqueue(PROMPT, greedy(4)))
+        assert out.result.token_ids
+        tokens = generator.tokenizer.encode(PROMPT)
+        hashes = block_hashes(tokens, generator.page_size)
+        assert hashes, "prompt must span full pages"
+        assert all(store.host_pool.has(h) for h in hashes)
+        assert generator.metrics.counter("fabric_mirror") == len(hashes)
+        assert_page_accounting(generator, store)
+
+    def test_mirror_off_keeps_pool_empty(self, params):
+        sched, generator, store = make_replica(params, mirror=False)
+        drain_one(sched, sched.enqueue(PROMPT, greedy(4)))
+        assert len(store.host_pool) == 0
+
+    def test_fetch_adopt_restore_byte_identical(self, params):
+        """Replica A computes + mirrors; replica B prefetches A's pages
+        over the fabric and decodes byte-identically with zero leaks —
+        and the adopted pages show up as prefix-cache hits, not
+        recomputes."""
+        sched_a, gen_a, store_a = make_replica(params, mirror=True)
+        ref = drain_one(sched_a, sched_a.enqueue(PROMPT, greedy(8)))
+
+        tokens = gen_a.tokenizer.encode(PROMPT)
+        hashes = block_hashes(tokens, gen_a.page_size)
+        index = FabricIndex()
+        index.update("a", [h.hex() for h in hashes], url="http://a")
+
+        # transport = replica a's serving path, minus the HTTP frame
+        pages = {
+            h.hex(): store_a.host_pool.get(h) for h in hashes
+        }
+        sched_b, gen_b, store_b = make_replica(params, mirror=False)
+        fetcher = make_fetcher(index, served(pages), self_id="b")
+        adopted = asyncio.run(
+            fetcher.prefetch(tokens, store=store_b)
+        )
+        assert adopted == len(hashes)
+        assert fetcher.metrics.counter("fabric_prefetch_adopted") == adopted
+        # adopted blocks are host-resident (restorable), not device pages
+        assert all(store_b.restorable(h) for h in hashes)
+
+        out = drain_one(sched_b, sched_b.enqueue(PROMPT, greedy(8)))
+        assert list(out.result.token_ids) == list(ref.result.token_ids)
+        # the adopted pages were RESTORED (one DMA), not recomputed
+        assert gen_b.metrics.counter("kv_restore") == len(hashes)
+        assert gen_b.metrics.counter("kv_hit") == len(hashes)
+        assert_page_accounting(gen_b, store_b)
+
+    def test_prefetch_adopts_only_the_contiguous_prefix(self, params):
+        """A gap in the fetched set stops adoption — a block behind a
+        gap can never be prefix-matched."""
+        sched_a, gen_a, store_a = make_replica(params, mirror=True)
+        drain_one(sched_a, sched_a.enqueue(PROMPT, greedy(4)))
+        tokens = gen_a.tokenizer.encode(PROMPT)
+        hashes = block_hashes(tokens, gen_a.page_size)
+        assert len(hashes) >= 2
+        index = FabricIndex()
+        index.update("a", [h.hex() for h in hashes], url="http://a")
+        # serve every block EXCEPT the first: nothing is adoptable
+        pages = {
+            h.hex(): store_a.host_pool.get(h) for h in hashes[1:]
+        }
+        _, _, store_b = make_replica(params, mirror=False)
+        fetcher = make_fetcher(index, served(pages), self_id="b")
+        assert asyncio.run(fetcher.prefetch(tokens, store=store_b)) == 0
+        assert all(not store_b.restorable(h) for h in hashes)
+
+    def test_prefetch_without_a_pool_is_a_noop(self, params):
+        _, _, store_b = make_replica(params, pool_mb=0)
+        fetcher = make_fetcher(FabricIndex(), served({}))
+        tokens = list(range(48))
+        assert asyncio.run(fetcher.prefetch(tokens, store=store_b)) == 0
