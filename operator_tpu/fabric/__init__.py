@@ -20,6 +20,10 @@ the fleet tier *active* — three composing planes:
 - :mod:`.disagg` — prefill/decode disaggregation: replica roles, the
   role-aware candidate ordering the router uses, and the two-leg
   prefill->decode dispatch helper built on token-level resume.
+- :mod:`.peers` — the index feeder for router-less replicas: polls peer
+  ``/healthz`` inventories (``KV_FABRIC_PEERS``, DNS-expanded each
+  round so one headless-Service name covers the fleet) into the local
+  index with the router's replace-on-report freshness.
 
 See docs/FABRIC.md for the protocol, deadline policy, and knobs.
 """
@@ -27,6 +31,7 @@ See docs/FABRIC.md for the protocol, deadline policy, and knobs.
 from .disagg import DECODE, MIXED, PREFILL, VALID_ROLES, disaggregated_dispatch
 from .fetch import FabricFetcher
 from .index import FabricIndex
+from .peers import PeerPoller
 from .wire import CorruptBlock, decode_block, encode_block
 
 __all__ = [
@@ -36,6 +41,7 @@ __all__ = [
     "FabricIndex",
     "MIXED",
     "PREFILL",
+    "PeerPoller",
     "VALID_ROLES",
     "decode_block",
     "disaggregated_dispatch",

@@ -168,7 +168,12 @@ class FabricFetcher:
 
     # -- the admission-time entry point ---------------------------------
     async def prefetch(
-        self, tokens: Sequence[int], *, store, budget_s: Optional[float] = None
+        self,
+        tokens: Sequence[int],
+        *,
+        store,
+        budget_s: Optional[float] = None,
+        executor=None,
     ) -> int:
         """Pull the prompt's missing prefix blocks into the local host
         pool so the ordinary one-DMA restore path turns the fabric hit
@@ -178,11 +183,24 @@ class FabricFetcher:
         carry a non-empty host pool (``kv_host_pool_mb > 0``) — without
         one there is nowhere to land a page without touching device
         memory off the commit window.
+
+        ``executor`` is the engine's single-thread decode executor (the
+        thread the scheduler mutates the store from): when given, the
+        store probe and the adoption loop run THERE, so every store
+        mutation serializes with enqueue/step and the check-then-forget
+        in adoption is atomic w.r.t. a concurrent restore flipping the
+        block back to device residency.  None (tests, in-process
+        harnesses with no scheduler thread) runs them inline.
         """
         pool = getattr(store, "host_pool", None)
         if pool is None or getattr(pool, "capacity_bytes", 0) <= 0:
             return 0
-        probe = store.probe(tokens)
+        if executor is not None:
+            probe = await asyncio.get_running_loop().run_in_executor(
+                executor, store.probe, tokens
+            )
+        else:
+            probe = store.probe(tokens)
         wanted = [
             (i, block_hash)
             for i, (block_hash, resident) in enumerate(probe)
@@ -196,29 +214,40 @@ class FabricFetcher:
         fetched = {
             i: page for (i, _h), page in zip(wanted, results) if page is not None
         }
-        page_size = store.page_size
-        adopted = 0
-        parent: Optional[bytes] = None
-        for i, (block_hash, resident) in enumerate(probe):
-            if resident:
+
+        def adopt() -> int:
+            page_size = store.page_size
+            adopted = 0
+            parent: Optional[bytes] = None
+            for i, (block_hash, resident) in enumerate(probe):
+                if resident:
+                    parent = block_hash
+                    continue
+                page = fetched.get(i)
+                if page is None:
+                    break  # gap: later blocks are unmatchable, stop here
+                k, v = page
+                dropped = pool.put(block_hash, k, v)
+                if dropped is None:
+                    break  # pool refused (disabled or page > pool)
+                for old in dropped:
+                    entry = store.get(old)
+                    if entry is not None and entry.page < 0:
+                        store.forget(old)
+                store.adopt_host(
+                    block_hash, parent,
+                    tokens[i * page_size:(i + 1) * page_size],
+                )
+                adopted += 1
                 parent = block_hash
-                continue
-            page = fetched.get(i)
-            if page is None:
-                break  # gap: later blocks are unmatchable, stop adopting
-            k, v = page
-            dropped = pool.put(block_hash, k, v)
-            if dropped is None:
-                break  # pool refused (disabled or page larger than pool)
-            for old in dropped:
-                entry = store.get(old)
-                if entry is not None and entry.page < 0:
-                    store.forget(old)
-            store.adopt_host(
-                block_hash, parent, tokens[i * page_size:(i + 1) * page_size]
+            return adopted
+
+        if executor is not None:
+            adopted = await asyncio.get_running_loop().run_in_executor(
+                executor, adopt
             )
-            adopted += 1
-            parent = block_hash
+        else:
+            adopted = adopt()
         if adopted:
             self.metrics.incr("fabric_prefetch_adopted", adopted)
         return adopted

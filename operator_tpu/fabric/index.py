@@ -60,6 +60,12 @@ class FabricIndex:
         self.evictions += 1
         return True
 
+    def empty(self) -> bool:
+        """True when no replica currently advertises any block — the
+        cheap pre-tokenize gate for the admission-time prefetch (an
+        unfed index must cost a request nothing, not a re-tokenize)."""
+        return not any(self._blocks.values())
+
     def holders(self, block_hash: str) -> list[str]:
         """Replica ids currently advertising ``block_hash``, sorted for
         deterministic fetch ordering."""

@@ -11,8 +11,11 @@ newline after the magic without framing state; the payload is the two
 arrays' contiguous bytes back to back.  The checksum covers the payload
 only — the header is self-validating (shape/dtype must reconstruct to
 exactly the payload length).  Pages live in the holder's HostKVPool as
-host numpy, so encoding is two ``tobytes()`` calls and decoding is two
-zero-copy ``frombuffer`` views.
+host numpy, so encoding is two ``tobytes()`` calls.  Decoding COPIES
+each array out of the response buffer: a ``frombuffer`` view would be
+read-only and would pin the entire wire blob (header + both arrays)
+alive for as long as the adopted page sits in the pool, silently
+breaking the pool's ``k.nbytes + v.nbytes`` accounting.
 """
 
 from __future__ import annotations
@@ -71,7 +74,9 @@ def _reconstruct(spec: dict, payload: bytes, offset: int) -> tuple[np.ndarray, i
     if offset + nbytes > len(payload):
         raise CorruptBlock("payload shorter than header claims")
     arr = np.frombuffer(payload, dtype=dtype, count=count, offset=offset)
-    return arr.reshape(shape), offset + nbytes
+    # copy: a frombuffer view is read-only and keeps the whole wire blob
+    # alive behind a page-sized pool entry (see module docstring)
+    return arr.reshape(shape).copy(), offset + nbytes
 
 
 def decode_block(data: bytes) -> tuple[bytes, np.ndarray, np.ndarray]:

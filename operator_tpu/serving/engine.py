@@ -1549,6 +1549,12 @@ class ServingEngine:
         #: holder's host pool instead of recomputing.  None = local-only
         #: (the pre-fabric behaviour, and the default).
         self.fabric: Optional[Any] = None
+        #: fabric/peers.py PeerPoller feeding the fetcher's index from
+        #: peer /healthz inventories (KV_FABRIC_PEERS) — the standalone
+        #: replica's substitute for an in-process router's kv_index.
+        #: Wired post-construction; start() runs it, close() cancels it.
+        self.fabric_poller: Optional[Any] = None
+        self._fabric_poll_task: Optional[asyncio.Task] = None
         #: prefill/decode disaggregation role advertised on /healthz
         #: (fabric/disagg.py): "prefill" | "decode" | "mixed"
         self.replica_role: str = "mixed"
@@ -2004,31 +2010,53 @@ class ServingEngine:
 
         Tokenizes exactly the way the scheduler's enqueue will (same
         truncation budget, same resume suffix) so the probed block
-        hashes line up with the prefix match that follows.  Never
-        raises — every failure mode is a silent fall-through to the
-        recompute the request was going to do anyway."""
+        hashes line up with the prefix match that follows.  The cheap
+        gates run FIRST — no host pool to land pages in, or an index
+        with no holders at all, must cost the request nothing (the
+        tokenize is duplicate CPU work the enqueue repeats).  The
+        tokenize itself and all store access run on the decode executor:
+        the event loop never touches the store (the scheduler mutates it
+        from that same thread), and long prompts never stall other
+        connections here.  Never raises — every failure mode is a silent
+        fall-through to the recompute the request was going to do
+        anyway."""
         from .types import prompt_budget
 
         store = getattr(self._sched, "_kvstore", None)
         if store is None:
             return
+        pool = getattr(store, "host_pool", None)
+        if pool is None or getattr(pool, "capacity_bytes", 0) <= 0:
+            return  # nowhere to land a fetched page
         try:
+            if self.fabric.index.empty():
+                return  # no holders anywhere: nothing to fetch
             g = self.generator
             p = params or SamplingParams()
-            ids = g.tokenizer.encode(prompt)
-            budget = prompt_budget(g.max_seq, p.max_tokens)
-            if resume_tokens:
-                if len(resume_tokens) >= budget:
-                    return  # enqueue will reject it; nothing to prefetch
-                tokens = g._truncate_prompt(
-                    ids, budget - len(resume_tokens)
-                ) + list(resume_tokens)
-            else:
-                tokens = g._truncate_prompt(ids, budget)
+
+            def tokenize() -> Optional[list]:
+                ids = g.tokenizer.encode(prompt)
+                budget = prompt_budget(g.max_seq, p.max_tokens)
+                if resume_tokens:
+                    if len(resume_tokens) >= budget:
+                        return None  # enqueue will reject it
+                    return g._truncate_prompt(
+                        ids, budget - len(resume_tokens)
+                    ) + list(resume_tokens)
+                return g._truncate_prompt(ids, budget)
+
+            tokens = await asyncio.get_running_loop().run_in_executor(
+                self._executor, tokenize
+            )
+            if tokens is None:
+                return
             residual = None
             if p.deadline is not None:
                 residual = p.deadline - g._clock()
-            await self.fabric.prefetch(tokens, store=store, budget_s=residual)
+            await self.fabric.prefetch(
+                tokens, store=store, budget_s=residual,
+                executor=self._executor,
+            )
         except asyncio.CancelledError:
             raise
         except Exception:
@@ -2065,9 +2093,22 @@ class ServingEngine:
             self._supervise_task = asyncio.create_task(
                 self._supervise(), name="serving-supervisor"
             )
+        if self.fabric_poller is not None and self._fabric_poll_task is None:
+            self._fabric_poll_task = asyncio.create_task(
+                self.fabric_poller.run(), name="fabric-peer-poll"
+            )
 
     async def close(self) -> None:
         self._closed = True
+        # the peer poller is pure index plumbing — first down, nothing
+        # depends on it
+        poll_task, self._fabric_poll_task = self._fabric_poll_task, None
+        if poll_task is not None:
+            poll_task.cancel()
+            try:
+                await poll_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001 - already torn down
+                pass
         # wake an idle watchdog so it observes _closed and exits.  A
         # watchdog MID-RESTART is awaited (bounded) rather than cancelled:
         # cancelling between survivor collection and the device-state

@@ -34,6 +34,7 @@ cache-on vs cache-off.
 from __future__ import annotations
 
 import hashlib
+import threading
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -83,12 +84,23 @@ class PrefixKVStore:
     any row).  Rows acquire/release references; the scheduler drives
     insert (ownership transfer at prefill completion), eviction
     (``evict_lru`` when admission needs pages), and host offload.
+
+    Threading: the scheduler mutates the store on the engine's
+    single-thread decode executor, while the event loop reads it
+    (``inventory``/``stats`` behind /healthz) and the fabric prefetch
+    path probes/adopts into it.  Every method therefore takes one
+    re-entrant lock so no reader ever iterates ``_blocks`` mid-mutation.
+    The lock guards PER-METHOD invariants only — compound sequences
+    (check residency, then forget; evict_lru, then mark_offloaded) stay
+    correct because every MUTATING caller runs on the decode executor
+    (the fabric fetcher ships its probe/adopt work there too).
     """
 
     def __init__(self, page_size: int, *, host_pool=None, metrics=None) -> None:
         self.page_size = page_size
         self.host_pool = host_pool  # ops/kv_transfer.HostKVPool or None
         self.metrics = metrics
+        self._lock = threading.RLock()
         self._blocks: dict[bytes, CachedBlock] = {}
         #: hashes gathered off-device at eviction but not yet fetched
         #: into the host pool (the scheduler's _pending_offload holds the
@@ -103,21 +115,25 @@ class PrefixKVStore:
     # -- introspection ----------------------------------------------------
 
     def __len__(self) -> int:
-        return len(self._blocks)
+        with self._lock:
+            return len(self._blocks)
 
     def get(self, h: bytes) -> Optional[CachedBlock]:
-        return self._blocks.get(h)
+        with self._lock:
+            return self._blocks.get(h)
 
     @property
     def device_pages_held(self) -> int:
         """Device pages the store currently owns (resident blocks)."""
-        return sum(1 for b in self._blocks.values() if b.page >= 0)
+        with self._lock:
+            return sum(1 for b in self._blocks.values() if b.page >= 0)
 
     def restorable(self, h: bytes) -> bool:
         """An off-device block that can come back without recompute:
         pooled on host, or gathered and awaiting the offload drain."""
-        if h in self.pending_offload:
-            return True
+        with self._lock:
+            if h in self.pending_offload:
+                return True
         return bool(self.host_pool and self.host_pool.has(h))
 
     def hit_rate(self) -> Optional[float]:
@@ -127,21 +143,23 @@ class PrefixKVStore:
     def inventory(self, limit: int = 128) -> list[str]:
         """Most-recently-used block hashes (hex), for the /healthz peer
         index — bounded so the load report stays small."""
-        blocks = sorted(
-            self._blocks.values(), key=lambda b: b.last_used, reverse=True
-        )
-        return [b.hash.hex() for b in blocks[:limit]]
+        with self._lock:
+            blocks = sorted(
+                self._blocks.values(), key=lambda b: b.last_used, reverse=True
+            )
+            return [b.hash.hex() for b in blocks[:limit]]
 
     def stats(self) -> dict:
-        return {
-            "blocks": len(self._blocks),
-            "device_pages": self.device_pages_held,
-            "host_blocks": (len(self.host_pool) if self.host_pool else 0),
-            "lookups": self.lookups,
-            "hits": self.hits,
-            "misses": self.misses,
-            "hit_rate": self.hit_rate(),
-        }
+        with self._lock:
+            return {
+                "blocks": len(self._blocks),
+                "device_pages": self.device_pages_held,
+                "host_blocks": (len(self.host_pool) if self.host_pool else 0),
+                "lookups": self.lookups,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": self.hit_rate(),
+            }
 
     # -- matching ---------------------------------------------------------
 
@@ -154,28 +172,29 @@ class PrefixKVStore:
         when it is device-resident OR restorable from the host pool.
         Updates hit/miss accounting at block granularity.
         """
-        self._clock += 1
-        self.lookups += 1
-        ps = self.page_size
-        matchable = max(0, (len(tokens) - 1) // ps)
-        chain: list[CachedBlock] = []
-        h = b""
-        for i in range(matchable):
-            block = tokens[i * ps : (i + 1) * ps]
-            m = hashlib.sha256()
-            m.update(h)
-            m.update(b",".join(str(t).encode() for t in block))
-            h = m.digest()[:16]
-            entry = self._blocks.get(h)
-            if entry is None:
-                break
-            if entry.page < 0 and not self.restorable(h):
-                # stale index entry: neither on device nor restorable
-                break
-            entry.last_used = self._clock
-            chain.append(entry)
-        self.hits += len(chain)
-        self.misses += matchable - len(chain)
+        with self._lock:
+            self._clock += 1
+            self.lookups += 1
+            ps = self.page_size
+            matchable = max(0, (len(tokens) - 1) // ps)
+            chain: list[CachedBlock] = []
+            h = b""
+            for i in range(matchable):
+                block = tokens[i * ps : (i + 1) * ps]
+                m = hashlib.sha256()
+                m.update(h)
+                m.update(b",".join(str(t).encode() for t in block))
+                h = m.digest()[:16]
+                entry = self._blocks.get(h)
+                if entry is None:
+                    break
+                if entry.page < 0 and not self.restorable(h):
+                    # stale index entry: neither on device nor restorable
+                    break
+                entry.last_used = self._clock
+                chain.append(entry)
+            self.hits += len(chain)
+            self.misses += matchable - len(chain)
         if self.metrics is not None:
             if chain:
                 self.metrics.incr("kv_hit", len(chain))
@@ -195,27 +214,30 @@ class PrefixKVStore:
         ps = self.page_size
         matchable = max(0, (len(tokens) - 1) // ps)
         out: list[tuple[bytes, bool]] = []
-        for h in block_hashes(tokens[: matchable * ps], ps):
-            entry = self._blocks.get(h)
-            resident = entry is not None and (
-                entry.page >= 0 or self.restorable(h)
-            )
-            out.append((h, resident))
+        with self._lock:
+            for h in block_hashes(tokens[: matchable * ps], ps):
+                entry = self._blocks.get(h)
+                resident = entry is not None and (
+                    entry.page >= 0 or self.restorable(h)
+                )
+                out.append((h, resident))
         return out
 
     # -- refcounts --------------------------------------------------------
 
     def acquire(self, blocks: Sequence[CachedBlock]) -> None:
-        self._clock += 1
-        for b in blocks:
-            b.refs += 1
-            b.last_used = self._clock
+        with self._lock:
+            self._clock += 1
+            for b in blocks:
+                b.refs += 1
+                b.last_used = self._clock
 
     def release(self, hashes: Sequence[bytes]) -> None:
-        for h in hashes:
-            entry = self._blocks.get(h)
-            if entry is not None and entry.refs > 0:
-                entry.refs -= 1
+        with self._lock:
+            for h in hashes:
+                entry = self._blocks.get(h)
+                if entry is not None and entry.refs > 0:
+                    entry.refs -= 1
 
     # -- insert / evict ---------------------------------------------------
 
@@ -232,26 +254,27 @@ class PrefixKVStore:
         store.  If the block already exists without a device page (host
         resident after eviction), the page is adopted — a free revival.
         """
-        self._clock += 1
-        entry = self._blocks.get(h)
-        if entry is not None:
-            if entry.page < 0 and page >= 0:
-                entry.page = page
-                entry.refs += refs
-                entry.last_used = self._clock
-                return entry
-            # caller keeps its duplicate page; store already has one
-            raise ValueError("block already device-resident")
-        entry = CachedBlock(
-            hash=h,
-            parent=parent,
-            tokens=tuple(tokens),
-            page=page,
-            refs=refs,
-            last_used=self._clock,
-        )
-        self._blocks[h] = entry
-        return entry
+        with self._lock:
+            self._clock += 1
+            entry = self._blocks.get(h)
+            if entry is not None:
+                if entry.page < 0 and page >= 0:
+                    entry.page = page
+                    entry.refs += refs
+                    entry.last_used = self._clock
+                    return entry
+                # caller keeps its duplicate page; store already has one
+                raise ValueError("block already device-resident")
+            entry = CachedBlock(
+                hash=h,
+                parent=parent,
+                tokens=tuple(tokens),
+                page=page,
+                refs=refs,
+                last_used=self._clock,
+            )
+            self._blocks[h] = entry
+            return entry
 
     def adopt_host(
         self, h: bytes, parent: Optional[bytes], tokens: Sequence[int]
@@ -263,24 +286,29 @@ class PrefixKVStore:
         restore path revives it when a match acquires it.  Idempotent:
         an existing entry (any residency) is returned untouched.
         """
-        entry = self._blocks.get(h)
-        if entry is not None:
+        with self._lock:
+            entry = self._blocks.get(h)
+            if entry is not None:
+                return entry
+            self._clock += 1
+            entry = CachedBlock(
+                hash=h,
+                parent=parent,
+                tokens=tuple(tokens),
+                page=-1,
+                refs=0,
+                last_used=self._clock,
+            )
+            self._blocks[h] = entry
             return entry
-        self._clock += 1
-        entry = CachedBlock(
-            hash=h,
-            parent=parent,
-            tokens=tuple(tokens),
-            page=-1,
-            refs=0,
-            last_used=self._clock,
-        )
-        self._blocks[h] = entry
-        return entry
 
     def evictable(self) -> list[CachedBlock]:
         """Device-resident refcount-zero blocks, LRU first."""
-        out = [b for b in self._blocks.values() if b.refs == 0 and b.page >= 0]
+        with self._lock:
+            out = [
+                b for b in self._blocks.values()
+                if b.refs == 0 and b.page >= 0
+            ]
         out.sort(key=lambda b: b.last_used)
         return out
 
@@ -298,23 +326,26 @@ class PrefixKVStore:
     def mark_offloaded(self, h: bytes) -> None:
         """Block left the device but survives in the host pool: keep the
         index entry restorable (page = -1)."""
-        entry = self._blocks.get(h)
-        if entry is not None:
-            entry.page = -1
+        with self._lock:
+            entry = self._blocks.get(h)
+            if entry is not None:
+                entry.page = -1
 
     def forget(self, h: bytes) -> None:
         """Drop a block from the index entirely (evicted with no host
         copy — it can never be restored, so a match must miss)."""
-        self._blocks.pop(h, None)
+        with self._lock:
+            self._blocks.pop(h, None)
 
     def reset(self) -> None:
         """Device reset: every device page is gone (the generator
         rebuilds its allocator), but host-pool copies survive and their
         index entries stay restorable."""
-        self.pending_offload.clear()  # the gathered device buffers died
-        for h in list(self._blocks):
-            b = self._blocks[h]
-            b.page = -1
-            b.refs = 0
-            if not (self.host_pool and self.host_pool.has(h)):
-                del self._blocks[h]
+        with self._lock:
+            self.pending_offload.clear()  # gathered device buffers died
+            for h in list(self._blocks):
+                b = self._blocks[h]
+                b.page = -1
+                b.refs = 0
+                if not (self.host_pool and self.host_pool.has(h)):
+                    del self._blocks[h]

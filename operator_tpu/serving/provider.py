@@ -532,10 +532,13 @@ def build_serving_engine(
         generator, supervisor=supervisor, scheduler=scheduler
     )
     # fleet KV fabric + disaggregation role (operator_tpu/fabric/,
-    # docs/FABRIC.md).  The fetcher starts with a private empty index —
-    # a no-op until something feeds it holders: in-process fleets
-    # (loadgen storm, bench, tests) point it at the router's
-    # health.kv_index, which the existing /healthz poll keeps fresh.
+    # docs/FABRIC.md).  The fetcher starts with a private empty index;
+    # two feeders exist: in-process fleets (loadgen storm, bench, tests)
+    # point it at the router's health.kv_index, which the existing
+    # /healthz poll keeps fresh, while a standalone replica (the k8s
+    # Deployment) runs the KV_FABRIC_PEERS poller — without one of the
+    # two the empty-index gate makes the fabric a true no-op (no probe,
+    # no tokenize) rather than a silent per-request tax.
     from ..fabric.disagg import normalize_role
 
     engine.replica_role = normalize_role(config.replica_role)
@@ -543,23 +546,39 @@ def build_serving_engine(
         from ..fabric.fetch import FabricFetcher
         from ..fabric.index import FabricIndex
 
+        self_id = (
+            os.environ.get("SERVING_REPLICA_ID")
+            or os.environ.get("POD_NAME")
+            or ""
+        )
         engine.fabric = FabricFetcher(
             FabricIndex(),
             api_token=os.environ.get("OPERATOR_TPU_API_TOKEN") or None,
             timeout_s=config.kv_fabric_fetch_timeout_s,
             concurrency=config.kv_fabric_concurrency,
-            self_id=(
-                os.environ.get("SERVING_REPLICA_ID")
-                or os.environ.get("POD_NAME")
-                or ""
-            ),
+            self_id=self_id,
             metrics=generator.metrics,
         )
+        peers = [
+            u.strip() for u in config.kv_fabric_peers.split(",") if u.strip()
+        ]
+        if peers:
+            from ..fabric.peers import PeerPoller
+
+            engine.fabric_poller = PeerPoller(
+                engine.fabric.index,
+                peers=peers,
+                self_id=self_id,
+                poll_s=config.kv_fabric_poll_s,
+                timeout_s=config.kv_fabric_fetch_timeout_s,
+                metrics=generator.metrics,
+            )
         log.info(
             "fleet KV fabric: fetch timeout %.2fs concurrency %d role %s "
-            "mirror %s",
+            "mirror %s peers %s",
             config.kv_fabric_fetch_timeout_s, config.kv_fabric_concurrency,
             engine.replica_role, config.kv_fabric_mirror,
+            ",".join(peers) or "<in-process index>",
         )
     return engine, model_id
 

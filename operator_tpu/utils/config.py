@@ -383,6 +383,16 @@ class OperatorConfig:
     # prefill completion (inside the commit step's host-sync window) so
     # peers can fetch them without waiting for eviction to spill them
     kv_fabric_mirror: bool = True
+    # comma-separated peer base URLs whose /healthz inventories feed
+    # this replica's fabric index (fabric/peers.py).  Hostnames are
+    # DNS-expanded every poll round, so the single headless-Service name
+    # (http://podmortem-serving:8000) covers the whole fleet.  "" (the
+    # default) starts no poller: an in-process harness feeds the index
+    # directly, and a standalone replica without peers has no fabric to
+    # fetch from — the empty-index gate skips the prefetch entirely
+    kv_fabric_peers: str = ""
+    # seconds between peer inventory poll rounds
+    kv_fabric_poll_s: float = 5.0
     # prefill/decode disaggregation role advertised on /healthz
     # (fabric/disagg.py): "prefill" | "decode" | "mixed".  A routing
     # preference, never a filter — mixed (the default) serves both

@@ -77,6 +77,15 @@ class TestWire:
         assert np.array_equal(k, k2) and np.array_equal(v, v2)
         assert k2.dtype == k.dtype and v2.shape == v.shape
 
+    def test_decode_copies_out_of_the_wire_blob(self):
+        # a frombuffer view would be read-only and would pin the whole
+        # response bytes alive behind one page-sized pool entry
+        k, v = _page(7)
+        blob = encode_block(bytes.fromhex(HASH), k, v)
+        _h, k2, v2 = decode_block(blob)
+        assert k2.flags.writeable and v2.flags.writeable
+        assert k2.base is None and v2.base is None
+
     @pytest.mark.parametrize("mutate", [
         lambda b: b[:-1],                          # truncated payload
         lambda b: b"XXKV1\n" + b[6:],               # bad magic
@@ -153,6 +162,143 @@ class TestFabricIndex:
         index.update("b", ["h1"], url="http://b")
         assert index.holders("h1") == ["a", "b"]
         assert index.holder_urls("h1") == [("b", "http://b")]
+
+    def test_empty_is_the_pre_tokenize_gate(self):
+        index = FabricIndex()
+        assert index.empty()
+        index.update("a", [], url="http://a")   # a replica with no blocks
+        assert index.empty()
+        index.update("a", ["h1"], url="http://a")
+        assert not index.empty()
+        index.update("a", [], url="http://a")
+        assert index.empty()
+
+
+# ---------------------------------------------------------------------------
+# the peer poller (the standalone replica's index feeder)
+# ---------------------------------------------------------------------------
+
+
+def healthz(rid, blocks):
+    import json
+
+    return json.dumps({
+        "status": "ok",
+        "replica": rid,
+        "load": {"kvBlocks": blocks},
+    }).encode()
+
+
+def poller_for(fleet, *, peers=None, resolver=None, **kw):
+    """PeerPoller over an in-memory fleet: {url: (replica_id, blocks)}.
+    A url missing from the fleet answers like a dead pod."""
+    from operator_tpu.fabric import PeerPoller
+
+    async def transport(url, timeout_s):
+        assert timeout_s > 0
+        base = url.rsplit("/healthz", 1)[0]
+        if base not in fleet:
+            raise ConnectionError(f"no pod at {base}")
+        rid, blocks = fleet[base]
+        return 200, healthz(rid, blocks)
+
+    index = FabricIndex()
+    kw.setdefault("metrics", MetricsRegistry())
+    return index, PeerPoller(
+        index,
+        peers=peers or list(fleet),
+        resolver=resolver or (lambda host, port: [(host, port)]),
+        transport=transport,
+        **kw,
+    )
+
+
+class TestPeerPoller:
+    def test_poll_feeds_the_index_with_fetchable_urls(self):
+        fleet = {
+            "http://a:8000": ("pod-a", ["h1", "h2"]),
+            "http://b:8000": ("pod-b", ["h2"]),
+        }
+        index, poller = poller_for(fleet)
+        assert asyncio.run(poller.poll_once()) == 2
+        assert index.holders("h2") == ["pod-a", "pod-b"]
+        # the fed URL is the one the fetch client will GET /kv/blocks on
+        assert ("pod-a", "http://a:8000") in index.holder_urls("h1")
+        assert poller.metrics.counter("fabric_peer_poll_ok") == 2
+
+    def test_self_is_never_indexed(self):
+        fleet = {"http://me:8000": ("pod-me", ["h1"])}
+        index, poller = poller_for(fleet, self_id="pod-me")
+        assert asyncio.run(poller.poll_once()) == 0
+        assert index.empty()
+
+    def test_dead_peer_is_removed_the_same_round(self):
+        fleet = {
+            "http://a:8000": ("pod-a", ["h1"]),
+            "http://b:8000": ("pod-b", ["h1"]),
+        }
+        index, poller = poller_for(fleet)
+        asyncio.run(poller.poll_once())
+        assert index.holders("h1") == ["pod-a", "pod-b"]
+        del fleet["http://a:8000"]  # pod died between rounds
+        asyncio.run(poller.poll_once())
+        # a dead peer is never offered as a holder
+        assert index.holders("h1") == ["pod-b"]
+        m = poller.metrics
+        assert m.counter("fabric_peer_poll_error") == 1
+        assert m.counter("fabric_peer_removed") == 1
+
+    def test_replace_on_report_rides_the_poller(self):
+        fleet = {"http://a:8000": ("pod-a", ["h1", "h2"])}
+        index, poller = poller_for(fleet)
+        asyncio.run(poller.poll_once())
+        assert index.holders("h1") == ["pod-a"]
+        fleet["http://a:8000"] = ("pod-a", ["h2"])  # h1 aged out
+        asyncio.run(poller.poll_once())
+        assert index.holders("h1") == []
+        assert index.holders("h2") == ["pod-a"]
+
+    def test_dns_expansion_covers_a_headless_service(self):
+        """One KV_FABRIC_PEERS entry (the Service name) expands to every
+        pod IP each round — the k8s deployment shape."""
+        fleet = {
+            "http://10.0.0.4:8000": ("pod-a", ["h1"]),
+            "http://10.0.0.5:8000": ("pod-b", ["h2"]),
+        }
+
+        def resolver(host, port):
+            assert host == "podmortem-serving" and port == 8000
+            return [("10.0.0.4", 8000), ("10.0.0.5", 8000)]
+
+        index, poller = poller_for(
+            fleet, peers=["http://podmortem-serving:8000"],
+            resolver=resolver,
+        )
+        assert asyncio.run(poller.poll_once()) == 2
+        assert index.holders("h1") == ["pod-a"]
+        assert index.holders("h2") == ["pod-b"]
+        # scale-down: the name stops resolving pod-b's IP
+        def shrunk(host, port):
+            return [("10.0.0.4", 8000)]
+
+        poller._resolver = shrunk
+        asyncio.run(poller.poll_once())
+        assert index.holders("h2") == []
+
+    def test_resolve_failure_counts_and_removes(self):
+        fleet = {"http://a:8000": ("pod-a", ["h1"])}
+
+        index, poller = poller_for(fleet)
+        asyncio.run(poller.poll_once())
+        assert not index.empty()
+
+        def dead_dns(host, port):
+            raise OSError("dns down")
+
+        poller._resolver = dead_dns
+        asyncio.run(poller.poll_once())
+        assert index.empty()
+        assert poller.metrics.counter("fabric_peer_resolve_error") == 1
 
 
 # ---------------------------------------------------------------------------
@@ -561,3 +707,101 @@ class TestMirrorAndAdopt:
         fetcher = make_fetcher(FabricIndex(), served({}))
         tokens = list(range(48))
         assert asyncio.run(fetcher.prefetch(tokens, store=store_b)) == 0
+
+
+# ---------------------------------------------------------------------------
+# threading discipline: event-loop readers vs decode-thread mutation
+# ---------------------------------------------------------------------------
+
+import threading  # noqa: E402
+import time  # noqa: E402
+from concurrent.futures import ThreadPoolExecutor  # noqa: E402
+
+
+class TestStoreThreadSafety:
+    def test_readers_never_see_mid_mutation_state(self):
+        """Hammer the store from two threads: the fabric path adopting
+        and forgetting blocks while the /healthz path iterates
+        inventory/stats/evictable — the regression this guards is a
+        dict-changed-during-iteration RuntimeError."""
+        pool = HostKVPool(8)
+        store = PrefixKVStore(16, host_pool=pool, metrics=MetricsRegistry())
+        k = np.zeros((2, 4, 2, 8), dtype=np.float32)
+        v = np.zeros_like(k)
+        stop = threading.Event()
+        errors: list[BaseException] = []
+
+        def mutate():
+            i = 0
+            try:
+                while not stop.is_set():
+                    tokens = list(range(i % 5, i % 5 + 64))
+                    hashes = block_hashes(tokens, 16)
+                    parent = None
+                    for n, h in enumerate(hashes):
+                        pool.put(h, k, v)
+                        store.adopt_host(h, parent,
+                                         tokens[n * 16:(n + 1) * 16])
+                        parent = h
+                    for h in hashes:
+                        store.forget(h)
+                    i += 1
+            except BaseException as exc:  # noqa: BLE001 - the assertion
+                errors.append(exc)
+
+        def read():
+            try:
+                while not stop.is_set():
+                    store.inventory()
+                    store.stats()
+                    store.evictable()
+                    store.probe(list(range(64)))
+                    len(store)
+            except BaseException as exc:  # noqa: BLE001 - the assertion
+                errors.append(exc)
+
+        threads = [threading.Thread(target=mutate),
+                   threading.Thread(target=read)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert not errors, errors
+
+    def test_prefetch_store_ops_run_on_the_given_executor(self, params):
+        """With executor= (the engine's decode thread), probe and every
+        adoption-side store mutation must run THERE — that serialization
+        with enqueue/step is the whole fix for the event-loop race."""
+        sched_a, gen_a, store_a = make_replica(params, mirror=True)
+        drain_one(sched_a, sched_a.enqueue(PROMPT, greedy(4)))
+        tokens = gen_a.tokenizer.encode(PROMPT)
+        hashes = block_hashes(tokens, gen_a.page_size)
+        index = FabricIndex()
+        index.update("a", [h.hex() for h in hashes], url="http://a")
+        pages = {h.hex(): store_a.host_pool.get(h) for h in hashes}
+
+        _, _, store_b = make_replica(params, mirror=False)
+        seen: set[str] = set()
+        real_probe, real_adopt = store_b.probe, store_b.adopt_host
+
+        def spy_probe(toks):
+            seen.add(threading.current_thread().name)
+            return real_probe(toks)
+
+        def spy_adopt(h, parent, toks):
+            seen.add(threading.current_thread().name)
+            return real_adopt(h, parent, toks)
+
+        store_b.probe = spy_probe
+        store_b.adopt_host = spy_adopt
+        fetcher = make_fetcher(index, served(pages), self_id="b")
+        with ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tpu-decode"
+        ) as ex:
+            adopted = asyncio.run(
+                fetcher.prefetch(tokens, store=store_b, executor=ex)
+            )
+        assert adopted == len(hashes)
+        assert seen and all(n.startswith("tpu-decode") for n in seen)
