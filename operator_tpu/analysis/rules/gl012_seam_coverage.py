@@ -20,11 +20,21 @@ sites (the same site set GL003 budgets, via
     function contains a ``fault_plan.apply`` whose site pattern therefore
     governs the call;
 (b) **test-named** — every registered seam pattern is named by at least
-    one string literal in ``tests/`` or ``loadgen/`` (f-string seam sites
-    register as fnmatch globs — ``f"kube.{op}"`` is ``kube.*`` — and a
-    test naming ``kube.patch_status`` matches it; the comparison runs
-    both directions so a test's own glob ``kube.*`` also matches a
-    literal seam).
+    one string literal in ``tests/``, ``loadgen/``, or ``chaos/``, or by
+    a game-day scenario file under ``tests/scenarios/*.json`` (f-string
+    seam sites register as fnmatch globs — ``f"kube.{op}"`` is
+    ``kube.*`` — and a test naming ``kube.patch_status`` matches it; the
+    comparison runs both directions so a test's own glob ``kube.*`` also
+    matches a literal seam).
+
+Scenario files are first-class seam sources, and the compact is
+two-way: a seam a scenario names counts as rehearsed, and a scenario
+naming a seam NO ``fault_plan.apply`` registers is a lint error — the
+conductor would queue an injection nothing ever consumes, and the
+game day's ``pending_faults`` gate would blame the scenario at run
+time instead of the diff that renamed the seam.  The same unknown-seam
+check covers literal ``Injection("<seam>", ...)`` construction in
+chaos/test python.
 
 The full audit is emitted as a deterministic ``seam-coverage.json`` map
 (``--seam-coverage FILE``; byte-identical across runs on an unchanged
@@ -36,6 +46,7 @@ from __future__ import annotations
 
 import ast
 import fnmatch
+import json
 import re
 from typing import Optional
 
@@ -55,21 +66,35 @@ _SITE_LITERAL_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[A-Za-z0-9_*?\[\]]+)+$")
 
 #: modules whose ``fault_plan.apply`` calls register seams — the package
 #: itself, minus the analysis tree (rule fixtures/doc examples are not
-#: seams) and minus loadgen (a chaos DRIVER: its literals count as
-#: test-side naming, its apply calls — if any — are not registrations)
+#: seams) and minus loadgen and chaos (chaos DRIVERS: their literals
+#: count as test-side naming, their apply calls — if any — are not
+#: registrations)
 _REGISTRY_SCOPE = re.compile(
-    r"operator_tpu/(?!analysis/|loadgen/).*\.py$"
+    r"operator_tpu/(?!analysis/|loadgen/|chaos/).*\.py$"
 )
+
+#: committed game-day scenario files — seam-naming sources the gameday
+#: lane replays (``LOADGEN_SCENARIO=<file.json>``)
+_SCENARIO_DIR = "tests/scenarios"
+
+#: a scenario injection's seam key in the JSON text, matched on the raw
+#: source so findings carry real line numbers (json.loads drops them)
+_SEAM_KEY_RE = re.compile(r'"seam"\s*:\s*"([^"]*)"')
 
 
 def seam_pattern(call: ast.Call) -> Optional[str]:
-    """The site pattern a ``fault_plan.apply(<arg0>, ...)`` call registers:
-    a literal string verbatim, an f-string with every interpolation
-    widened to ``*`` (``f"kube.watch.{kind}"`` -> ``kube.watch.*``).
-    None when the call is not an apply on a fault-plan receiver or the
-    site argument is not statically resolvable."""
+    """The site pattern a ``fault_plan.apply(<arg0>, ...)`` or
+    ``fault_plan.apply_async(<arg0>, ...)`` call registers: a literal
+    string verbatim, an f-string with every interpolation widened to
+    ``*`` (``f"kube.watch.{kind}"`` -> ``kube.watch.*``).  None when the
+    call is not an apply on a fault-plan receiver or the site argument
+    is not statically resolvable."""
     chain = attr_chain(call.func)
-    if len(chain) < 2 or chain[-1] != "apply" or chain[-2] != "fault_plan":
+    if (
+        len(chain) < 2
+        or chain[-1] not in ("apply", "apply_async")
+        or chain[-2] != "fault_plan"
+    ):
         return None
     if not call.args:
         return None
@@ -102,9 +127,10 @@ class ChaosSeamCoverage(Rule):
     name = "chaos-seam-coverage"
     description = (
         "every blocking external call must be reachable from a registered "
-        "fault_plan seam (utils/faultinject.py), and every registered seam "
-        "must be named by a chaos/loadgen test — emits the seam-coverage.json "
-        "audit map"
+        "fault_plan seam (utils/faultinject.py), every registered seam "
+        "must be named by a chaos/loadgen test or a tests/scenarios/*.json "
+        "game-day file, and every seam a scenario names must exist — emits "
+        "the seam-coverage.json audit map"
     )
     #: sites audited — exactly the deadline rule's control-plane scope;
     #: the seam registry and the callgraph walk span the whole package
@@ -192,7 +218,16 @@ class ChaosSeamCoverage(Rule):
             })
 
         # -- (b) test naming per registered seam ------------------------
+        # scenario files and chaos-package literals count alongside
+        # tests/ and loadgen/: a committed game-day scenario rehearses
+        # every seam it injects
         literals = self._test_literals(ctx)
+        scenarios, scenario_findings = self._scenario_seams(ctx)
+        findings.extend(scenario_findings)
+        for relpath, rows in scenarios.items():
+            literals.setdefault(relpath, set()).update(
+                seam for seam, _line, _name in rows
+            )
         seam_rows = []
         for pattern in sorted(registry):
             naming = sorted(
@@ -227,6 +262,46 @@ class ChaosSeamCoverage(Rule):
                 "tests": naming,
             })
 
+        # -- (c) unknown seams in scenarios -----------------------------
+        # a scenario (JSON file or literal Injection(...) in chaos/test
+        # python) naming a seam no fault_plan.apply registers is dead
+        # chaos: the conductor queues a rule nothing consumes and the
+        # run-time pending_faults gate fires long after the rename that
+        # broke it
+        known = sorted(registry)
+        for relpath in sorted(scenarios):
+            for seam, line, scenario_name in scenarios[relpath]:
+                if any(_patterns_match(p, seam) for p in known):
+                    continue
+                findings.append(Finding(
+                    rule=self.id,
+                    path=relpath,
+                    line=line,
+                    message=(
+                        f"scenario names unknown fault seam `{seam}`: no "
+                        "fault_plan.apply registers it, so the game day "
+                        "queues an injection nothing can fire — fix the "
+                        "seam name or register the seam "
+                        "(utils/faultinject.py)"
+                    ),
+                    symbol=scenario_name,
+                ))
+        for module, node, seam in self._injection_literals(ctx, package):
+            if any(_patterns_match(p, seam) for p in known):
+                continue
+            if ctx.module(module.relpath) is None:
+                continue
+            findings.append(
+                self.finding(
+                    module, node,
+                    f"Injection names unknown fault seam `{seam}`: no "
+                    "fault_plan.apply registers it, so the game day "
+                    "queues an injection nothing can fire — fix the "
+                    "seam name or register the seam "
+                    "(utils/faultinject.py)",
+                )
+            )
+
         # stable artifact for --seam-coverage / CI (plain assignment: no
         # other rule touches this key, and dict stores are atomic)
         ctx.caches["seam_coverage"] = {
@@ -235,6 +310,10 @@ class ChaosSeamCoverage(Rule):
             "external_call_sites": sorted(
                 site_rows, key=lambda r: (r["path"], r["line"])
             ),
+            "scenario_files": {
+                relpath: sorted({seam for seam, _l, _n in rows})
+                for relpath, rows in sorted(scenarios.items())
+            },
             "uncovered_sites": sum(1 for r in site_rows if not r["seams"]),
             "unnamed_seams": sum(1 for r in seam_rows if not r["tests"]),
         }
@@ -360,7 +439,7 @@ class ChaosSeamCoverage(Rule):
         so a ``--changed-only`` run still audits against the whole test
         tree; parses are memoized on the context."""
         out: dict[str, set[str]] = {}
-        roots = ("tests", "operator_tpu/loadgen")
+        roots = ("tests", "operator_tpu/loadgen", "operator_tpu/chaos")
         for rel_root in roots:
             base = ctx.root / rel_root
             if not base.is_dir():
@@ -379,4 +458,83 @@ class ChaosSeamCoverage(Rule):
                 }
                 if found:
                     out[relpath] = found
+        return out
+
+    # -- scenario files --------------------------------------------------
+    def _scenario_seams(
+        self, ctx: AnalysisContext
+    ) -> "tuple[dict[str, list[tuple[str, int, str]]], list[Finding]]":
+        """Repo-relative scenario path -> [(seam, line, scenario name)]
+        for every ``tests/scenarios/*.json``, plus findings for files
+        that do not parse (a committed repro the gameday lane cannot
+        replay is itself a defect).  Seams and lines come from the raw
+        text (``json.loads`` drops positions); the parse is only the
+        well-formedness gate."""
+        out: dict[str, list[tuple[str, int, str]]] = {}
+        findings: list[Finding] = []
+        base = ctx.root / _SCENARIO_DIR
+        if not base.is_dir():
+            return out, findings
+        for path in sorted(base.glob("*.json")):
+            relpath = path.relative_to(ctx.root).as_posix()
+            try:
+                text = path.read_text(encoding="utf-8")
+                data = json.loads(text)
+            except (OSError, ValueError) as exc:
+                findings.append(Finding(
+                    rule=self.id,
+                    path=relpath,
+                    line=1,
+                    message=(
+                        "scenario file is not valid JSON — the gameday "
+                        f"lane cannot replay it ({exc})"
+                    ),
+                    symbol=path.stem,
+                ))
+                continue
+            name = str(data.get("name", path.stem)) if isinstance(
+                data, dict
+            ) else path.stem
+            rows = []
+            for match in _SEAM_KEY_RE.finditer(text):
+                line = text.count("\n", 0, match.start()) + 1
+                rows.append((match.group(1), line, name))
+            out[relpath] = rows
+        return out, findings
+
+    def _injection_literals(
+        self, ctx: AnalysisContext, package: "list[ModuleSource]"
+    ) -> "list[tuple[ModuleSource, ast.Call, str]]":
+        """Literal first arguments of ``Injection(...)`` constructions in
+        the chaos package and the test tree — python-side scenario
+        definitions, held to the same known-seam bar as JSON files."""
+        modules: dict[str, ModuleSource] = {
+            m.relpath: m for m in package
+            if m.relpath.startswith("operator_tpu/chaos/")
+        }
+        base = ctx.root / "tests"
+        if base.is_dir():
+            for path in sorted(base.rglob("*.py")):
+                relpath = path.relative_to(ctx.root).as_posix()
+                module = ctx.aux_module(relpath)
+                if module is not None and module.tree is not None:
+                    modules.setdefault(relpath, module)
+        out = []
+        for relpath in sorted(modules):
+            module = modules[relpath]
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = attr_chain(node.func)
+                if not chain or chain[-1] != "Injection":
+                    continue
+                arg: Optional[ast.expr] = node.args[0] if node.args else None
+                if arg is None:
+                    for kw in node.keywords:
+                        if kw.arg == "seam":
+                            arg = kw.value
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str
+                ):
+                    out.append((module, node, arg.value))
         return out

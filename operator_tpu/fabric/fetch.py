@@ -16,7 +16,9 @@ recomputing them.  Two invariants shape everything here:
   rest on the floor (they were cheap host numpy, not device pages).
 
 Fetch outcomes feed back into the index: a 404 from a supposed holder
-evicts that (replica, block) entry immediately.
+evicts that (replica, block) entry immediately, and timeouts/transport
+errors decay it after ``FabricIndex.failure_threshold`` consecutive
+failures (a black-holed peer can never 404 — see index.py).
 """
 
 from __future__ import annotations
@@ -89,6 +91,12 @@ class FabricFetcher:
             asyncio.to_thread(fetch), timeout=budget_s + _THREAD_SLACK_S
         )
 
+    def _note_failure(self, rid: str, block_hash: str) -> None:
+        """Feed a non-404 fetch failure into the index's consecutive-
+        failure decay; counts the eviction when the threshold tripped."""
+        if self.index.note_failure(rid, block_hash):
+            self.metrics.incr("fabric_index_decayed", exemplar=rid)
+
     # -- one block ------------------------------------------------------
     async def fetch_block(self, block_hash: str, *, budget_s: Optional[float] = None):
         """Fetch one block from any current holder.
@@ -121,11 +129,21 @@ class FabricFetcher:
                     break
                 if self.fault_plan is not None:
                     try:
-                        self.fault_plan.apply(
+                        await self.fault_plan.apply_async(
                             "fabric.fetch", replica=rid, block=block_hash
                         )
-                    except Exception:
-                        self.metrics.incr("fabric_fetch_error", exemplar=rid)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as exc:
+                        if _is_timeout(exc):
+                            self.metrics.incr(
+                                "fabric_fetch_timeout", exemplar=rid
+                            )
+                        else:
+                            self.metrics.incr(
+                                "fabric_fetch_error", exemplar=rid
+                            )
+                        self._note_failure(rid, block_hash)
                         continue
                 block_url = f"{url.rstrip('/')}/kv/blocks/{block_hash}"
                 try:
@@ -144,6 +162,7 @@ class FabricFetcher:
                         self.metrics.incr("fabric_fetch_timeout", exemplar=rid)
                     else:
                         self.metrics.incr("fabric_fetch_error", exemplar=rid)
+                    self._note_failure(rid, block_hash)
                     continue
                 if status == 404:
                     if self.index.evict(rid, block_hash):
@@ -152,15 +171,19 @@ class FabricFetcher:
                     continue
                 if status != 200:
                     self.metrics.incr("fabric_fetch_error", exemplar=rid)
+                    self._note_failure(rid, block_hash)
                     continue
                 try:
                     got_hash, k, v = decode_block(data)
                 except CorruptBlock:
                     self.metrics.incr("fabric_fetch_corrupt", exemplar=rid)
+                    self._note_failure(rid, block_hash)
                     continue
                 if got_hash.hex() != block_hash:
                     self.metrics.incr("fabric_fetch_corrupt", exemplar=rid)
+                    self._note_failure(rid, block_hash)
                     continue
+                self.index.note_success(rid, block_hash)
                 self.metrics.incr("fabric_fetch_ok", exemplar=rid)
                 return k, v
         self.metrics.incr("fabric_fetch_fallback", exemplar=block_hash)

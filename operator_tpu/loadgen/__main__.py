@@ -532,6 +532,134 @@ def _overload_main() -> None:
     print("loadgen overload: OK")
 
 
+async def _gameday_main() -> None:
+    """``LOADGEN_GAMEDAY=1``: the seeded game-day matrix
+    (docs/ROBUSTNESS.md "Game days").
+
+    Runs each scenario through the chaos conductor
+    (``operator_tpu/chaos/``) and fails loudly unless, per scenario:
+
+    - BUILD determinism: an independent second build and a JSON
+      round-trip both produce the identical fingerprint — the replay
+      contract a committed repro depends on;
+    - the invariant auditor recorded ZERO violations across its commit
+      barriers and the scenario-end sweep;
+    - every injection fired (``pending_faults == {}``) — a rule the run
+      never consumed is a renamed seam or a dead phase window, and a
+      gate that ignores it quietly stops rehearsing that failure;
+    - arrivals landed and every submit drained without error.
+
+    Scenario selection: the builtin matrix (``chaos/library.py``,
+    reseeded by ``LOADGEN_SEED``) plus every committed repro under
+    ``tests/scenarios/*.json``; ``LOADGEN_SCENARIO=<file.json>`` runs
+    that one file instead — the replay path printed by the shrinker.
+    ``LOADGEN_MUTATION=<name>`` arms a mutation lane (the auditor
+    self-test), inverting the violation gate: the run must violate.
+    """
+    from ..chaos import ChaosScenario, run_scenario
+    from ..chaos.library import builtin_scenarios
+    from ..utils.timing import MetricsRegistry
+
+    seed = int(os.environ.get("LOADGEN_SEED", "0") or 0)
+    mutation = os.environ.get("LOADGEN_MUTATION") or None
+    single = os.environ.get("LOADGEN_SCENARIO") or None
+
+    # (scenario, source, rebuild): rebuild() is the INDEPENDENT second
+    # build the fingerprint-identity gate compares against
+    jobs = []
+    if single:
+        try:
+            with open(single, encoding="utf-8") as fh:
+                text = fh.read()
+            scenario = ChaosScenario.from_json(text)
+        except (OSError, ValueError, KeyError) as exc:
+            _fail(f"cannot load LOADGEN_SCENARIO={single}: {exc}")
+        jobs.append((
+            scenario, single,
+            lambda text=text: ChaosScenario.from_json(text),
+        ))
+    else:
+        for i, scenario in enumerate(builtin_scenarios(seed)):
+            jobs.append((
+                scenario, "builtin",
+                lambda i=i: builtin_scenarios(seed)[i],
+            ))
+        scen_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            "tests", "scenarios",
+        )
+        if os.path.isdir(scen_dir):
+            for name in sorted(os.listdir(scen_dir)):
+                if not name.endswith(".json"):
+                    continue
+                path = os.path.join(scen_dir, name)
+                with open(path, encoding="utf-8") as fh:
+                    text = fh.read()
+                try:
+                    scenario = ChaosScenario.from_json(text)
+                except (ValueError, KeyError) as exc:
+                    _fail(f"committed scenario {name} does not load: {exc}")
+                jobs.append((
+                    scenario, f"tests/scenarios/{name}",
+                    lambda text=text: ChaosScenario.from_json(text),
+                ))
+
+    rows = []
+    for scenario, source, rebuild in jobs:
+        where = f"{scenario.name} ({source})"
+        if rebuild().fingerprint() != scenario.fingerprint():
+            _fail(f"{where}: two builds disagree on the fingerprint")
+        round_trip = ChaosScenario.from_json(scenario.to_json())
+        if round_trip.fingerprint() != scenario.fingerprint():
+            _fail(f"{where}: JSON round-trip changes the fingerprint")
+
+        report = await run_scenario(
+            scenario, mutation=mutation, metrics=MetricsRegistry(),
+        )
+        violated = [v["name"] for v in report["violations"]]
+        if mutation is None and violated:
+            _fail(
+                f"{where}: invariant violation(s) {violated} — black-boxed "
+                "by the flight recorder; shrink the scenario to a minimal "
+                "repro with operator_tpu.chaos.shrink"
+            )
+        if mutation is not None and not violated:
+            _fail(
+                f"{where}: mutation `{mutation}` armed but no invariant "
+                "fired — the auditor is asleep"
+            )
+        if report["pending_faults"]:
+            _fail(
+                f"{where}: injections never fired: "
+                f"{report['pending_faults']} — renamed seam or dead "
+                "phase window"
+            )
+        driver = report["driver"]
+        if not driver["arrivals"]:
+            _fail(f"{where}: no arrivals landed")
+        if driver["submit_errors"] or driver["cancelled_at_drain"]:
+            _fail(
+                f"{where}: {driver['submit_errors']} submit error(s), "
+                f"{driver['cancelled_at_drain']} cancelled at drain"
+            )
+        rows.append({
+            "scenario": scenario.name,
+            "source": source,
+            "seed": scenario.seed,
+            "fingerprint": report["fingerprint"],
+            "arrivals": driver["arrivals"],
+            "completed": report["slo"]["total"]["completed"],
+            "invariant_checks": report["invariant_checks"],
+            "violations": violated,
+            "fault_trace_len": report["fault_trace_len"],
+            "actions": len(report["actions"]),
+        })
+
+    print(json.dumps(rows, indent=2))
+    print("loadgen gameday: OK")
+
+
 if __name__ == "__main__":
     if os.environ.get("LOADGEN_OVERLOAD", "0") == "1":
         _overload_main()
@@ -539,5 +667,7 @@ if __name__ == "__main__":
         asyncio.run(_elastic_main())
     elif os.environ.get("LOADGEN_DISAGG", "0") == "1":
         asyncio.run(_disagg_main())
+    elif os.environ.get("LOADGEN_GAMEDAY", "0") == "1":
+        asyncio.run(_gameday_main())
     else:
         asyncio.run(_main())

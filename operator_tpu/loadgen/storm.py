@@ -585,6 +585,10 @@ async def build_storm_stack(
         replicas, metrics=metrics, allow_empty=allow_empty,
         disaggregate=disaggregate,
     )
+    if fault_plan is not None:
+        # the router's dispatch seam joins the same plan as the apiserver
+        # (router.dispatch — replica kills/partitions in the data plane)
+        backend.router.fault_plan = fault_plan
     registry = default_registry()
     registry.register("storm", backend)
     pipeline = AnalysisPipeline(

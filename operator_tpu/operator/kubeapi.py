@@ -311,13 +311,15 @@ class FakeKubeApi(KubeApi):
     def inject_conflicts(self, times: int, op: str = "patch_status") -> None:
         self.inject_errors(op, lambda: ConflictError("the object has been modified"), times)
 
-    def _check_hooks(self, op: str, kind: str, name: str) -> None:
+    async def _check_hooks(self, op: str, kind: str, name: str) -> None:
         for hook in self.error_hooks:
             exc = hook(op, kind, name)
             if exc is not None:
                 raise exc
         if self.fault_plan is not None:
-            self.fault_plan.apply(f"kube.{op}", kind=kind, name=name)
+            # apply_async: a delay/jitter action holds the op without
+            # blocking the loop (latency-shaped apiserver)
+            await self.fault_plan.apply_async(f"kube.{op}", kind=kind, name=name)
 
     # --- store helpers ----------------------------------------------------
     def _bucket(self, kind: str) -> dict[tuple[str, str], dict]:
@@ -348,7 +350,7 @@ class FakeKubeApi(KubeApi):
 
     # --- KubeApi ----------------------------------------------------------
     async def get(self, kind: str, name: str, namespace: str) -> dict:
-        self._check_hooks("get", kind, name)
+        await self._check_hooks("get", kind, name)
         obj = self._bucket(kind).get((namespace, name))
         if obj is None:
             raise NotFoundError(f"{kind} {namespace}/{name} not found")
@@ -360,7 +362,7 @@ class FakeKubeApi(KubeApi):
         namespace: Optional[str] = None,
         label_selector: Optional[LabelSelector] = None,
     ) -> list[dict]:
-        self._check_hooks("list", kind, "*")
+        await self._check_hooks("list", kind, "*")
         out = []
         for (ns, _), obj in sorted(self._bucket(kind).items()):
             if namespace is not None and ns != namespace:
@@ -377,7 +379,7 @@ class FakeKubeApi(KubeApi):
         name, namespace = meta.get("name"), meta.get("namespace")
         if not name or not namespace:
             raise ApiError(f"{kind} requires metadata.name and metadata.namespace", 422)
-        self._check_hooks("create", kind, name)
+        await self._check_hooks("create", kind, name)
         bucket = self._bucket(kind)
         if (namespace, name) in bucket:
             raise ConflictError(f"{kind} {namespace}/{name} already exists")
@@ -398,7 +400,7 @@ class FakeKubeApi(KubeApi):
         patch: dict,
         resource_version: Optional[str],
     ) -> dict:
-        self._check_hooks(op, kind, name)
+        await self._check_hooks(op, kind, name)
         bucket = self._bucket(kind)
         current = bucket.get((namespace, name))
         if current is None:
@@ -439,7 +441,7 @@ class FakeKubeApi(KubeApi):
         )
 
     async def delete(self, kind: str, name: str, namespace: str) -> None:
-        self._check_hooks("delete", kind, name)
+        await self._check_hooks("delete", kind, name)
         bucket = self._bucket(kind)
         obj = bucket.pop((namespace, name), None)
         if obj is None:
@@ -451,7 +453,7 @@ class FakeKubeApi(KubeApi):
 
     # --- scale subresource ------------------------------------------------
     async def get_scale(self, kind: str, name: str, namespace: str) -> dict:
-        self._check_hooks("get_scale", kind, name)
+        await self._check_hooks("get_scale", kind, name)
         obj = self._bucket(kind).get((namespace, name))
         if obj is None:
             raise NotFoundError(f"{kind} {namespace}/{name} not found")
@@ -483,7 +485,7 @@ class FakeKubeApi(KubeApi):
         *,
         resource_version: Optional[str] = None,
     ) -> dict:
-        self._check_hooks("patch_scale", kind, name)
+        await self._check_hooks("patch_scale", kind, name)
         bucket = self._bucket(kind)
         current = bucket.get((namespace, name))
         if current is None:
@@ -527,7 +529,7 @@ class FakeKubeApi(KubeApi):
         previous: bool = False,
         tail_bytes: Optional[int] = None,
     ) -> str:
-        self._check_hooks("get_log", "Pod", name)
+        await self._check_hooks("get_log", "Pod", name)
         text = self._logs.get((namespace, name, previous))
         if text is None and previous:
             text = self._logs.get((namespace, name, False))
@@ -550,7 +552,7 @@ class FakeKubeApi(KubeApi):
             # stream-open faults: inject a 410 on a resume attempt
             # (WatchExpired forces the consumer's relist path) or refuse the
             # connection (WatchClosed) before any replay happens
-            self.fault_plan.apply(
+            await self.fault_plan.apply_async(
                 f"kube.watch_open.{kind}", resource_version=resource_version
             )
         replayed: list[WatchEvent] = []
@@ -581,14 +583,14 @@ class FakeKubeApi(KubeApi):
                     # per-event faults ("drop the stream after N events"):
                     # WatchClosed/WatchExpired here reaches the consumer
                     # exactly as a server-side stream death would
-                    self.fault_plan.apply(f"kube.watch.{kind}", event=event.type)
+                    await self.fault_plan.apply_async(f"kube.watch.{kind}", event=event.type)
                 yield event
             while True:
                 event = await registration.queue.get()
                 if isinstance(event, Exception):
                     raise WatchClosed(str(event)) from event
                 if self.fault_plan is not None:
-                    self.fault_plan.apply(f"kube.watch.{kind}", event=event.type)
+                    await self.fault_plan.apply_async(f"kube.watch.{kind}", event=event.type)
                 yield event
         finally:
             if registration in self._watches:

@@ -637,7 +637,9 @@ class OpenAICompatProvider:
             if budget_s is not None:
                 timeout_s = min(timeout_s, budget_s)
             if self.fault_plan is not None:
-                self.fault_plan.apply(
+                # apply_async: delay/jitter actions shape provider latency
+                # without blocking the loop
+                await self.fault_plan.apply_async(
                     "http.provider", attempt=attempt, replica=replica.id
                 )
             return await asyncio.to_thread(

@@ -436,7 +436,9 @@ class EngineRouter:
                     request=request_id,
                 ):
                     if self.fault_plan is not None:
-                        self.fault_plan.apply(
+                        # apply_async: delay/jitter actions shape dispatch
+                        # latency without blocking the loop
+                        await self.fault_plan.apply_async(
                             "router.dispatch", replica=replica.id, attempt=attempt
                         )
                     if resume_log is not None:

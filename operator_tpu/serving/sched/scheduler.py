@@ -140,6 +140,7 @@ class Scheduler:
         queue_limit: int = 0,
         overload_policy: Optional[Any] = None,
         fabric_mirror: bool = False,
+        audit_hook: Optional[Any] = None,
     ) -> None:
         if not getattr(generator, "paged", False):
             raise ValueError("the continuous scheduler requires paged KV")
@@ -222,6 +223,11 @@ class Scheduler:
         #: the determinism test replays a fixed arrival trace and
         #: asserts the schedule is byte-identical
         self.plan_log: Optional[list] = None
+        #: ``hook(self)`` after each step's commit window — the game-day
+        #: invariant auditor's commit-barrier probe point (chaos/
+        #: invariants.py checks page conservation against
+        #: :meth:`page_accounting` here, while rows still hold pages)
+        self.audit_hook: Optional[Any] = audit_hook
         #: queue eviction (router/value.py): when the submit queue holds
         #: ``queue_limit`` entries, enqueue sheds the LOWEST-VALUE
         #: non-protected request instead of growing without bound.
@@ -554,7 +560,38 @@ class Scheduler:
             self._commit_oldest(outcomes)
             if not plan.work:
                 break
+        if self.audit_hook is not None:
+            # commit barrier: every page granted, cached, offloaded or
+            # freed this step has settled — the point where fleet-wide
+            # conservation invariants must hold exactly
+            self.audit_hook(self)
         return outcomes
+
+    # -- audit ---------------------------------------------------------
+
+    def page_accounting(self) -> dict:
+        """Snapshot of where every KV page is right now — the terms of
+        the page-conservation invariant the game-day auditor checks at
+        commit barriers:
+
+        ``available + row_pages + store_pages + prefix_pages == total``
+
+        (page 0 is the reserved trash page, hence ``num_pages - 1``).
+        ``row_pages`` are grants held by live rows, ``store_pages`` are
+        device pages pinned by the prefix cache, ``prefix_pages`` are
+        the generator's system-prefix hold."""
+        g = self.generator
+        return {
+            "available": g.allocator.available,
+            "row_pages": sum(len(row.pages) for row in self._rows.values()),
+            "store_pages": (
+                self._kvstore.device_pages_held
+                if self._kvstore is not None
+                else 0
+            ),
+            "prefix_pages": g.prefix_held_pages,
+            "total": g.allocator.num_pages - 1,
+        }
 
     # -- schedule ------------------------------------------------------
 

@@ -29,13 +29,21 @@ Seams (each an opt-in ``fault_plan`` attribute, zero cost when ``None``):
 - :class:`serving.engine.BatchedGenerator.step` — the engine step loop
   (``engine.step``: stalls and simulated device errors).
 
-The ``seed`` drives :meth:`FaultPlan.bernoulli` (probabilistic schedules
-materialised AT BUILD TIME into a fixed action list), so even randomised
-plans replay identically.
+The ``seed`` drives :meth:`FaultPlan.bernoulli` and :meth:`FaultPlan.jitter`
+(probabilistic/latency schedules materialised AT BUILD TIME into a fixed
+action list), so even randomised plans replay identically.
+
+Beyond fail/drop, plans shape LATENCY: a :func:`delay_` action (or a
+seeded :meth:`FaultPlan.jitter` schedule) holds the seam call for its
+seconds and then lets it succeed.  Async seams consume the plan through
+``await fault_plan.apply_async(site, ...)`` so the hold is an
+``asyncio.sleep``, never a blocked event loop; worker-thread seams
+``time.sleep`` the value :meth:`FaultPlan.apply` returns.
 """
 
 from __future__ import annotations
 
+import asyncio
 import fnmatch
 import hashlib
 import random
@@ -46,9 +54,10 @@ from typing import Callable, Optional
 
 @dataclass(frozen=True)
 class FaultAction:
-    """One injected behaviour: raise an exception, stall, or pass."""
+    """One injected behaviour: raise an exception, stall, shape latency,
+    or pass."""
 
-    kind: str  # "raise" | "sleep" | "ok"
+    kind: str  # "raise" | "sleep" | "delay" | "ok"
     make: Optional[Callable[[], BaseException]] = None
     seconds: float = 0.0
     label: str = ""
@@ -65,8 +74,8 @@ class FaultAction:
     def __repr__(self) -> str:
         if self.label:
             return f"<{self.kind}:{self.label}>"
-        if self.kind == "sleep":
-            return f"<sleep:{self.seconds}>"
+        if self.kind in ("sleep", "delay"):
+            return f"<{self.kind}:{self.seconds}>"
         return f"<{self.kind}>"
 
 
@@ -78,6 +87,15 @@ def raise_(factory: Callable[[], BaseException], label: str = "") -> FaultAction
 def sleep_(seconds: float) -> FaultAction:
     """Action that stalls a SYNC seam for ``seconds`` (engine step)."""
     return FaultAction("sleep", seconds=seconds)
+
+
+def delay_(seconds: float) -> FaultAction:
+    """Latency-shaping action: the seam call SUCCEEDS but is held for
+    ``seconds`` first.  Unlike :func:`sleep_` the plan never blocks the
+    event loop for it — ``apply`` RETURNS the delay and the seam applies
+    it in its own idiom (``await fault_plan.apply_async`` on async
+    seams, ``time.sleep`` on worker-thread seams)."""
+    return FaultAction("delay", seconds=round(float(seconds), 6))
 
 
 #: explicit no-op entry for readable sequences like [err, OK, err]
@@ -137,11 +155,26 @@ class FaultPlan:
         a probabilistic schedule that still replays byte-identically."""
         return [action if self.rng.random() < p else OK for _ in range(n)]
 
+    def jitter(self, n: int, lo: float, hi: float) -> list[FaultAction]:
+        """A length-``n`` list of :func:`delay_` actions with uniform
+        ``[lo, hi)`` seconds drawn NOW from the plan's seeded rng — the
+        latency-shaping analogue of :meth:`bernoulli`: jittered tails
+        that still replay byte-identically (the drawn values, rounded
+        into the action repr, are part of the trace)."""
+        return [delay_(self.rng.uniform(lo, hi)) for _ in range(n)]
+
     # ---- consumption (called from the seams) -----------------------------
-    def apply(self, site: str, **ctx) -> None:
+    def apply(self, site: str, **ctx) -> float:
         """Consult the plan at a seam; may raise or stall.  Every FIRED
         action is recorded in the trace as (site, per-site call index,
-        action repr)."""
+        action repr).
+
+        Returns the latency-shaping delay in seconds (0.0 when no delay
+        action fired).  ``delay`` actions are never slept here — the
+        seam owns the idiom: async seams ``await`` it via
+        :meth:`apply_async`, worker-thread seams ``time.sleep`` the
+        returned value.  A seam that ignores the return simply does not
+        support latency shaping (the action is still traced)."""
         seq = self._site_seq.get(site, 0)
         self._site_seq[site] = seq + 1
         for rule in self._rules:
@@ -156,8 +189,20 @@ class FaultPlan:
                 continue  # exhausted: later calls pass (or hit later rules)
             action = rule.actions.pop(0)
             self._trace.append((site, seq, repr(action)))
+            if action.kind == "delay":
+                return action.seconds
             action.fire()
-            return
+            return 0.0
+        return 0.0
+
+    async def apply_async(self, site: str, **ctx) -> None:
+        """:meth:`apply` for async seams: a fired ``delay``/``jitter``
+        action becomes a non-blocking ``asyncio.sleep`` so latency
+        shaping never stalls the event loop.  Raise actions propagate
+        exactly as from :meth:`apply`."""
+        seconds = self.apply(site, **ctx)
+        if seconds > 0:
+            await asyncio.sleep(seconds)
 
     # ---- replay verification --------------------------------------------
     def trace(self) -> list[tuple[str, int, str]]:

@@ -1228,6 +1228,95 @@ def test_gl012_map_is_byte_deterministic_across_runs(tmp_path):
     assert run_once() == run_once()
 
 
+def test_gl012_async_seam_registers(tmp_path):
+    """``await fault_plan.apply_async(...)`` is the async idiom of the
+    same seam registration — it must govern its call path and count as
+    a registered pattern (the sync->async seam migration must not
+    silently empty the registry)."""
+    findings, _ = run_rule(tmp_path, "GL012", {
+        "operator_tpu/operator/pipeline.py": """
+            class P:
+                async def fetch(self, op, name):
+                    await self.fault_plan.apply_async(f"kube.{op}")
+                    return await self.api.get("Pod", name, "ns")
+        """,
+        "tests/test_chaos_fixture.py": """
+            SEAM = "kube.get"
+        """,
+    })
+    assert findings == []
+
+
+def test_gl012_scenario_file_counts_as_seam_naming(tmp_path):
+    """A committed game-day scenario (tests/scenarios/*.json) naming a
+    seam rehearses it: no `named by no test` finding, and the audit map
+    lists the scenario file as the naming source."""
+    ctx = make_ctx(tmp_path, {
+        "operator_tpu/operator/gitops.py": """
+            class Git:
+                def push(self):
+                    self.fault_plan.apply("git.push")
+        """,
+        "tests/scenarios/repro-git.json": """
+            {
+              "name": "repro-git",
+              "phases": [
+                {"name": "p", "injections": [
+                  {"seam": "git.push", "kind": "fail", "error": "timeout"}
+                ]}
+              ]
+            }
+        """,
+    })
+    findings, _ = run_analysis(ctx, rules_by_id(["GL012"]))
+    assert findings == []
+    coverage = ctx.caches["seam_coverage"]
+    [seam] = coverage["seams"]
+    assert seam["tests"] == ["tests/scenarios/repro-git.json"]
+    assert coverage["scenario_files"] == {
+        "tests/scenarios/repro-git.json": ["git.push"],
+    }
+
+
+def test_gl012_scenario_unknown_seam_is_flagged(tmp_path):
+    """A scenario naming a seam no fault_plan.apply registers is dead
+    chaos — the game day would queue an injection nothing fires."""
+    ctx = make_ctx(tmp_path, {
+        "operator_tpu/mod.py": "X = 1\n",
+        "tests/scenarios/bad.json": """
+            {
+              "name": "bad",
+              "phases": [
+                {"name": "p", "injections": [
+                  {"seam": "kube.reboot", "kind": "fail", "error": "timeout"}
+                ]}
+              ]
+            }
+        """,
+    })
+    findings, _ = run_analysis(ctx, rules_by_id(["GL012"]))
+    assert len(findings) == 1
+    assert findings[0].path == "tests/scenarios/bad.json"
+    assert "unknown fault seam `kube.reboot`" in findings[0].message
+    assert findings[0].symbol == "bad"
+
+
+def test_gl012_python_injection_unknown_seam_is_flagged(tmp_path):
+    """Literal Injection("<seam>", ...) construction in test python is
+    held to the same known-seam bar as JSON scenario files."""
+    findings, _ = run_rule(tmp_path, "GL012", {
+        "operator_tpu/mod.py": "X = 1\n",
+        "tests/test_gameday_fixture.py": """
+            from operator_tpu.chaos import Injection
+
+            BAD = Injection("kube.reboot", "fail", error="timeout")
+        """,
+    })
+    assert len(findings) == 1
+    assert findings[0].path == "tests/test_gameday_fixture.py"
+    assert "unknown fault seam `kube.reboot`" in findings[0].message
+
+
 # ---------------------------------------------------------------------------
 # GL013 mesh-axis consistency
 # ---------------------------------------------------------------------------

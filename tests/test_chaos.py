@@ -987,3 +987,343 @@ def test_fabric_holder_killed_mid_fetch_falls_back_to_recompute():
     second = scenario(29)
     assert second["trace"] == first["trace"]
     assert second["tokens"] == first["tokens"]
+
+
+# ---------------------------------------------------------------------------
+# game-day conductor (operator_tpu/chaos/): composed scenarios, the
+# invariant auditor, and fault-plan shrinking
+# ---------------------------------------------------------------------------
+
+import json  # noqa: E402
+
+from operator_tpu.chaos import (  # noqa: E402
+    ChaosScenario,
+    FleetAction,
+    GameDayView,
+    Injection,
+    InvariantAuditor,
+    Phase,
+    composed_storm,
+    run_scenario,
+    shrink,
+)
+from operator_tpu.loadgen.arrivals import ArrivalSpec  # noqa: E402
+from operator_tpu.obs.record import FlightRecorder  # noqa: E402
+
+
+def test_scenario_roundtrips_and_fingerprints():
+    scen = composed_storm()
+    assert len(scen.injections()) >= 6
+    # a scenario is a runnable JSON artifact: the round trip preserves
+    # materialisation identity
+    assert (
+        ChaosScenario.from_json(scen.to_json()).fingerprint()
+        == scen.fingerprint()
+    )
+    # ...and reseeding changes it (jitter draws + arrival schedule)
+    assert composed_storm(1).fingerprint() != scen.fingerprint()
+    # with_injections keeps phases + fleet actions as structural context
+    thinned = scen.with_injections([0])
+    assert len(thinned.injections()) == 1
+    assert [p.name for p in thinned.phases] == [p.name for p in scen.phases]
+    assert any(
+        a.kind == "depose_leader"
+        for p in thinned.phases
+        for a in p.actions
+    )
+
+
+def test_composed_storm_runs_clean_under_the_conductor():
+    """The acceptance game day: replica kill + peer partition + leader
+    depose + watch drops + a 409 storm + fetch timeouts, composed in ONE
+    scenario — zero invariant violations, every declared injection
+    actually fired, the deposed lease landed on the standby, and a
+    second build of the scenario materialises byte-identically."""
+    metrics = MetricsRegistry()
+    report = run(run_scenario(composed_storm(), metrics=metrics))
+    assert report["violations"] == []
+    assert report["pending_faults"] == {}  # all six injections fired
+    assert report["invariant_checks"] >= 2  # barriers + the end check
+    kinds = [a["kind"] for a in report["actions"]]
+    assert kinds.count("kill_replica") == 1
+    assert kinds.count("depose_leader") == 1
+    assert report["leader"] == "conductor-b"
+    assert metrics.counter("chaos_watch_reopen") >= 1
+    assert metrics.counter("fabric_fetch_timeout") >= 4
+    # the replay gate: two BUILDS materialise identically
+    assert composed_storm().fingerprint() == report["fingerprint"]
+
+
+def test_scale_down_evicts_prefill_replica_mid_disagg_handoff():
+    """Unrehearsed composition: a scale-down event kills the ONLY
+    prefill replica while disaggregated handoffs are in flight.  The
+    prefill leg fails over to the mixed replica (role preference is a
+    preference, not a partition), the decode leg still seeds from the
+    handed-off resume tokens, and every arrival reaches exactly one
+    terminal outcome — the arrival-conservation probe checks the ledger
+    denominator against admissions."""
+    scen = ChaosScenario(
+        name="prefill-eviction-mid-handoff",
+        seed=41,
+        arrivals=ArrivalSpec(
+            name="storm", rate_per_min=400.0, duration_s=4.0,
+            recall_hot_fraction=0.5,
+        ),
+        fleet=("prefill", "decode", "mixed"),
+        disaggregate=True,
+        phases=(
+            Phase(
+                name="warm",
+                at_arrival=0,
+                injections=(
+                    Injection(
+                        "kube.get", "jitter", count=4,
+                        seconds=0.004, low=0.001,
+                    ),
+                ),
+            ),
+            Phase(
+                name="scale-down",
+                at_arrival=10,
+                actions=(
+                    # storm-replica-0 IS the prefill replica
+                    FleetAction("kill_replica", replica="storm-replica-0"),
+                ),
+            ),
+        ),
+    )
+    metrics = MetricsRegistry()
+    report = run(run_scenario(scen, metrics=metrics))
+    assert report["violations"] == []
+    assert report["pending_faults"] == {}
+    assert report["actions"] == [
+        {"kind": "kill_replica", "phase": "scale-down",
+         "replica": "storm-replica-0"},
+    ]
+    # disaggregation kept happening across the kill: prefill->decode
+    # handoffs completed on the surviving fleet
+    assert metrics.counter("fabric_disagg_handoff") > 0
+    assert report["slo"]["total"]["completed"] > 0
+
+
+def test_leader_depose_mid_fabric_fetch_storm_replays_identically():
+    """Unrehearsed composition: the leader is deposed while fabric
+    fetches are timing out.  Claims resume on the new leader (the
+    claim-exactly-once probe would flag any left pending), timed-out
+    fetches fall back to recompute, and the run replays byte-identically
+    — same scenario fingerprint, same fired-fault trace."""
+    scen = ChaosScenario(
+        name="depose-mid-fetch",
+        seed=43,
+        arrivals=ArrivalSpec(
+            name="storm", rate_per_min=400.0, duration_s=4.0,
+            recall_hot_fraction=0.8,
+        ),
+        fleet=("mixed", "mixed"),
+        leadership=True,
+        phases=(
+            Phase(
+                name="fetch-load",
+                at_arrival=0,
+                injections=(
+                    Injection(
+                        "fabric.fetch", "fail", error="timeout",
+                        count=3, after=2,
+                    ),
+                ),
+            ),
+            Phase(
+                name="handover",
+                at_arrival=8,
+                actions=(FleetAction("depose_leader"),),
+            ),
+        ),
+    )
+
+    def one_run():
+        metrics = MetricsRegistry()
+        report = run(run_scenario(scen, metrics=metrics))
+        assert report["violations"] == []
+        assert report["pending_faults"] == {}
+        assert report["leader"] == "conductor-b"
+        assert report["actions"] == [
+            {"kind": "depose_leader", "phase": "handover",
+             "leader": "conductor-b"},
+        ]
+        # every block has exactly one holder, so an injected timeout IS
+        # a recompute fallback; untouched fetches still verified clean
+        assert metrics.counter("fabric_fetch_fallback") >= 1
+        assert metrics.counter("fabric_fetch_ok") >= 1
+        return report
+
+    first, second = one_run(), one_run()
+    assert first["fingerprint"] == second["fingerprint"]
+    # per-site call-order consumption: the fired trace is byte-identical
+    assert first["fault_fingerprint"] == second["fault_fingerprint"]
+
+
+def _mutation_bed(seed: int = 47) -> ChaosScenario:
+    """Six injections, one of which (the 409 storm) arms the
+    drop-settle mutation — the shrinker must isolate exactly it."""
+    return ChaosScenario(
+        name="mutation-bed",
+        seed=seed,
+        arrivals=ArrivalSpec(
+            name="storm", rate_per_min=400.0, duration_s=4.0,
+            recall_hot_fraction=0.3,
+        ),
+        fleet=("mixed", "mixed"),
+        phases=(
+            Phase(
+                name="noise",
+                at_arrival=0,
+                injections=(
+                    Injection(
+                        "kube.get", "jitter", count=3,
+                        seconds=0.004, low=0.001,
+                    ),
+                    Injection("kube.patch", "delay", count=2, seconds=0.003),
+                    Injection(
+                        "kube.get_log", "fail", error="api-500",
+                        count=2, after=2,
+                    ),
+                    Injection(
+                        "kube.watch.Pod", "fail", error="watch-closed",
+                        count=1, after=3,
+                    ),
+                ),
+            ),
+            Phase(
+                name="conflict-storm",
+                at_arrival=8,
+                injections=(
+                    Injection(
+                        "kube.patch_status", "fail", error="conflict",
+                        count=3, after=4,
+                    ),
+                    Injection("fabric.fetch", "fail", error="timeout", count=2),
+                ),
+            ),
+        ),
+    )
+
+
+def test_mutation_lane_auditor_blackbox_and_shrink(tmp_path):
+    """Auditor self-coverage, end to end: a deliberately broken run
+    (one settle dropped) fires arrival-conservation, the violation is
+    black-boxed tagged with fingerprint + phase, ddmin shrinks the
+    six-injection scenario to the single guilty 409 injection, and the
+    minimal repro replays byte-identically twice."""
+    scen = _mutation_bed()
+    assert len(scen.injections()) == 6
+    recorder = FlightRecorder(
+        path=str(tmp_path / "traces.jsonl"),
+        blackbox_path=str(tmp_path / "blackbox.jsonl"),
+        metrics=MetricsRegistry(),
+    )
+    report = run(
+        run_scenario(
+            scen, mutation="drop-settle-on-conflict",
+            recorder=recorder, metrics=MetricsRegistry(),
+        )
+    )
+    assert [v["name"] for v in report["violations"]] == [
+        "arrival-conservation"
+    ]
+    recorder.flush()
+    dumps = [
+        json.loads(line)
+        for line in (tmp_path / "blackbox.jsonl").read_text().splitlines()
+    ]
+    dumps = [
+        d for d in dumps
+        if str(d.get("reason", "")).startswith("invariant-violation:")
+    ]
+    assert dumps, "the violation must leave a black-box artifact"
+    assert dumps[0]["reason"] == "invariant-violation:arrival-conservation"
+    assert dumps[0]["extra"]["fingerprint"] == report["fingerprint"]
+    assert dumps[0]["extra"]["phase"] == "end"
+    assert dumps[0]["trace"]["scenario"] == "mutation-bed"
+
+    async def probe(candidate: ChaosScenario) -> bool:
+        rep = await run_scenario(
+            candidate, mutation="drop-settle-on-conflict",
+            metrics=MetricsRegistry(),
+        )
+        return bool(rep["violations"])
+
+    result = run(shrink(scen, probe, metrics=MetricsRegistry()))
+    assert result.original == 6 and result.minimal <= 2
+    assert all(
+        i.seam == "kube.patch_status" for i in result.scenario.injections()
+    )
+    assert "LOADGEN_GAMEDAY=1" in result.repro_command("repro.json")
+
+    # the minimal repro is a runnable JSON artifact that replays
+    # byte-identically: same fingerprint, same fired trace, same verdict
+    minimal = ChaosScenario.from_json(result.repro_json())
+    replays = [
+        run(
+            run_scenario(
+                minimal, mutation="drop-settle-on-conflict",
+                metrics=MetricsRegistry(),
+            )
+        )
+        for _ in range(2)
+    ]
+    assert (
+        replays[0]["fingerprint"]
+        == replays[1]["fingerprint"]
+        == minimal.fingerprint()
+    )
+    assert replays[0]["fault_fingerprint"] == replays[1]["fault_fingerprint"]
+    assert all(r["pending_faults"] == {} for r in replays)
+    assert [
+        [v["name"] for v in r["violations"]] for r in replays
+    ] == [["arrival-conservation"], ["arrival-conservation"]]
+
+
+def test_scheduler_commit_barrier_hook_catches_a_leaked_page():
+    """The always-on half of the auditor: wired into the serving
+    scheduler's commit barrier it passes every step of a healthy
+    request, and catches a page that leaves the allocator outside any
+    row/store/prefix ledger — the skipped-release class of leak."""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from operator_tpu.models import TINY_TEST, init_params
+    from operator_tpu.models.tokenizer import ByteTokenizer
+    from operator_tpu.serving.engine import BatchedGenerator, SamplingParams
+    from operator_tpu.serving.sched import Scheduler
+
+    params = init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+    generator = BatchedGenerator(
+        params, TINY_TEST, ByteTokenizer(), paged=True, max_slots=4,
+        max_seq=128, page_size=16, cache_dtype=jnp.float32,
+        metrics=MetricsRegistry(),
+    )
+    auditor = InvariantAuditor(metrics=MetricsRegistry())
+    sched = Scheduler(
+        generator,
+        audit_hook=auditor.barrier_hook(
+            lambda s: GameDayView(schedulers=[s])
+        ),
+    )
+    greedy = SamplingParams(max_tokens=4, temperature=0.0, stop_on_eos=False)
+
+    def drain(req):
+        for _ in range(200):
+            for outcome in sched.step():
+                if outcome.req_id == req:
+                    return outcome
+        raise AssertionError("request never finished")
+
+    drain(sched.enqueue("healthy request", greedy))
+    assert auditor.checks > 0 and auditor.violations == []
+
+    # the deliberate bug: one page allocated behind the scheduler's back
+    generator.allocator.allocate(1)
+    drain(sched.enqueue("leaky request", greedy))
+    assert {v.name for v in auditor.violations} == {"kv-page-conservation"}
+    detail = auditor.violations[0].detail["imbalanced"][0]
+    assert detail["sum"] == detail["total"] - 1

@@ -470,6 +470,99 @@ class TestFabricFetcher:
         assert plan.pending() == {}
 
 
+class TestConsecutiveFailureDecay:
+    """A black-holed holder never 404s, so eviction-on-404 alone would
+    advertise it forever; the index decays a (replica, block) entry
+    after ``failure_threshold`` CONSECUTIVE timeout/transport failures
+    instead — and only consecutive ones, so a flaky-but-alive peer is
+    never evicted by lifetime totals."""
+
+    def _two_holders(self):
+        index = FabricIndex()
+        index.update("a", [HASH], url="http://a")
+        index.update("b", [HASH], url="http://b")
+        return index
+
+    def test_black_holed_peer_decays_after_threshold(self):
+        index = self._two_holders()
+        k, v = _page(8)
+
+        async def transport(url, budget_s):
+            if "//a/" in url:
+                raise asyncio.TimeoutError()  # black hole: never a 404
+            return 200, encode_block(bytes.fromhex(HASH), k, v)
+
+        fetcher = make_fetcher(index, transport)
+        for _ in range(3):
+            # each fetch times out on "a" (decaying it once) and is
+            # served by "b" — the caller never sees the black hole
+            assert asyncio.run(fetcher.fetch_block(HASH)) is not None
+        # the third consecutive timeout evicted the (a, HASH) entry
+        assert index.holders(HASH) == ["b"]
+        m = fetcher.metrics
+        assert m.counter("fabric_fetch_timeout") == 3
+        assert m.counter("fabric_index_decayed") == 1
+        assert m.counter("fabric_fetch_ok") == 3
+        assert index.stats()["decaying"] == 0
+        # and the dead peer is no longer consulted at all
+        assert asyncio.run(fetcher.fetch_block(HASH)) is not None
+        assert m.counter("fabric_fetch_timeout") == 3
+
+    def test_success_resets_the_consecutive_count(self):
+        index = self._two_holders()
+        k, v = _page(9)
+        black_hole = {"on": True}
+
+        async def transport(url, budget_s):
+            if "//a/" in url and black_hole["on"]:
+                raise asyncio.TimeoutError()
+            return 200, encode_block(bytes.fromhex(HASH), k, v)
+
+        fetcher = make_fetcher(index, transport)
+        for _ in range(2):
+            asyncio.run(fetcher.fetch_block(HASH))
+        assert index.stats()["decaying"] == 1
+        black_hole["on"] = False  # one answer = fresh liveness evidence
+        asyncio.run(fetcher.fetch_block(HASH))
+        assert index.stats()["decaying"] == 0
+        black_hole["on"] = True
+        for _ in range(2):
+            asyncio.run(fetcher.fetch_block(HASH))
+        # two MORE failures after the reset: still below the threshold
+        assert index.holders(HASH) == ["a", "b"]
+        assert fetcher.metrics.counter("fabric_index_decayed") == 0
+
+    def test_fresh_inventory_report_resets_the_count(self):
+        index = FabricIndex()
+        index.update("a", [HASH], url="http://a")
+        assert index.note_failure("a", HASH) is False
+        assert index.note_failure("a", HASH) is False
+        assert index.stats()["decaying"] == 1
+        # a fresh report is fresh evidence the replica is alive
+        index.update("a", [HASH], url="http://a")
+        assert index.stats()["decaying"] == 0
+        assert index.note_failure("a", HASH) is False  # count restarted
+        assert index.holders(HASH) == ["a"]
+
+    def test_404_still_evicts_immediately(self):
+        """Decay is for peers that cannot answer; a peer that CAN answer
+        "I don't have it" still evicts on the first 404."""
+        index = self._two_holders()
+        k, v = _page(10)
+
+        async def transport(url, budget_s):
+            if "//a/" in url:
+                return 404, b""
+            return 200, encode_block(bytes.fromhex(HASH), k, v)
+
+        fetcher = make_fetcher(index, transport)
+        assert asyncio.run(fetcher.fetch_block(HASH)) is not None
+        assert index.holders(HASH) == ["b"]
+        m = fetcher.metrics
+        assert m.counter("fabric_index_evicted") == 1
+        assert m.counter("fabric_index_decayed") == 0
+
+
 # ---------------------------------------------------------------------------
 # disaggregation primitives
 # ---------------------------------------------------------------------------
